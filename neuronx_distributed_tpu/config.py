@@ -1,0 +1,105 @@
+"""Typed configuration for the framework.
+
+Replaces the reference's validated-dict API ``neuronx_distributed_config``
+(``trainer/trainer.py:26-92``) and its env-flag sprawl (SURVEY §5.6) with one
+set of dataclasses.  Everything downstream (trainer, checkpoint, pipeline)
+consumes these objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from neuronx_distributed_tpu.parallel.mesh import MeshConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Reference: ``optimizer_config`` sub-dict (``trainer/trainer.py:40-56``)."""
+
+    zero_one_enabled: bool = True
+    grad_clipping: bool = True
+    max_grad_norm: float = 1.0
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.01
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-8
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Reference: ``pipeline_config`` kwargs for NxDPPModel (``pipeline/model.py:46-157``).
+
+    ``num_microbatches`` is the 1F1B microbatch count; stage assignment is an
+    explicit layer partition (no FX tracing on TPU — jaxprs are already
+    functional)."""
+
+    num_microbatches: int = 1
+    schedule: str = "1f1b"  # "1f1b" | "gpipe" | "inference"
+
+
+@dataclasses.dataclass(frozen=True)
+class ActivationCheckpointConfig:
+    """Reference: activation_checkpoint_config (``trainer/trainer.py:131-158``).
+
+    ``policy``: "none" | "full" | "selective" — selective remats attention+MLP
+    cores like the reference's CoreAttention/MLP checkpointing
+    (``modeling_llama_nxd.py:184-187``)."""
+
+    policy: str = "selective"
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingConfig:
+    """Top-level config (the ``nxd_config`` dict equivalent)."""
+
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+    activation_checkpoint: ActivationCheckpointConfig = dataclasses.field(
+        default_factory=ActivationCheckpointConfig
+    )
+    sequence_parallel: bool = True
+    # dtype policy: explicit instead of the reference's XLA_DOWNCAST_BF16 trick
+    # (SURVEY §7 hard-part 5): bf16 compute, fp32 params + optimizer states.
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    seed: int = 1234
+
+    def replace(self, **kw: Any) -> "TrainingConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def training_config(**kwargs: Any) -> TrainingConfig:
+    """Convenience constructor accepting flat kwargs for the common fields,
+    in the spirit of ``neuronx_distributed_config(...)``."""
+    sub_fields = {
+        "mesh": MeshConfig,
+        "optimizer": OptimizerConfig,
+        "pipeline": PipelineConfig,
+        "activation_checkpoint": ActivationCheckpointConfig,
+    }
+    # Whole sub-config objects may be passed directly (mesh=MeshConfig(...)).
+    sub_objs = {k: kwargs.pop(k) for k in list(kwargs) if k in sub_fields}
+    top_keys = {f.name for f in dataclasses.fields(TrainingConfig)} - set(sub_fields)
+
+    built: dict = {}
+    for name, cls in sub_fields.items():
+        keys = {f.name for f in dataclasses.fields(cls)}
+        # ActivationCheckpointConfig.policy would shadow nothing today, but
+        # guard against overlapping flat keys landing in two sub-configs.
+        sub_kw = {k: kwargs.pop(k) for k in list(kwargs) if k in keys}
+        if name in sub_objs:
+            if sub_kw:
+                raise TypeError(
+                    f"pass either {name}= or its flat keys {sorted(sub_kw)}, not both"
+                )
+            built[name] = sub_objs[name]
+        else:
+            built[name] = cls(**sub_kw)
+    top_kw = {k: kwargs.pop(k) for k in list(kwargs) if k in top_keys}
+    if kwargs:
+        raise TypeError(f"unknown config keys: {sorted(kwargs)}")
+    return TrainingConfig(**built, **top_kw)

@@ -1,0 +1,19 @@
+"""Small shape/partition helpers (reference: ``parallel_layers/utils.py:17-76``)."""
+
+from __future__ import annotations
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Exact integer division, raising on remainder (reference ``utils.divide``)."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Smallest value >= n that is divisible by ``multiple``."""
+    return ((n + multiple - 1) // multiple) * multiple

@@ -1,0 +1,39 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+The reference's unit tests mock out parallel_state entirely and its
+integration tests need real Trn1 hardware (SURVEY §4); on JAX we can do better
+— 8 simulated XLA:CPU devices give a real SPMD mesh with real collectives, so
+the dense-vs-sharded numerical-equivalence methodology of
+``test/integration/parallel_layers/test_layers.py:42-84`` runs in CI with no
+hardware.
+"""
+
+import os
+
+# Must be set before the XLA backend initializes.  The environment may pin
+# JAX_PLATFORMS to a hardware plugin (its config value is latched when
+# sitecustomize imports jax), so use jax.config.update rather than the env var.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import pytest  # noqa: E402
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_parallel_state():
+    yield
+    mesh_lib.destroy_model_parallel()
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
