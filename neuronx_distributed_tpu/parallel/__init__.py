@@ -1,0 +1,67 @@
+"""Parallelism primitives: mesh state, collective mappings, TP layers, norms.
+
+Mirrors the reference's ``parallel_layers`` package surface
+(``src/neuronx_distributed/parallel_layers/__init__.py:4-22``)."""
+
+from neuronx_distributed_tpu.parallel.mesh import (
+    BATCH_AXES,
+    CONTEXT_AXIS,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    KV_REPLICA_AXIS,
+    MESH_AXES,
+    PIPELINE_AXIS,
+    SEQUENCE_AXES,
+    TENSOR_AXES,
+    TENSOR_AXIS,
+    MeshConfig,
+    destroy_model_parallel,
+    get_data_parallel_size,
+    get_kv_size_multiplier,
+    get_mesh,
+    get_pipeline_parallel_size,
+    get_tensor_parallel_size,
+    initialize_model_parallel,
+    mesh_context,
+    model_parallel_is_initialized,
+    named_sharding,
+)
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+    RowParallelLinear,
+    shard_activation,
+)
+from neuronx_distributed_tpu.parallel.norm import LayerNorm, RMSNorm
+from neuronx_distributed_tpu.parallel import mappings
+
+__all__ = [
+    "BATCH_AXES",
+    "CONTEXT_AXIS",
+    "DATA_AXIS",
+    "EXPERT_AXIS",
+    "KV_REPLICA_AXIS",
+    "MESH_AXES",
+    "PIPELINE_AXIS",
+    "SEQUENCE_AXES",
+    "TENSOR_AXES",
+    "TENSOR_AXIS",
+    "MeshConfig",
+    "initialize_model_parallel",
+    "destroy_model_parallel",
+    "model_parallel_is_initialized",
+    "get_mesh",
+    "get_tensor_parallel_size",
+    "get_pipeline_parallel_size",
+    "get_data_parallel_size",
+    "get_kv_size_multiplier",
+    "mesh_context",
+    "named_sharding",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "ParallelEmbedding",
+    "shard_activation",
+    "LayerNorm",
+    "RMSNorm",
+    "mappings",
+]

@@ -1,0 +1,236 @@
+"""Tensor-parallel layers (GSPMD production path).
+
+TPU-native re-design of the reference's Megatron TP modules
+(``parallel_layers/layers.py``: ``ColumnParallelLinear`` :372-516,
+``RowParallelLinear`` :519-660, ``ParallelEmbedding`` :97-205).  Instead of
+hand-written autograd Functions with explicit all-gather / all-reduce /
+reduce-scatter calls (``layers.py:208-334``), each module:
+
+- creates its kernel with a :class:`flax.linen.Partitioned` metadata spec
+  (column-parallel → sharded on the output dim, row-parallel → input dim,
+  embedding → vocab dim), and
+- constrains its activations with ``with_sharding_constraint`` so GSPMD
+  inserts exactly the Megatron collectives — including the backward-pass
+  conjugates and the async overlap the reference implements by hand
+  (``layers.py:270-305``), which XLA's latency-hiding scheduler recovers
+  automatically.
+
+Sequence parallelism (Megatron-SP, reference ``mappings.py:198-250`` +
+``layers.py:230-238,311-324``) is an activation-sharding choice here: SP
+regions carry activations as ``[batch, seq/TP, hidden]``; entering a column-
+parallel layer XLA all-gathers the sequence dim, and a row-parallel layer's
+output constraint reduce-scatters back onto it.
+
+Fused projections (reference ``stride=`` for QKV / gate-up,
+``layers.py:372-516``, ``modeling_llama_nxd.py:142-150``) are expressed
+shape-wise: ``n_fused > 1`` keeps a leading fused axis on the kernel so every
+TP shard holds matching slices of each fused part — no interleaving tricks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel.mesh import (
+    SEQUENCE_AXES,
+    TENSOR_AXES,
+    get_mesh,
+    model_parallel_is_initialized,
+)
+
+Dtype = Any
+Initializer = Callable[..., jax.Array]
+
+_U = P.UNCONSTRAINED
+
+
+def shard_activation(x: jax.Array, spec: P) -> jax.Array:
+    """Constrain ``x``'s sharding over the global mesh (no-op if no mesh)."""
+    if not model_parallel_is_initialized():
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(get_mesh(), spec))
+
+
+def _trailing_spec(ndim: int, **dims: Any) -> P:
+    """Build a PartitionSpec that pins only dims addressed from the end.
+
+    ``_trailing_spec(3, last=TENSOR_AXES)`` → P(U, U, ('kvr','tp')).
+    Keys: ``last`` (features dim), ``seq`` (dim -2).
+    """
+    entries = [_U] * ndim
+    if "last" in dims:
+        entries[-1] = dims["last"]
+    if "seq" in dims and ndim >= 2:
+        entries[-2] = dims["seq"]
+    return P(*entries)
+
+
+class ColumnParallelLinear(nn.Module):
+    """Linear with output-dim sharding (reference ``layers.py:372-516``).
+
+    Args:
+      features: global output size (sum over TP shards).
+      n_fused: number of fused sub-projections (QKV=3, gate-up=2).  When >1
+        the kernel carries an explicit fused axis and the output is returned
+        as ``[..., n_fused, features // n_fused]`` so each TP shard holds
+        matching slices of every part (TPU-native form of reference
+        ``stride=``).
+      gather_output: all-gather the output so every shard sees the full
+        feature dim (reference ``gather_output=True``).
+      sequence_parallel: input activations are sequence-sharded
+        ``[batch, seq/TP, hidden]``; XLA all-gathers seq before the matmul.
+    """
+
+    features: int
+    use_bias: bool = True
+    gather_output: bool = False
+    sequence_parallel: bool = False
+    n_fused: int = 1
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = nn.initializers.lecun_normal()
+    bias_init: Initializer = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        in_features = x.shape[-1]
+        if self.features % self.n_fused != 0:
+            raise ValueError(f"features={self.features} not divisible by n_fused={self.n_fused}")
+        per_fused = self.features // self.n_fused
+
+        if self.n_fused == 1:
+            kernel = self.param(
+                "kernel",
+                nn.with_partitioning(self.kernel_init, (None, TENSOR_AXES)),
+                (in_features, self.features),
+                self.param_dtype,
+            )
+        else:
+            kernel = self.param(
+                "kernel",
+                nn.with_partitioning(self.kernel_init, (None, None, TENSOR_AXES)),
+                (in_features, self.n_fused, per_fused),
+                self.param_dtype,
+            )
+
+        x = x.astype(self.dtype)
+        if self.sequence_parallel:
+            x = shard_activation(x, _trailing_spec(x.ndim, seq=SEQUENCE_AXES, last=None))
+        kernel = jnp.asarray(kernel, self.dtype)
+
+        if self.n_fused == 1:
+            y = jax.lax.dot_general(
+                x, kernel, (((x.ndim - 1,), (0,)), ((), ())), preferred_element_type=self.dtype
+            )
+        else:
+            y = jnp.einsum("...h,hfp->...fp", x, kernel, preferred_element_type=self.dtype)
+        # The load-bearing constraint: output sharded on the feature dim makes
+        # GSPMD insert the Megatron collectives (and their bwd conjugates).
+        y = shard_activation(y, _trailing_spec(y.ndim, last=TENSOR_AXES))
+
+        if self.use_bias:
+            if self.n_fused == 1:
+                bias = self.param(
+                    "bias",
+                    nn.with_partitioning(self.bias_init, (TENSOR_AXES,)),
+                    (self.features,),
+                    self.param_dtype,
+                )
+            else:
+                bias = self.param(
+                    "bias",
+                    nn.with_partitioning(self.bias_init, (None, TENSOR_AXES)),
+                    (self.n_fused, per_fused),
+                    self.param_dtype,
+                )
+            y = y + jnp.asarray(bias, self.dtype)
+
+        if self.gather_output:
+            y = shard_activation(y, _trailing_spec(y.ndim, last=None))
+        return y
+
+
+class RowParallelLinear(nn.Module):
+    """Linear with input-dim sharding (reference ``layers.py:519-660``).
+
+    The matmul contracts over the sharded input dim, so each shard produces a
+    partial sum; the output constraint makes GSPMD finish it with an
+    all-reduce (``input_is_parallel`` + dense output, reference
+    ``layers.py:654-658``) or a reduce-scatter onto the sequence dim
+    (``sequence_parallel``)."""
+
+    features: int
+    use_bias: bool = True
+    input_is_parallel: bool = True
+    sequence_parallel: bool = False
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = nn.initializers.lecun_normal()
+    bias_init: Initializer = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        in_features = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (TENSOR_AXES, None)),
+            (in_features, self.features),
+            self.param_dtype,
+        )
+        x = x.astype(self.dtype)
+        if self.input_is_parallel:
+            x = shard_activation(x, _trailing_spec(x.ndim, last=TENSOR_AXES))
+        y = jax.lax.dot_general(
+            x,
+            jnp.asarray(kernel, self.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=self.dtype,
+        )
+        if self.sequence_parallel:
+            y = shard_activation(y, _trailing_spec(y.ndim, seq=SEQUENCE_AXES, last=None))
+        else:
+            y = shard_activation(y, _trailing_spec(y.ndim, last=None))
+        if self.use_bias:
+            # Bias is replicated and added after the reduction (reference adds
+            # bias post all-reduce on the full output, layers.py:650-659).
+            bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
+            y = y + jnp.asarray(bias, self.dtype)
+        return y
+
+
+class ParallelEmbedding(nn.Module):
+    """Vocab-sharded embedding (reference ``layers.py:97-205``).
+
+    The table is sharded along the vocab dim; GSPMD lowers the sharded take
+    to the same mask-local-lookup + psum the reference writes by hand
+    (out-of-range mask + all-reduce combine, ``layers.py:182-205``)."""
+
+    num_embeddings: int
+    features: int
+    sequence_parallel_output: bool = False
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    embedding_init: Initializer = nn.initializers.normal(stddev=0.02)
+
+    @nn.compact
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        embedding = self.param(
+            "embedding",
+            nn.with_partitioning(self.embedding_init, (TENSOR_AXES, None)),
+            (self.num_embeddings, self.features),
+            self.param_dtype,
+        )
+        y = jnp.take(jnp.asarray(embedding, self.dtype), ids, axis=0)
+        if self.sequence_parallel_output:
+            # Model enters its first SP region right after the embedding
+            # (reference scatter_to_sequence_parallel_region,
+            # modeling_llama_nxd.py:530-532).
+            y = shard_activation(y, _trailing_spec(y.ndim, seq=SEQUENCE_AXES, last=None))
+        else:
+            y = shard_activation(y, _trailing_spec(y.ndim, last=None))
+        return y

@@ -1,0 +1,225 @@
+"""Conjugate-pair collective mappings (explicit shard_map path).
+
+TPU-native counterpart of the reference's autograd-aware collectives
+(``parallel_layers/mappings.py:126-283``): the 7 Megatron conjugate pairs,
+here as ``jax.custom_vjp`` functions over named mesh axes, usable inside
+``shard_map``.  The production layers (``parallel/layers.py``) rely on GSPMD
+sharding constraints instead — XLA inserts these same collectives
+automatically — but the explicit forms are needed where collective placement
+must be exact (vocab-parallel loss, parity tests, ring attention).
+
+Forward/backward conjugacy table (reference ``mappings.py``):
+
+=============================================  ==========================
+forward                                        backward
+=============================================  ==========================
+copy (identity)                                psum over tp
+psum over tp                                   copy (identity)
+split along last dim                           all-gather along last dim
+all-gather along last dim                      split along last dim
+split along seq (first data) dim               all-gather along seq dim
+all-gather along seq dim                       reduce-scatter | split
+reduce-scatter along seq dim                   all-gather along seq dim
+=============================================  ==========================
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from neuronx_distributed_tpu.parallel.mesh import TENSOR_AXES
+
+AxisNames = Union[str, Tuple[str, ...]]
+
+
+def _axes(axis_name: Optional[AxisNames]) -> AxisNames:
+    return TENSOR_AXES if axis_name is None else axis_name
+
+
+def axis_size(axis_name: Optional[AxisNames] = None) -> int:
+    """Product of the given (possibly tuple) axis sizes. Trace-time constant."""
+    ax = _axes(axis_name)
+    if isinstance(ax, str):
+        ax = (ax,)
+    size = 1
+    for a in ax:
+        size *= lax.axis_size(a)
+    return size
+
+
+def axis_rank(axis_name: Optional[AxisNames] = None) -> jax.Array:
+    """Combined rank along (possibly tuple) axes, major-to-minor order."""
+    ax = _axes(axis_name)
+    if isinstance(ax, str):
+        ax = (ax,)
+    rank = jnp.zeros((), dtype=jnp.int32)
+    for a in ax:
+        rank = rank * lax.axis_size(a) + lax.axis_index(a)
+    return rank
+
+
+def _split_along_dim(x: jax.Array, dim: int, axis_name: AxisNames) -> jax.Array:
+    n = axis_size(axis_name)
+    if n == 1:
+        return x
+    if x.shape[dim] % n != 0:
+        raise ValueError(
+            f"cannot split dim {dim} of size {x.shape[dim]} across {n} ranks "
+            f"(axis {axis_name}): not divisible"
+        )
+    rank = axis_rank(axis_name)
+    chunk = x.shape[dim] // n
+    return lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=dim)
+
+
+# ---------------------------------------------------------------------------
+# copy <-> psum   (reference _CopyToModelParallelRegion / _ReduceFrom...)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_parallel_region(x: jax.Array, axis_name: Optional[AxisNames] = None) -> jax.Array:
+    """fwd identity, bwd psum over the TP axes (``mappings.py:126-141``)."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (lax.psum(g, _axes(axis_name)),)
+
+
+copy_to_tensor_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_parallel_region(x: jax.Array, axis_name: Optional[AxisNames] = None) -> jax.Array:
+    """fwd psum over TP, bwd identity (``mappings.py:144-159``)."""
+    return lax.psum(x, _axes(axis_name))
+
+
+def _reduce_fwd(x, axis_name):
+    return lax.psum(x, _axes(axis_name)), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# ---------------------------------------------------------------------------
+# split/gather along the LAST dim (TP region; reference _ScatterTo/_GatherFrom)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_parallel_region(x: jax.Array, axis_name: Optional[AxisNames] = None) -> jax.Array:
+    """fwd split last dim, bwd all-gather last dim (``mappings.py:162-177``)."""
+    return _split_along_dim(x, -1, _axes(axis_name))
+
+
+def _scatter_tp_fwd(x, axis_name):
+    return _split_along_dim(x, -1, _axes(axis_name)), None
+
+
+def _scatter_tp_bwd(axis_name, _, g):
+    return (lax.all_gather(g, _axes(axis_name), axis=g.ndim - 1, tiled=True),)
+
+
+scatter_to_tensor_parallel_region.defvjp(_scatter_tp_fwd, _scatter_tp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_parallel_region(x: jax.Array, axis_name: Optional[AxisNames] = None) -> jax.Array:
+    """fwd all-gather last dim, bwd split last dim (``mappings.py:180-195``)."""
+    return lax.all_gather(x, _axes(axis_name), axis=x.ndim - 1, tiled=True)
+
+
+def _gather_tp_fwd(x, axis_name):
+    return lax.all_gather(x, _axes(axis_name), axis=x.ndim - 1, tiled=True), None
+
+
+def _gather_tp_bwd(axis_name, _, g):
+    return (_split_along_dim(g, -1, _axes(axis_name)),)
+
+
+gather_from_tensor_parallel_region.defvjp(_gather_tp_fwd, _gather_tp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# sequence-parallel region: first ("sequence") dim, configurable
+# (reference _ScatterToSequenceParallelRegion etc., mappings.py:198-250)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_to_sequence_parallel_region(
+    x: jax.Array, seq_dim: int = 0, axis_name: Optional[AxisNames] = None
+) -> jax.Array:
+    """fwd split seq dim, bwd all-gather seq dim (``mappings.py:198-210``)."""
+    return _split_along_dim(x, seq_dim, _axes(axis_name))
+
+
+def _scatter_sp_fwd(x, seq_dim, axis_name):
+    return _split_along_dim(x, seq_dim, _axes(axis_name)), None
+
+
+def _scatter_sp_bwd(seq_dim, axis_name, _, g):
+    return (lax.all_gather(g, _axes(axis_name), axis=seq_dim, tiled=True),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_scatter_sp_fwd, _scatter_sp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def gather_from_sequence_parallel_region(
+    x: jax.Array,
+    seq_dim: int = 0,
+    to_tensor_parallel: bool = True,
+    axis_name: Optional[AxisNames] = None,
+) -> jax.Array:
+    """fwd all-gather seq dim; bwd reduce-scatter (if feeding a TP block) or
+    plain split (``mappings.py:213-232``)."""
+    return lax.all_gather(x, _axes(axis_name), axis=seq_dim, tiled=True)
+
+
+def _gather_sp_fwd(x, seq_dim, to_tensor_parallel, axis_name):
+    return lax.all_gather(x, _axes(axis_name), axis=seq_dim, tiled=True), None
+
+
+def _gather_sp_bwd(seq_dim, to_tensor_parallel, axis_name, _, g):
+    ax = _axes(axis_name)
+    if to_tensor_parallel:
+        return (lax.psum_scatter(g, ax, scatter_dimension=seq_dim, tiled=True),)
+    return (_split_along_dim(g, seq_dim, ax),)
+
+
+gather_from_sequence_parallel_region.defvjp(_gather_sp_fwd, _gather_sp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_to_sequence_parallel_region(
+    x: jax.Array, seq_dim: int = 0, axis_name: Optional[AxisNames] = None
+) -> jax.Array:
+    """fwd reduce-scatter seq dim, bwd all-gather seq dim (``mappings.py:235-250``)."""
+    return lax.psum_scatter(x, _axes(axis_name), scatter_dimension=seq_dim, tiled=True)
+
+
+def _rs_sp_fwd(x, seq_dim, axis_name):
+    return lax.psum_scatter(x, _axes(axis_name), scatter_dimension=seq_dim, tiled=True), None
+
+
+def _rs_sp_bwd(seq_dim, axis_name, _, g):
+    return (lax.all_gather(g, _axes(axis_name), axis=seq_dim, tiled=True),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_rs_sp_fwd, _rs_sp_bwd)
