@@ -1,8 +1,21 @@
-"""Parallelism primitives: mesh state, collective mappings, TP layers, norms.
+"""Parallelism primitives: mesh state, collective mappings, TP layers, loss,
+GQA QKV, norms.
 
 Mirrors the reference's ``parallel_layers`` package surface
 (``src/neuronx_distributed/parallel_layers/__init__.py:4-22``)."""
 
+from neuronx_distributed_tpu.parallel import mappings
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+    RowParallelLinear,
+    shard_activation,
+    trailing_spec,
+)
+from neuronx_distributed_tpu.parallel.loss import (
+    parallel_cross_entropy,
+    vocab_parallel_cross_entropy,
+)
 from neuronx_distributed_tpu.parallel.mesh import (
     BATCH_AXES,
     CONTEXT_AXIS,
@@ -26,14 +39,12 @@ from neuronx_distributed_tpu.parallel.mesh import (
     model_parallel_is_initialized,
     named_sharding,
 )
-from neuronx_distributed_tpu.parallel.layers import (
-    ColumnParallelLinear,
-    ParallelEmbedding,
-    RowParallelLinear,
-    shard_activation,
-)
 from neuronx_distributed_tpu.parallel.norm import LayerNorm, RMSNorm
-from neuronx_distributed_tpu.parallel import mappings
+from neuronx_distributed_tpu.parallel.qkv import (
+    GQAQKVColumnParallelLinear,
+    KV_HEAD_AXES,
+    Q_HEAD_AXES,
+)
 
 __all__ = [
     "BATCH_AXES",
@@ -46,6 +57,8 @@ __all__ = [
     "SEQUENCE_AXES",
     "TENSOR_AXES",
     "TENSOR_AXIS",
+    "Q_HEAD_AXES",
+    "KV_HEAD_AXES",
     "MeshConfig",
     "initialize_model_parallel",
     "destroy_model_parallel",
@@ -60,7 +73,11 @@ __all__ = [
     "ColumnParallelLinear",
     "RowParallelLinear",
     "ParallelEmbedding",
+    "GQAQKVColumnParallelLinear",
     "shard_activation",
+    "trailing_spec",
+    "parallel_cross_entropy",
+    "vocab_parallel_cross_entropy",
     "LayerNorm",
     "RMSNorm",
     "mappings",

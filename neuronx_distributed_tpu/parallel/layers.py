@@ -42,6 +42,7 @@ from neuronx_distributed_tpu.parallel.mesh import (
     get_mesh,
     model_parallel_is_initialized,
 )
+from neuronx_distributed_tpu.utils.common import divide
 
 Dtype = Any
 Initializer = Callable[..., jax.Array]
@@ -56,10 +57,10 @@ def shard_activation(x: jax.Array, spec: P) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, NamedSharding(get_mesh(), spec))
 
 
-def _trailing_spec(ndim: int, **dims: Any) -> P:
+def trailing_spec(ndim: int, **dims: Any) -> P:
     """Build a PartitionSpec that pins only dims addressed from the end.
 
-    ``_trailing_spec(3, last=TENSOR_AXES)`` → P(U, U, ('kvr','tp')).
+    ``trailing_spec(3, last=TENSOR_AXES)`` → P(U, U, ('kvr','tp')).
     Keys: ``last`` (features dim), ``seq`` (dim -2).
     """
     entries = [_U] * ndim
@@ -99,9 +100,7 @@ class ColumnParallelLinear(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         in_features = x.shape[-1]
-        if self.features % self.n_fused != 0:
-            raise ValueError(f"features={self.features} not divisible by n_fused={self.n_fused}")
-        per_fused = self.features // self.n_fused
+        per_fused = divide(self.features, self.n_fused)
 
         if self.n_fused == 1:
             kernel = self.param(
@@ -120,7 +119,7 @@ class ColumnParallelLinear(nn.Module):
 
         x = x.astype(self.dtype)
         if self.sequence_parallel:
-            x = shard_activation(x, _trailing_spec(x.ndim, seq=SEQUENCE_AXES, last=None))
+            x = shard_activation(x, trailing_spec(x.ndim, seq=SEQUENCE_AXES, last=None))
         kernel = jnp.asarray(kernel, self.dtype)
 
         if self.n_fused == 1:
@@ -131,7 +130,7 @@ class ColumnParallelLinear(nn.Module):
             y = jnp.einsum("...h,hfp->...fp", x, kernel, preferred_element_type=self.dtype)
         # The load-bearing constraint: output sharded on the feature dim makes
         # GSPMD insert the Megatron collectives (and their bwd conjugates).
-        y = shard_activation(y, _trailing_spec(y.ndim, last=TENSOR_AXES))
+        y = shard_activation(y, trailing_spec(y.ndim, last=TENSOR_AXES))
 
         if self.use_bias:
             if self.n_fused == 1:
@@ -151,7 +150,7 @@ class ColumnParallelLinear(nn.Module):
             y = y + jnp.asarray(bias, self.dtype)
 
         if self.gather_output:
-            y = shard_activation(y, _trailing_spec(y.ndim, last=None))
+            y = shard_activation(y, trailing_spec(y.ndim, last=None))
         return y
 
 
@@ -184,7 +183,7 @@ class RowParallelLinear(nn.Module):
         )
         x = x.astype(self.dtype)
         if self.input_is_parallel:
-            x = shard_activation(x, _trailing_spec(x.ndim, last=TENSOR_AXES))
+            x = shard_activation(x, trailing_spec(x.ndim, last=TENSOR_AXES))
         y = jax.lax.dot_general(
             x,
             jnp.asarray(kernel, self.dtype),
@@ -192,9 +191,9 @@ class RowParallelLinear(nn.Module):
             preferred_element_type=self.dtype,
         )
         if self.sequence_parallel:
-            y = shard_activation(y, _trailing_spec(y.ndim, seq=SEQUENCE_AXES, last=None))
+            y = shard_activation(y, trailing_spec(y.ndim, seq=SEQUENCE_AXES, last=None))
         else:
-            y = shard_activation(y, _trailing_spec(y.ndim, last=None))
+            y = shard_activation(y, trailing_spec(y.ndim, last=None))
         if self.use_bias:
             # Bias is replicated and added after the reduction (reference adds
             # bias post all-reduce on the full output, layers.py:650-659).
@@ -230,7 +229,7 @@ class ParallelEmbedding(nn.Module):
             # Model enters its first SP region right after the embedding
             # (reference scatter_to_sequence_parallel_region,
             # modeling_llama_nxd.py:530-532).
-            y = shard_activation(y, _trailing_spec(y.ndim, seq=SEQUENCE_AXES, last=None))
+            y = shard_activation(y, trailing_spec(y.ndim, seq=SEQUENCE_AXES, last=None))
         else:
-            y = shard_activation(y, _trailing_spec(y.ndim, last=None))
+            y = shard_activation(y, trailing_spec(y.ndim, last=None))
         return y

@@ -37,8 +37,13 @@ from neuronx_distributed_tpu.parallel.mesh import TENSOR_AXES
 AxisNames = Union[str, Tuple[str, ...]]
 
 
-def _axes(axis_name: Optional[AxisNames]) -> AxisNames:
+def resolve_axes(axis_name: Optional[AxisNames]) -> AxisNames:
+    """Default an axis-name argument to the full TP axis tuple."""
     return TENSOR_AXES if axis_name is None else axis_name
+
+
+# internal alias used throughout this module
+_axes = resolve_axes
 
 
 def axis_size(axis_name: Optional[AxisNames] = None) -> int:

@@ -37,3 +37,21 @@ def devices8():
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
     return devs[:8]
+
+
+def sharded_params(params):
+    """Place flax Partitioned params on the global mesh per their metadata
+    (shared by the layer/qkv/model parity tests)."""
+    from flax import linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neuronx_distributed_tpu.parallel.mesh import get_mesh
+
+    mesh = get_mesh()
+    specs = nn.get_partition_spec(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        nn.unbox(params),
+        specs,
+        is_leaf=lambda x: isinstance(x, P) or not isinstance(x, dict),
+    )

@@ -15,6 +15,7 @@ from neuronx_distributed_tpu.parallel.layers import (
     ParallelEmbedding,
     RowParallelLinear,
 )
+from conftest import sharded_params
 from neuronx_distributed_tpu.parallel.norm import LayerNorm, RMSNorm
 from neuronx_distributed_tpu.parallel.mesh import (
     get_mesh,
@@ -31,25 +32,13 @@ def mesh(request, devices8):
     )
 
 
-def sharded_params(model, params):
-    """Place params per their Partitioned metadata on the global mesh."""
-    mesh = get_mesh()
-    specs = nn.get_partition_spec(params)
-    unboxed = nn.unbox(params)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        unboxed,
-        specs,
-        is_leaf=lambda x: isinstance(x, P) or not isinstance(x, dict),
-    )
-
 
 def test_column_parallel_matches_dense(mesh):
     B, S, H, O = 2, 8, 16, 32
     x = jax.random.normal(jax.random.PRNGKey(0), (B, S, H), dtype=jnp.float32)
     layer = ColumnParallelLinear(features=O, gather_output=True, dtype=jnp.float32)
     params = layer.init(jax.random.PRNGKey(1), x)
-    p = sharded_params(layer, params)
+    p = sharded_params(params)
 
     @jax.jit
     def fwd(p, x):
@@ -81,7 +70,7 @@ def test_row_parallel_matches_dense(mesh):
     x = jax.random.normal(jax.random.PRNGKey(0), (B, S, H), dtype=jnp.float32)
     layer = RowParallelLinear(features=O, input_is_parallel=False, dtype=jnp.float32)
     params = layer.init(jax.random.PRNGKey(1), x)
-    p = sharded_params(layer, params)
+    p = sharded_params(params)
 
     @jax.jit
     def fwd(p, x):
@@ -112,7 +101,7 @@ def test_column_row_mlp_with_sequence_parallel(mesh):
 
     model = TPMLP()
     params = model.init(jax.random.PRNGKey(1), x)
-    p = sharded_params(model, params)
+    p = sharded_params(params)
     w1 = np.asarray(nn.unbox(params)["params"]["ColumnParallelLinear_0"]["kernel"])
     w2 = np.asarray(nn.unbox(params)["params"]["RowParallelLinear_0"]["kernel"])
 
@@ -160,7 +149,7 @@ def test_fused_column_parallel(mesh):
     x = jax.random.normal(jax.random.PRNGKey(0), (B, S, H), dtype=jnp.float32)
     layer = ColumnParallelLinear(features=2 * I, n_fused=2, use_bias=False, dtype=jnp.float32)
     params = layer.init(jax.random.PRNGKey(1), x)
-    p = sharded_params(layer, params)
+    p = sharded_params(params)
 
     @jax.jit
     def fwd(p, x):
@@ -178,7 +167,7 @@ def test_parallel_embedding_matches_dense(mesh):
     ids = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, V)
     layer = ParallelEmbedding(num_embeddings=V, features=H, dtype=jnp.float32)
     params = layer.init(jax.random.PRNGKey(1), ids)
-    p = sharded_params(layer, params)
+    p = sharded_params(params)
 
     @jax.jit
     def fwd(p, ids):
