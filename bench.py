@@ -1,0 +1,117 @@
+"""Benchmark: Llama pretrain throughput on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no absolute numbers (BASELINE.md), so ``vs_baseline``
+is measured against the north-star target of 35% MFU (BASELINE.json): a value
+of 1.0 means exactly 35% MFU on this chip; >1 beats the target.
+
+Model: Llama-shaped decoder sized to fit a single v5e chip's 16 GB HBM for
+full training (fp32 master params + fp32 Adam states + bf16 compute), seq
+2048 — the single-chip slice of the Llama-2-7B TP=8 pretrain config
+(tp_zero1_llama2_7b_hf_pretrain.sh:19-36 in the reference).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+# v5e (lite) peak bf16 FLOPs per chip
+PEAK_FLOPS = {
+    "tpu v5 lite": 197e12,
+    "tpu v5e": 197e12,
+    "tpu v5": 459e12,  # v5p
+    "tpu v4": 275e12,
+    "tpu v6 lite": 918e12,
+    "cpu": 1e12,  # nominal, for smoke runs
+}
+
+
+def peak_flops_for(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for k, v in PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return 197e12
+
+
+def main():
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        causal_lm_loss,
+    )
+    from neuronx_distributed_tpu.trainer import (
+        default_batch_spec,
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        make_train_step,
+        transformer_flops_per_token,
+        mfu,
+    )
+
+    devices = jax.devices()
+    n = len(devices)
+    on_tpu = devices[0].platform != "cpu"
+
+    if on_tpu:
+        # ~400M-param Llama slice: 7B's hidden/4 layout, seq 2048
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_layers=12, num_heads=12, num_kv_heads=12, head_dim=128,
+            max_seq_len=2048, sequence_parallel=n > 1, remat="selective",
+        )
+        batch, seq, steps, warmup = 2, 2048, 10, 3
+    else:  # CPU smoke mode
+        cfg = LlamaConfig.tiny(sequence_parallel=False, remat="none")
+        batch, seq, steps, warmup = 2, 64, 3, 1
+
+    tp = n if n > 1 else 1
+    nxd.initialize_model_parallel(tensor_parallel_size=tp, devices=devices)
+    config = nxd.training_config(tensor_parallel_size=tp, learning_rate=1e-4)
+
+    model = initialize_parallel_model(
+        config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, seq), jnp.int32),)
+    )
+    opt = initialize_parallel_optimizer(config, model)
+    step = make_train_step(
+        config, model, opt, causal_lm_loss,
+        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()},
+    )
+
+    ids = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab_size)
+    data = {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+    params, state = model.params, opt.state
+
+    for i in range(warmup):
+        params, state, m = step(params, state, data, jax.random.PRNGKey(i))
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, state, m = step(params, state, data, jax.random.PRNGKey(i))
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    tokens_per_sec_per_chip = tokens_per_sec / n
+    fpt = transformer_flops_per_token(
+        cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+        seq, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_,
+    )
+    achieved_mfu = mfu(tokens_per_sec_per_chip, fpt, peak_flops_for(devices[0]))
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 2),
+        "unit": f"tokens/s/chip (mfu={achieved_mfu:.3f}, model={model.num_parameters()/1e6:.0f}M, seq={seq})",
+        "vs_baseline": round(achieved_mfu / 0.35, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,337 @@
+"""Llama model family, TPU-native.
+
+Re-design of the reference's NxD Llama port
+(``examples/training/llama2/modeling_llama_nxd.py``, 734 LoC) around the
+framework's GSPMD layers:
+
+- fused gate-up ColumnParallel (reference stride=2 ``:142-150``) via
+  ``n_fused=2``;
+- GQA QKV through :class:`GQAQKVColumnParallelLinear` (reference ``:246-265``)
+  with the kvr/tp sub-axis sharding replacing KV-group replication;
+- Megatron-SP residual stream: outside attention/MLP the activations are
+  sequence-sharded (reference ``[seq, batch, hidden]`` handling
+  ``:319-321,349-352,530-532``; here ``[batch, seq, hidden]`` with a seq-dim
+  sharding constraint);
+- vocab-parallel loss (reference ``:691-699``) via
+  :func:`parallel_cross_entropy`;
+- selective activation checkpointing of the attention core + MLP (reference
+  ``:184-214``) via ``jax.checkpoint`` on those submodule calls;
+- RoPE computed in fp32 (reference shares sin/cos across layers for CSE,
+  ``tp_zero1_llama2_7b_hf_pretrain.py:226-242`` — XLA CSEs the shared
+  computation automatically under one jit);
+- optional KV cache plumbing for the inference engine (reference splits
+  context-encoding vs token-generation models,
+  ``examples/inference/llama2/neuron_modeling_llama.py:292-342``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+    RowParallelLinear,
+    shard_activation,
+    trailing_spec,
+)
+from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy
+from neuronx_distributed_tpu.parallel.mesh import (
+    BATCH_AXES,
+    KV_REPLICA_AXIS,
+    SEQUENCE_AXES,
+    TENSOR_AXIS,
+)
+from neuronx_distributed_tpu.parallel.norm import RMSNorm
+from neuronx_distributed_tpu.parallel.qkv import GQAQKVColumnParallelLinear, Q_HEAD_AXES
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    sequence_parallel: bool = True
+    remat: str = "selective"  # none | selective | full
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @staticmethod
+    def llama2_7b(**overrides) -> "LlamaConfig":
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_layers=32, num_heads=32, num_kv_heads=32), **overrides})
+
+    @staticmethod
+    def llama2_13b(**overrides) -> "LlamaConfig":
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+            num_layers=40, num_heads=40, num_kv_heads=40), **overrides})
+
+    @staticmethod
+    def llama2_70b(**overrides) -> "LlamaConfig":
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, hidden_size=8192, intermediate_size=28672,
+            num_layers=80, num_heads=64, num_kv_heads=8), **overrides})
+
+    @staticmethod
+    def llama3_8b(**overrides) -> "LlamaConfig":
+        return LlamaConfig(**{**dict(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0), **overrides})
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        """Test-scale config (the reference's 4-layer combinatorial config)."""
+        return LlamaConfig(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=8, num_kv_heads=8, max_seq_len=128), **overrides})
+
+
+def rope_sin_cos(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    """RoPE tables in fp32 for the given positions ``[...s]`` →
+    ``(sin, cos)`` of shape ``[..., s, head_dim/2]``."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    """Rotate-half RoPE (HF Llama convention) in fp32; ``x`` is
+    ``[B, S, n, d]``, sin/cos ``[B, S, d/2]``."""
+    d2 = x.shape[-1] // 2
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :d2], xf[..., d2:]
+    sin = sin[..., None, :]  # broadcast over heads
+    cos = cos[..., None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _causal_mask(q_len: int, kv_len: int, q_offset) -> jax.Array:
+    """Boolean [q_len, kv_len] mask, True = attend; q position i (global
+    ``i + q_offset``) attends kv positions <= its own."""
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return kv_pos <= q_pos
+
+
+class CoreAttention(nn.Module):
+    """Grouped (GQA) causal attention core — the reference's ``CoreAttention``
+    (``modeling_llama_nxd.py:193-214``), expressed so the kv-head dim shards
+    over 'tp' and the q-per-kv group dim over 'kvr' with no collective."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, q, k, v, q_offset=0):
+        cfg = self.config
+        B, S, NQ, D = q.shape
+        T = k.shape[1]
+        NKV = k.shape[2]
+        G = NQ // NKV
+        qg = q.reshape(B, S, NKV, G, D)
+        qg = shard_activation(qg, P(P.UNCONSTRAINED, None, TENSOR_AXIS, KV_REPLICA_AXIS, None))
+        # fp32 softmax (explicit-dtype replacement for the reference's
+        # double-means-fp32 trick, modeling_llama_nxd.py:211)
+        scores = jnp.einsum("bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(D).astype(jnp.float32)
+        mask = _causal_mask(S, T, q_offset)
+        scores = jnp.where(mask[None, None, None], scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bkgst,btkd->bskgd", probs, v, preferred_element_type=q.dtype)
+        return out.reshape(B, S, NQ, D)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, kv_cache=None, cache_offset=0):
+        cfg = self.config
+        D = cfg.head_dim_
+        q, k, v = GQAQKVColumnParallelLinear(
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=D,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="qkv",
+        )(x)
+        sin, cos = rope_sin_cos(positions, D, cfg.rope_theta)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+
+        new_cache = None
+        if kv_cache is not None:
+            # decode: write new k/v at cache_offset, attend over the cache
+            ck, cv = kv_cache
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_offset, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_offset, axis=1)
+            new_cache = (ck, cv)
+            k, v = ck, cv
+
+        # rematerialization is applied at block granularity in LlamaModel
+        out = CoreAttention(cfg, name="core")(q, k, v, cache_offset if kv_cache is not None else 0)
+
+        B, S = x.shape[0], q.shape[1]
+        out = out.reshape(B, S, cfg.num_heads * D)
+        out = RowParallelLinear(
+            features=cfg.hidden_size,
+            use_bias=False,
+            sequence_parallel=cfg.sequence_parallel,
+            input_partition_axes=Q_HEAD_AXES,  # attention out is in q-head order
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="o_proj",
+        )(out)
+        return out, new_cache
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate_up = ColumnParallelLinear(
+            features=2 * cfg.intermediate_size,
+            n_fused=2,  # reference fused gate-up stride=2
+            use_bias=False,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="gate_up",
+        )(x)
+        gate, up = gate_up[..., 0, :], gate_up[..., 1, :]
+        h = jax.nn.silu(gate) * up
+        return RowParallelLinear(
+            features=cfg.hidden_size,
+            use_bias=False,
+            sequence_parallel=cfg.sequence_parallel,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="down",
+        )(h)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, kv_cache=None, cache_offset=0):
+        cfg = self.config
+        h, new_cache = LlamaAttention(cfg, name="attn")(
+            RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    name="input_norm")(x),
+            positions, kv_cache, cache_offset,
+        )
+        x = x + h
+        h = LlamaMLP(cfg, name="mlp")(
+            RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    name="post_attn_norm")(x)
+        )
+        x = x + h
+        if cfg.sequence_parallel:
+            # residual stream lives sequence-sharded between blocks
+            x = shard_activation(x, trailing_spec(x.ndim, seq=SEQUENCE_AXES, last=None))
+        return x, new_cache
+
+
+class LlamaModel(nn.Module):
+    """Decoder stack without the LM head (reference ``LlamaModel``)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0):
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+        h = ParallelEmbedding(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            sequence_parallel_output=cfg.sequence_parallel,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="embed",
+        )(ids)
+
+        block_cls = LlamaBlock
+        if cfg.remat in ("selective", "full"):
+            # 'full' recomputes everything in bwd; 'selective' saves the
+            # matmul outputs inside the block (the XLA analogue of the
+            # reference checkpointing CoreAttention+MLP only).
+            policy = (
+                None
+                if cfg.remat == "full"
+                else jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+            )
+            block_cls = nn.remat(LlamaBlock, policy=policy, prevent_cse=False)
+
+        new_caches = []
+        for i in range(cfg.num_layers):
+            cache = kv_caches[i] if kv_caches is not None else None
+            if kv_caches is not None:
+                h, c = LlamaBlock(cfg, name=f"layer_{i}")(h, positions, cache, cache_offset)
+            else:
+                h, c = block_cls(cfg, name=f"layer_{i}")(h, positions, None, 0)
+            new_caches.append(c)
+        h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="final_norm")(h)
+        return (h, new_caches) if kv_caches is not None else (h, None)
+
+
+class LlamaForCausalLM(nn.Module):
+    """Full causal LM with vocab-parallel head (reference
+    ``LlamaForCausalLM``, loss at ``modeling_llama_nxd.py:681-699``)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0):
+        cfg = self.config
+        h, new_caches = LlamaModel(cfg, name="model")(ids, positions, kv_caches, cache_offset)
+        if cfg.sequence_parallel and kv_caches is None:
+            # gather the sequence back before the (batched) head matmul
+            h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))
+        logits = ColumnParallelLinear(
+            features=cfg.vocab_size,
+            use_bias=False,
+            gather_output=False,  # keep vocab-sharded for the parallel loss
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="lm_head",
+        )(h)
+        return (logits, new_caches) if kv_caches is not None else logits
+
+
+def causal_lm_loss(module: LlamaForCausalLM, params, batch, rng=None) -> jax.Array:
+    """Next-token loss with masking; batch = {ids, labels[, mask]}.
+
+    Labels < 0 (ignore convention) are masked out of the mean."""
+    logits = module.apply(params, batch["ids"])
+    labels = batch["labels"]
+    per_tok = parallel_cross_entropy(logits, labels)
+    mask = batch.get("mask")
+    if mask is None:
+        mask = (labels >= 0).astype(jnp.float32)
+    else:
+        mask = mask.astype(jnp.float32) * (labels >= 0)
+    return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)

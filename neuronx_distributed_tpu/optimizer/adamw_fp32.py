@@ -1,0 +1,39 @@
+"""AdamW with fp32 optimizer states regardless of compute precision.
+
+The reference keeps exp_avg in "fp64-under-XLA_DOWNCAST_BF16" so states stay
+fp32 when the whole program is downcast
+(``utils/adamw_fp32_optim_params.py:81-116``).  The TPU build uses explicit
+dtypes instead (SURVEY §7 hard-part 5): params are fp32 masters, modules cast
+to bf16 for compute, and the optimizer pins both moments to fp32 — no global
+downcast flag, no double-means-fp32 tricks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import optax
+
+
+def adamw_fp32(
+    learning_rate: Union[float, optax.Schedule],
+    b1: float = 0.9,
+    b2: float = 0.95,
+    eps: float = 1e-8,
+    weight_decay: float = 0.01,
+    mask: Optional[object] = None,
+) -> optax.GradientTransformation:
+    """AdamW whose first moment is pinned to fp32 (``mu_dtype``); the second
+    moment follows the (fp32 master) param dtype.  Betas default to the
+    reference Llama recipe (``tp_zero1_llama2_7b_hf_pretrain.py`` optimizer
+    args)."""
+    return optax.adamw(
+        learning_rate=learning_rate,
+        b1=b1,
+        b2=b2,
+        eps=eps,
+        weight_decay=weight_decay,
+        mu_dtype=jnp.float32,
+        mask=mask,
+    )

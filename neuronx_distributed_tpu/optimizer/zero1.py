@@ -1,0 +1,121 @@
+"""ZeRO-1 optimizer-state sharding over the data-parallel mesh axes.
+
+TPU-native re-design of ``NeuronZero1Optimizer``
+(``optimizer/zero_redundancy_optimizer.py:24-80``, whose shard/step/gather
+machinery lives inside torch-xla).  On a GSPMD mesh, ZeRO-1 is not a new
+optimizer — it is a *placement policy*: optimizer-state leaves that mirror a
+parameter get that parameter's PartitionSpec with the data-parallel axes
+prepended onto the first evenly-divisible unsharded dim.  The jitted update
+then computes each state shard on its dp-owner and XLA inserts the
+reduce-scatter(grad) / all-gather(param-delta) pair that torch-xla's ZeRO
+implements by hand — same math, same communication volume.
+
+Use :func:`optimizer_state_specs` to derive the state sharding pytree and
+feed it to ``jax.jit``'s in/out shardings (the trainer does this
+automatically; ``trainer/trainer.py`` here).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel.mesh import BATCH_AXES, get_mesh
+
+
+def _spec_entries(spec: Optional[P], ndim: int):
+    entries = list(spec) if spec is not None else []
+    entries += [None] * (ndim - len(entries))
+    return entries
+
+
+def _axes_of(entry) -> tuple:
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def zero1_spec(spec: Optional[P], shape: tuple, mesh: Optional[Mesh] = None) -> P:
+    """Extend a param's PartitionSpec with the dp axes for its optimizer state.
+
+    Picks the first dim whose size is divisible by ``dp * existing-sharding``
+    and prepends the data-parallel axes there (dp-major, so each dp rank owns
+    a contiguous state shard — the analogue of torch-xla ZeRO's contiguous
+    per-rank shards).  Falls back to the unmodified spec (replicated states)
+    for params too small to split, like biases and norm weights.
+    """
+    mesh = mesh if mesh is not None else get_mesh()
+    dp = math.prod(mesh.shape[a] for a in BATCH_AXES)
+    if dp == 1:
+        return spec if spec is not None else P()
+    entries = _spec_entries(spec, len(shape))
+    for i, (dim, entry) in enumerate(zip(shape, entries)):
+        axes = _axes_of(entry)
+        if any(a in BATCH_AXES for a in axes):
+            return P(*entries)  # already dp-sharded somehow; leave alone
+        existing = math.prod(mesh.shape[a] for a in axes) if axes else 1
+        if dim % (dp * existing) == 0:
+            entries[i] = tuple(BATCH_AXES) + axes
+            return P(*entries)
+    return P(*entries)
+
+
+def _params_path_map(params, param_specs):
+    flat_specs = jax.tree_util.tree_flatten_with_path(param_specs,
+                                                      is_leaf=lambda x: isinstance(x, P))[0]
+    flat_params = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = {}
+    for (path_s, spec), (path_p, value) in zip(flat_specs, flat_params):
+        key = tuple(str(k) for k in path_p)
+        out[key] = (spec, np.shape(value))
+    return out
+
+
+def optimizer_state_specs(
+    opt_state: Any,
+    params: Any,
+    param_specs: Any,
+    zero1: bool = True,
+    mesh: Optional[Mesh] = None,
+) -> Any:
+    """Derive a PartitionSpec pytree for an optax optimizer state.
+
+    State leaves whose tree path ends with a parameter's path (e.g. Adam's
+    ``mu``/``nu`` mirror the params tree) get that parameter's spec —
+    dp-extended when ``zero1`` — while scalar leaves (step counts) are
+    replicated."""
+    mesh = mesh if mesh is not None else get_mesh()
+    path_map = _params_path_map(params, param_specs)
+    max_suffix = max((len(k) for k in path_map), default=0)
+
+    def spec_for(path, leaf) -> P:
+        key = tuple(str(k) for k in path)
+        for take in range(min(len(key), max_suffix), 0, -1):
+            hit = path_map.get(key[-take:])
+            if hit is not None:
+                spec, shape = hit
+                if np.shape(leaf) != shape:
+                    continue  # same name, different tensor (defensive)
+                return zero1_spec(spec, shape, mesh) if zero1 else (spec or P())
+        return P()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    return jax.tree_util.tree_unflatten(treedef, [spec_for(p, l) for p, l in flat])
+
+
+def shard_optimizer_state(opt_state, specs, mesh: Optional[Mesh] = None):
+    """device_put the state per the derived specs (host-side placement)."""
+    mesh = mesh if mesh is not None else get_mesh()
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        opt_state,
+        specs,
+        is_leaf=lambda x: not isinstance(x, (dict, tuple, list)),
+    )

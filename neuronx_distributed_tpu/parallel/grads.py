@@ -1,0 +1,79 @@
+"""Gradient norm / clipping utilities.
+
+TPU-native counterpart of the reference's ``parallel_layers/grads.py``:
+
+- ``get_grad_norm`` / ``clip_grad_norm`` (reference ``:29-190``): the
+  reference spends most of its code classifying params into TP-duplicated vs
+  TP-sharded vs PP-shared so each rank can correct its local partial norm
+  (including a ``force_spmd`` mode that keeps every rank's graph identical,
+  ``:103-129``).  Under GSPMD none of that exists: gradient pytrees are
+  *logically global* arrays, so the norm is a plain reduction and XLA derives
+  the cross-shard collectives from the shardings — every rank's graph is
+  identical by construction.
+
+- ``bucket_allreduce_gradients`` (reference ``:193-246``, reverse-order
+  512 MB dtype-grouped buckets over the DP mesh): unnecessary here — data
+  parallelism is the ``dp`` sharding of the batch dim, so the gradient psum
+  over DP is inserted by autodiff/GSPMD inside the one jitted train step, and
+  XLA's scheduler handles fusion/overlap of those collectives.
+
+- ``allreduce_sequence_parallel_gradients`` (reference ``:249-264``): also
+  unnecessary — norm/bias weights in SP regions are replicated params whose
+  grad psum autodiff already emits.
+
+The explicit shard_map path gets :func:`psum_over_data_parallel` for parity
+with the reference's DP reduction when a user writes manual per-rank steps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from neuronx_distributed_tpu.parallel.mesh import BATCH_AXES
+
+
+def get_grad_norm(grads, norm_type: float = 2.0) -> jax.Array:
+    """Global norm over a gradient pytree, accumulated in fp32
+    (reference ``grads.py:29-138``)."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    if norm_type == 2.0:
+        return jnp.sqrt(
+            sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        )
+    if norm_type == float("inf"):
+        return jnp.max(
+            jnp.stack([jnp.max(jnp.abs(g.astype(jnp.float32))) for g in leaves])
+        )
+    return (
+        sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type) for g in leaves)
+    ) ** (1.0 / norm_type)
+
+
+def clip_grad_norm(
+    grads, max_norm: float, norm_type: float = 2.0, eps: float = 1e-6
+) -> Tuple[jax.Array, jax.Array]:
+    """Scale ``grads`` so their global norm is at most ``max_norm``; returns
+    ``(clipped_grads, pre_clip_norm)`` (reference ``grads.py:141-190``,
+    torch-style ``clip_coeff = max_norm / (norm + eps)`` capped at 1)."""
+    norm = get_grad_norm(grads, norm_type)
+    clip_coeff = jnp.minimum(max_norm / (norm + eps), 1.0)
+    clipped = jax.tree.map(lambda g: (g.astype(jnp.float32) * clip_coeff).astype(g.dtype), grads)
+    return clipped, norm
+
+
+def psum_over_data_parallel(grads, mean: bool = True):
+    """Explicit DP gradient reduction for shard_map training steps
+    (the conjugate of the reference's ``bucket_allreduce_gradients``)."""
+    n = 1
+    for a in BATCH_AXES:
+        n *= lax.axis_size(a)
+    reduced = jax.tree.map(lambda g: lax.psum(g, BATCH_AXES), grads)
+    if mean:
+        reduced = jax.tree.map(lambda g: g / n, reduced)
+    return reduced

@@ -167,6 +167,10 @@ class RowParallelLinear(nn.Module):
     use_bias: bool = True
     input_is_parallel: bool = True
     sequence_parallel: bool = False
+    # Sub-axis order of the sharded input dim.  Attention outputs arrive in
+    # q-head order — sharded ('tp','kvr') — so the o_proj sets this to match
+    # and no resharding happens between attention and projection.
+    input_partition_axes: tuple = TENSOR_AXES
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     kernel_init: Initializer = nn.initializers.lecun_normal()
@@ -177,13 +181,13 @@ class RowParallelLinear(nn.Module):
         in_features = x.shape[-1]
         kernel = self.param(
             "kernel",
-            nn.with_partitioning(self.kernel_init, (TENSOR_AXES, None)),
+            nn.with_partitioning(self.kernel_init, (self.input_partition_axes, None)),
             (in_features, self.features),
             self.param_dtype,
         )
         x = x.astype(self.dtype)
         if self.input_is_parallel:
-            x = shard_activation(x, trailing_spec(x.ndim, last=TENSOR_AXES))
+            x = shard_activation(x, trailing_spec(x.ndim, last=self.input_partition_axes))
         y = jax.lax.dot_general(
             x,
             jnp.asarray(kernel, self.dtype),

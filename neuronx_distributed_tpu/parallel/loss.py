@@ -20,12 +20,11 @@ Two paths:
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_tpu.parallel.mappings import AxisNames, axis_rank, axis_size, resolve_axes as _axes
 from neuronx_distributed_tpu.parallel.layers import shard_activation, trailing_spec

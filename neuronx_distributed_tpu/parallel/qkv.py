@@ -29,11 +29,11 @@ from typing import Any, Callable, Tuple
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import PartitionSpec as P
 
-from neuronx_distributed_tpu.parallel.layers import shard_activation
+from neuronx_distributed_tpu.parallel.layers import shard_activation, trailing_spec
 from neuronx_distributed_tpu.parallel.mesh import (
     KV_REPLICA_AXIS,
+    SEQUENCE_AXES,
     TENSOR_AXIS,
     get_kv_size_multiplier,
     get_tensor_parallel_size,
@@ -113,18 +113,13 @@ class GQAQKVColumnParallelLinear(nn.Module):
 
         x = x.astype(self.dtype)
         if self.sequence_parallel:
-            from neuronx_distributed_tpu.parallel.mesh import SEQUENCE_AXES
-
-            spec = [P.UNCONSTRAINED] * x.ndim
-            spec[-2] = SEQUENCE_AXES
-            x = shard_activation(x, P(*spec))
+            x = shard_activation(x, trailing_spec(x.ndim, seq=SEQUENCE_AXES))
 
         def proj(w, head_axes):
             y = jnp.einsum("...h,hnd->...nd", x, jnp.asarray(w, self.dtype),
                            preferred_element_type=self.dtype)
-            spec = [P.UNCONSTRAINED] * y.ndim
-            spec[-2] = head_axes
-            return shard_activation(y, P(*spec))
+            # head dim sits at -2 ([..., n_heads, head_dim])
+            return shard_activation(y, trailing_spec(y.ndim, seq=head_axes))
 
         q = proj(wq, Q_HEAD_AXES)
         k = proj(wk, KV_HEAD_AXES)

@@ -1,0 +1,38 @@
+"""Trainer facade (reference ``trainer/`` — nxd_config, initialize_parallel_model,
+initialize_parallel_optimizer, save/load_checkpoint)."""
+
+from neuronx_distributed_tpu.trainer.checkpoint import (
+    load_checkpoint,
+    newest_tag,
+    save_checkpoint,
+)
+from neuronx_distributed_tpu.trainer.metrics import (
+    Throughput,
+    TrainingMetrics,
+    mfu,
+    transformer_flops_per_token,
+)
+from neuronx_distributed_tpu.trainer.trainer import (
+    ParallelModel,
+    ParallelOptimizer,
+    default_batch_spec,
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "ParallelModel",
+    "ParallelOptimizer",
+    "initialize_parallel_model",
+    "initialize_parallel_optimizer",
+    "make_train_step",
+    "default_batch_spec",
+    "save_checkpoint",
+    "load_checkpoint",
+    "newest_tag",
+    "Throughput",
+    "TrainingMetrics",
+    "mfu",
+    "transformer_flops_per_token",
+]

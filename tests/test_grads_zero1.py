@@ -1,0 +1,124 @@
+"""Grad clipping + ZeRO-1 state-sharding tests (reference:
+``test/integration/parallel_layers/`` grads tests + torch-xla ZeRO parity,
+SURVEY §7 hard-part 2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from conftest import sharded_params
+from neuronx_distributed_tpu.optimizer.adamw_fp32 import adamw_fp32
+from neuronx_distributed_tpu.optimizer.zero1 import (
+    optimizer_state_specs,
+    shard_optimizer_state,
+    zero1_spec,
+)
+from neuronx_distributed_tpu.parallel.grads import clip_grad_norm, get_grad_norm
+from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear, RowParallelLinear
+from neuronx_distributed_tpu.parallel.mesh import (
+    TENSOR_AXES,
+    get_mesh,
+    initialize_model_parallel,
+)
+
+
+def test_clip_grad_norm_math():
+    grads = {"a": jnp.full((4,), 3.0), "b": jnp.full((3,), 4.0)}
+    norm = float(get_grad_norm(grads))
+    assert norm == pytest.approx(np.sqrt(4 * 9 + 3 * 16))
+    clipped, pre = clip_grad_norm(grads, max_norm=1.0)
+    assert float(pre) == pytest.approx(norm)
+    assert float(get_grad_norm(clipped)) == pytest.approx(1.0, rel=1e-4)
+    # below the cap: untouched
+    clipped2, _ = clip_grad_norm(grads, max_norm=100.0)
+    np.testing.assert_allclose(np.asarray(clipped2["a"]), np.asarray(grads["a"]), rtol=1e-6)
+
+
+def test_clip_preserves_dtype():
+    grads = {"a": jnp.ones((4,), jnp.bfloat16) * 100}
+    clipped, _ = clip_grad_norm(grads, 1.0)
+    assert clipped["a"].dtype == jnp.bfloat16
+
+
+def test_zero1_spec_derivation(devices8):
+    initialize_model_parallel(tensor_parallel_size=2, devices=devices8)  # dp=4
+    mesh = get_mesh()
+    # column-parallel kernel [H, O] spec (None, T): rows get dp
+    s = zero1_spec(P(None, ("kvr", "tp")), (16, 32), mesh)
+    assert s == P(("dp", "ep"), ("kvr", "tp"))
+    # row-parallel kernel [H, O] spec (T, None): dim0 sharded by tp → dim0
+    # also divisible by dp*tp? 16 % (4*2) == 0 → dp joins dim 0
+    s = zero1_spec(P(("kvr", "tp"), None), (16, 32), mesh)
+    assert s == P(("dp", "ep", "kvr", "tp"), None)
+    # tiny bias: replicated states
+    s = zero1_spec(P(None), (3,), mesh)
+    assert s == P(None)
+
+
+def test_zero1_matches_unsharded_adamw(devices8):
+    """The ZeRO-1 invariant: sharded-state AdamW must produce bitwise-same
+    (to fp tolerance) params as replicated-state AdamW."""
+    mesh = initialize_model_parallel(tensor_parallel_size=2, devices=devices8)
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = ColumnParallelLinear(features=64, use_bias=False, dtype=jnp.float32)(x)
+            h = nn.gelu(h)
+            return RowParallelLinear(features=16, use_bias=False, dtype=jnp.float32)(h)
+
+    model = MLP()
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 4, 16), dtype=jnp.float32)
+    y = jax.random.normal(jax.random.PRNGKey(1), (8, 4, 16), dtype=jnp.float32)
+    params0 = model.init(jax.random.PRNGKey(2), x)
+    param_specs = nn.get_partition_spec(params0)
+    p = sharded_params(params0)
+
+    tx = adamw_fp32(1e-2)
+    opt_state = tx.init(p)
+    specs = optimizer_state_specs(opt_state, p, param_specs, zero1=True, mesh=mesh)
+    opt_state_z = shard_optimizer_state(opt_state, specs, mesh)
+
+    # mu leaf for the column kernel must be physically dp-sharded
+    mu = opt_state_z[0].mu["params"]["ColumnParallelLinear_0"]["kernel"]
+    shard = mu.addressable_shards[0].data
+    assert shard.shape[0] == 16 // 4  # rows split over dp=4
+
+    def loss_fn(p):
+        out = model.apply(p, x)
+        return jnp.mean((out - y) ** 2)
+
+    @jax.jit
+    def step(p, s):
+        g = jax.grad(loss_fn)(p)
+        updates, s = tx.update(g, s, p)
+        return optax.apply_updates(p, updates), s
+
+    p_z, s_z = step(p, opt_state_z)
+    p_r, s_r = step(p, opt_state)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        p_z,
+        p_r,
+    )
+    # run a few more steps under ZeRO sharding; loss must decrease
+    l0 = float(loss_fn(p_z))
+    for _ in range(5):
+        p_z, s_z = step(p_z, s_z)
+    assert float(loss_fn(p_z)) < l0
+
+
+def test_optimizer_state_specs_scalar_leaves(devices8):
+    initialize_model_parallel(tensor_parallel_size=2, devices=devices8)
+    params = {"params": {"w": jnp.zeros((16, 8))}}
+    param_specs = {"params": {"w": P(None, ("kvr", "tp"))}}
+    tx = adamw_fp32(1e-3)
+    state = tx.init(params)
+    specs = optimizer_state_specs(state, params, param_specs, zero1=True)
+    assert specs[0].count == P()
+    assert specs[0].mu["params"]["w"] == P(("dp", "ep"), ("kvr", "tp"))

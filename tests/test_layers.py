@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from flax import linen as nn
-from jax.sharding import NamedSharding, PartitionSpec as P
+
 
 from neuronx_distributed_tpu.parallel.layers import (
     ColumnParallelLinear,
@@ -17,10 +17,7 @@ from neuronx_distributed_tpu.parallel.layers import (
 )
 from conftest import sharded_params
 from neuronx_distributed_tpu.parallel.norm import LayerNorm, RMSNorm
-from neuronx_distributed_tpu.parallel.mesh import (
-    get_mesh,
-    initialize_model_parallel,
-)
+from neuronx_distributed_tpu.parallel.mesh import initialize_model_parallel
 
 
 @pytest.fixture(params=[dict(tp=8, kv=1), dict(tp=4, kv=1), dict(tp=8, kv=2)], ids=["tp8", "tp4dp2", "tp8kv2"])

@@ -7,12 +7,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from flax import linen as nn
-from jax.sharding import NamedSharding, PartitionSpec as P
 
-from neuronx_distributed_tpu.parallel.mesh import (
-    get_mesh,
-    initialize_model_parallel,
-)
+
+from neuronx_distributed_tpu.parallel.mesh import initialize_model_parallel
 from neuronx_distributed_tpu.parallel.qkv import GQAQKVColumnParallelLinear
 from conftest import sharded_params
 

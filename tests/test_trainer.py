@@ -1,0 +1,134 @@
+"""Trainer facade + checkpoint tests: sharded init, jitted train step with
+ZeRO-1, loss decrease, save/load/rotate/resume (reference:
+``trainer/`` + ``test/integration`` checkpoint tests)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+    RowParallelLinear,
+)
+from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy
+from neuronx_distributed_tpu.trainer import (
+    default_batch_spec,
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    load_checkpoint,
+    make_train_step,
+    newest_tag,
+    save_checkpoint,
+)
+
+
+class TinyLM(nn.Module):
+    vocab: int = 64
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, ids):
+        h = ParallelEmbedding(num_embeddings=self.vocab, features=self.hidden, dtype=jnp.float32)(ids)
+        h = ColumnParallelLinear(features=64, use_bias=False, dtype=jnp.float32)(h)
+        h = nn.gelu(h)
+        h = RowParallelLinear(features=self.hidden, use_bias=False, dtype=jnp.float32)(h)
+        logits = ColumnParallelLinear(features=self.vocab, use_bias=False, gather_output=False, dtype=jnp.float32)(h)
+        return logits
+
+
+def lm_loss(module, params, batch, rng):
+    logits = module.apply(params, batch["ids"])
+    return jnp.mean(parallel_cross_entropy(logits, batch["labels"]))
+
+
+@pytest.fixture
+def config(devices8):
+    return nxd.training_config(tensor_parallel_size=2, learning_rate=5e-3)
+
+
+def _data(key, n=16, s=8, vocab=64):
+    ids = jax.random.randint(key, (n, s), 0, vocab)
+    return {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+
+
+def test_sharded_init_and_train_step(config):
+    model = initialize_parallel_model(config, TinyLM, (jnp.zeros((1, 8), jnp.int32),))
+    # params physically sharded per their specs
+    k = model.params["params"]["ColumnParallelLinear_0"]["kernel"]
+    assert len(k.addressable_shards) == 8
+    assert k.addressable_shards[0].data.shape == (32, 32)  # cols over tp=2
+
+    opt = initialize_parallel_optimizer(config, model)
+    # ZeRO-1: adam mu sharded over dp on dim 0
+    mu = opt.state[0].mu["params"]["ColumnParallelLinear_0"]["kernel"]
+    assert mu.addressable_shards[0].data.shape[0] == 32 // 4  # dp=4
+
+    step = make_train_step(
+        config, model, opt, lm_loss,
+        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()},
+    )
+    params, state = model.params, opt.state
+    losses = []
+    for i in range(10):
+        batch = _data(jax.random.PRNGKey(i))
+        params, state, metrics = step(params, state, batch, jax.random.PRNGKey(i))
+        losses.append(float(metrics["loss"]))
+        assert np.isfinite(metrics["grad_norm"])
+    assert losses[-1] < losses[0], losses
+
+
+def test_checkpoint_roundtrip_and_rotation(config, tmp_path):
+    model = initialize_parallel_model(config, TinyLM, (jnp.zeros((1, 8), jnp.int32),))
+    opt = initialize_parallel_optimizer(config, model)
+    ckpt_dir = str(tmp_path / "ckpts")
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    for i in range(4):
+        save_checkpoint(
+            ckpt_dir, f"step_{i}", model.params, opt.state,
+            user_content={"step": i}, num_kept_ckpts=2,
+        )
+    assert newest_tag(ckpt_dir) == "step_3"
+    kept = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("step_"))
+    assert kept == ["step_2", "step_3"]
+
+    restored, opt_restored, sched, user = load_checkpoint(
+        ckpt_dir, model_template=model.params, optimizer_template=opt.state
+    )
+    assert user == {"step": 3}
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored, model.params,
+    )
+    # restored arrays carry the template shardings (re-sharded to live mesh)
+    k = restored["params"]["ColumnParallelLinear_0"]["kernel"]
+    assert k.sharding == model.params["params"]["ColumnParallelLinear_0"]["kernel"].sharding
+
+
+def test_resume_training_continues(config, tmp_path):
+    model = initialize_parallel_model(config, TinyLM, (jnp.zeros((1, 8), jnp.int32),))
+    opt = initialize_parallel_optimizer(config, model)
+    step = make_train_step(config, model, opt, lm_loss)
+    params, state = model.params, opt.state
+    for i in range(3):
+        params, state, m = step(params, state, _data(jax.random.PRNGKey(i)), jax.random.PRNGKey(i))
+
+    ckpt_dir = str(tmp_path / "ck")
+    os.makedirs(ckpt_dir)
+    save_checkpoint(ckpt_dir, "t", params, state, user_content={"step": 3})
+    # two independent restores (the train step donates its inputs)
+    p2, s2, _, user = load_checkpoint(ckpt_dir, model_template=params, optimizer_template=state)
+    p3, s3, _, _ = load_checkpoint(ckpt_dir, model_template=params, optimizer_template=state)
+    assert user["step"] == 3
+
+    # one more step from each restored copy must match exactly
+    _, _, ma = step(p2, s2, _data(jax.random.PRNGKey(99)), jax.random.PRNGKey(99))
+    _, _, mb = step(p3, s3, _data(jax.random.PRNGKey(99)), jax.random.PRNGKey(99))
+    assert float(ma["loss"]) == pytest.approx(float(mb["loss"]), rel=1e-6)
