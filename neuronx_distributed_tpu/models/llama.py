@@ -322,6 +322,96 @@ class LlamaForCausalLM(nn.Module):
         return (logits, new_caches) if kv_caches is not None else logits
 
 
+class LlamaHead(nn.Module):
+    """Final norm + vocab-parallel LM head, split out as the pipeline's head
+    stage (reference ties this to the last PP stage,
+    ``pipeline/partition.py:225-250``)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.config
+        h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    name="final_norm")(h)
+        if cfg.sequence_parallel:
+            h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))
+        return ColumnParallelLinear(
+            features=cfg.vocab_size,
+            use_bias=False,
+            gather_output=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="lm_head",
+        )(h)
+
+
+def build_pipelined_llama(cfg: LlamaConfig, num_microbatches: int, seed: int = 0):
+    """Construct a :class:`~neuronx_distributed_tpu.pipeline.engine.PipelinedModel`
+    for pipeline-parallel Llama training.
+
+    Layer parameters are initialized *stacked* ``[L, ...]`` and sharded over
+    the ``pp`` mesh axis (the engine's partitioning-by-sharding; contrast the
+    reference's FX split into ``submod_i`` children,
+    ``pipeline/partition.py:17-42``)."""
+    import neuronx_distributed_tpu.pipeline.engine as engine
+    from neuronx_distributed_tpu.parallel.mesh import get_mesh
+
+    mesh = get_mesh()
+    embed_mod = ParallelEmbedding(
+        num_embeddings=cfg.vocab_size,
+        features=cfg.hidden_size,
+        sequence_parallel_output=cfg.sequence_parallel,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+    )
+    block_mod = LlamaBlock(cfg)
+    head_mod = LlamaHead(cfg)
+
+    def embed_fn(ep, ids):
+        return embed_mod.apply({"params": ep}, ids)
+
+    def block_fn(lp, x):
+        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+        y, _ = block_mod.apply({"params": lp}, x, positions)
+        return y
+
+    def head_fn(hp, h):
+        return head_mod.apply({"params": hp}, h)
+
+    def head_loss_fn(hp, h, labels):
+        logits = head_fn(hp, h)
+        per_tok = parallel_cross_entropy(logits, labels)
+        mask = (labels >= 0).astype(jnp.float32)
+        return jnp.sum(per_tok * mask), jnp.sum(mask)
+
+    return engine.build_pipelined_model(
+        embed_fn=embed_fn,
+        block_fn=block_fn,
+        head_loss_fn=head_loss_fn,
+        head_fn=head_fn,
+        embed_init=lambda r: embed_mod.init(r, jnp.zeros((1, cfg.max_seq_len), jnp.int32)),
+        block_init=lambda r: block_mod.init(
+            r,
+            jnp.zeros((1, cfg.max_seq_len, cfg.hidden_size), cfg.dtype),
+            jnp.zeros((1, cfg.max_seq_len), jnp.int32),
+        ),
+        head_init=lambda r: head_mod.init(
+            r, jnp.zeros((1, cfg.max_seq_len, cfg.hidden_size), cfg.dtype)
+        ),
+        num_layers=cfg.num_layers,
+        num_microbatches=num_microbatches,
+        mesh=mesh,
+        remat_block=cfg.remat != "none",
+        remat_policy=(
+            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+            if cfg.remat == "selective"
+            else None
+        ),
+        seed=seed,
+    )
+
+
 def causal_lm_loss(module: LlamaForCausalLM, params, batch, rng=None) -> jax.Array:
     """Next-token loss with masking; batch = {ids, labels[, mask]}.
 

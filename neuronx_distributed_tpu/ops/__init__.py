@@ -1,0 +1,10 @@
+"""Pallas TPU kernels: the hand-tuned hot ops the compiler can't fuse itself
+(flash attention, ring attention).  The reference delegates all kernel-level
+work to the Neuron compiler (SURVEY §2.9); on TPU these are first-class."""
+
+from neuronx_distributed_tpu.ops.flash_attention import (
+    flash_attention,
+    mha_reference,
+)
+
+__all__ = ["flash_attention", "mha_reference"]

@@ -1,0 +1,375 @@
+"""Flash attention as a pallas TPU kernel (fwd + bwd), with GQA support.
+
+The reference has no fused attention at all — its ``CoreAttention`` is a
+plain masked matmul-softmax-matmul that materializes the full [S, T] score
+matrix (``examples/training/llama2/modeling_llama_nxd.py:193-214``), leaning
+on ``NEURON_FUSE_SOFTMAX`` for fusion.  On TPU the blockwise online-softmax
+formulation is the difference between HBM-bound and MXU-bound attention, so
+this kernel is the framework's attention hot path (SURVEY §7 hard-part 6).
+
+Layout: ``q [B, HQ, S, D]``, ``k/v [B, HKV, T, D]`` with ``HQ = G * HKV``;
+grouped queries read their kv head via ``h // G`` in the BlockSpec index map,
+so GQA costs no extra memory traffic.  Forward emits the per-row logsumexp;
+backward follows the standard two-kernel split (dq by q-block, dk/dv by
+kv-block) with the ``delta = rowsum(dO * O)`` trick so neither direction ever
+materializes probabilities in HBM.  Causal blocks strictly above the diagonal
+are skipped via ``pl.when`` (no wasted MXU work on the masked half).
+
+Row statistics (m, l, lse, delta) are carried as ``[block, 128]``
+lane-replicated tiles — TPU VMEM wants a 128 minor dim.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas namespace; absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = float(-1e30)  # large-negative instead of -inf: keeps exp/where NaN-free
+LANES = 128
+
+
+def _auto_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(s: int, t: int, block_q: int, block_k: int) -> Tuple[int, int]:
+    bq, bk = min(block_q, s), min(block_k, t)
+    if s % bq != 0 or t % bk != 0:
+        raise ValueError(
+            f"sequence lengths (q={s}, kv={t}) must be divisible by block sizes "
+            f"({bq}, {bk}); pad the sequence"
+        )
+    return bq, bk
+
+
+def mha_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Dense oracle used by the tests (same math, full score matrix)."""
+    G = q.shape[1] // k.shape[1]
+    scale = (q.shape[-1] ** -0.5) if sm_scale is None else sm_scale
+    kk = jnp.repeat(k, G, axis=1)
+    vv = jnp.repeat(v, G, axis=1)
+    s = jnp.einsum("bhsd,bhtd->bhst", q, kk, preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool), k.shape[2] - q.shape[2])
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", p.astype(v.dtype), vv, preferred_element_type=q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_offset):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: skip blocks entirely above the diagonal (kv start > last q pos)
+    first_q = qi * block_q + kv_offset  # q positions offset into kv timeline
+    run = jnp.logical_or(
+        not causal, ki * block_k <= first_q + block_q - 1
+    )
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [bq, bk]
+        if causal:
+            qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[...] + jnp.log(safe_l)).astype(lse_ref.dtype)
+
+
+def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    B, HQ, S, D = q.shape
+    _, HKV, T, _ = k.shape
+    G = HQ // HKV
+    bq, bk = _block_sizes(S, T, block_q, block_k)
+    scale = (D ** -0.5) if sm_scale is None else sm_scale
+    nq, nk = S // bq, T // bk
+    kv_offset = T - S  # q positions sit at the end of the kv timeline
+
+    if pltpu is None:  # pragma: no cover - CPU builds always ship pltpu today
+        raise RuntimeError("pallas TPU namespace unavailable")
+    grid = (B, HQ, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
+        num_kv_blocks=nk, kv_offset=kv_offset,
+    )
+    scratch = [
+        # m / l lane-replicated, acc in fp32
+        pltpu.VMEM((bq, LANES), jnp.float32),
+        pltpu.VMEM((bq, LANES), jnp.float32),
+        pltpu.VMEM((bq, D), jnp.float32),
+    ]
+
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, HQ, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, HQ, S, LANES), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
+               *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_offset):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    first_q = qi * block_q + kv_offset
+    run = jnp.logical_or(not causal, ki * block_k <= first_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        acc_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finish():
+        dq_ref[0, 0] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                dk_scr, dv_scr,
+                *, sm_scale, causal, block_q, block_k, num_q_blocks, kv_offset):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    first_q = qi * block_q + kv_offset
+    run = jnp.logical_or(not causal, ki * block_k <= first_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # p^T @ do -> [bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale  # [bq, bk]
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # ds^T @ q -> [bk, D]
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret):
+    B, HQ, S, D = q.shape
+    _, HKV, T, _ = k.shape
+    G = HQ // HKV
+    bq, bk = _block_sizes(S, T, block_q, block_k)
+    scale = (D ** -0.5) if sm_scale is None else sm_scale
+    nq, nk = S // bq, T // bk
+    kv_offset = T - S
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,HQ,S]
+    delta = jnp.broadcast_to(delta[..., None], (B, HQ, S, LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
+            num_kv_blocks=nk, kv_offset=kv_offset,
+        ),
+        grid=(B, HQ, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, HQ, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv are accumulated per q-head then group-summed onto kv heads
+    dk_q, dv_q = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
+            num_q_blocks=nq, kv_offset=kv_offset,
+        ),
+        grid=(B, HQ, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi, G=G: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi, G=G: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, HQ, T, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, HQ, T, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk = jnp.sum(dk_q.reshape(B, HKV, G, T, D), axis=2).astype(k.dtype)
+    dv = jnp.sum(dv_q.reshape(B, HKV, G, T, D), axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API (custom_vjp)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused blockwise attention: ``q [B, HQ, S, D]``, ``k/v [B, HKV, T, D]``
+    (``HQ`` a multiple of ``HKV``) → ``[B, HQ, S, D]``.
+
+    With ``causal=True`` and ``T > S`` the queries occupy the *last* ``S``
+    positions of the kv timeline (the decode/chunked-prefill convention).
+    ``interpret`` defaults to auto: pallas interpreter off-TPU."""
+    o, _ = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, _auto_interpret(interpret))
+    return o
+
+
+def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, _auto_interpret(interpret))
+    return o, (q, k, v, o, lse)
+
+
+def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd_impl(
+        q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, _auto_interpret(interpret)
+    )
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

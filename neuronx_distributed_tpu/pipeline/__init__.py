@@ -1,0 +1,62 @@
+"""Pipeline parallelism (reference ``pipeline/`` — NxDPPModel, 1F1B scheduler,
+partitioner, neighbor comm; SURVEY §2.7).
+
+The TPU-native engine compiles the whole microbatch schedule into one jit
+(:mod:`.engine`); the declarative schedules (:mod:`.scheduler`) verify the
+task arithmetic and remain available for host-driven execution."""
+
+from neuronx_distributed_tpu.pipeline.engine import (
+    EMBED,
+    HEAD,
+    LAYERS,
+    PipelinedModel,
+    build_pipelined_model,
+    make_pipelined_forward_fn,
+    make_pipelined_loss_fn,
+    microbatch,
+    stacked_layer_specs,
+)
+from neuronx_distributed_tpu.pipeline.partition import (
+    layers_per_stage,
+    partition_uniform,
+    spans_from_cuts,
+)
+from neuronx_distributed_tpu.pipeline.scheduler import (
+    BackwardStep,
+    ForwardStep,
+    InferenceSchedule,
+    PipeSchedule,
+    RecvBackward,
+    RecvForward,
+    ReduceGrads,
+    SendBackward,
+    SendForward,
+    TrainSchedule,
+    bubble_fraction,
+)
+
+__all__ = [
+    "EMBED",
+    "HEAD",
+    "LAYERS",
+    "PipelinedModel",
+    "build_pipelined_model",
+    "make_pipelined_loss_fn",
+    "make_pipelined_forward_fn",
+    "microbatch",
+    "stacked_layer_specs",
+    "partition_uniform",
+    "spans_from_cuts",
+    "layers_per_stage",
+    "PipeSchedule",
+    "TrainSchedule",
+    "InferenceSchedule",
+    "ForwardStep",
+    "BackwardStep",
+    "RecvForward",
+    "SendForward",
+    "RecvBackward",
+    "SendBackward",
+    "ReduceGrads",
+    "bubble_fraction",
+]

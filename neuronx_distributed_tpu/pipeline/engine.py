@@ -1,0 +1,391 @@
+"""Pipeline-parallel execution engine: the whole schedule in one jit.
+
+TPU-native replacement for the reference's eager per-task PP runtime
+(``pipeline/model.py``: ``NxDPPModel`` task executor ``:954-979``, fwd/bwd
+tasks ``:637-920``, neighbor transport ``pipeline/comm.py:27-68``).  The
+reference dispatches one lazy-tensor graph per task and moves activations
+with 2-rank all-reduces bracketed by ``mark_step``; here the *entire*
+microbatch schedule compiles into a single ``lax.scan`` inside a
+partial-manual ``jax.shard_map``:
+
+- the ``pp`` mesh axis is manual: each tick rotates stage outputs to the next
+  stage with one ``lax.ppermute`` (a true collective-permute — what the
+  reference emulates with paired all-reduce, ``comm.py:38-68``);
+- every other axis (dp/tp/kvr/cp/ep) stays automatic, so the TP/SP layers'
+  GSPMD sharding constraints keep working unchanged inside a stage;
+- the backward pipeline needs no hand-written schedule at all: autodiff of
+  ``scan`` + ``ppermute`` produces the reverse-order drain with transposed
+  permutes, and XLA's latency-hiding scheduler overlaps the transfers.
+
+Layer parameters are stacked on a leading layer axis sharded over ``pp``
+(``L = num_stages * layers_per_stage``), so "partitioning" is a sharding
+spec, not a graph split (see :mod:`..pipeline.partition`).  Non-stage
+parameters (embedding, lm head, final norm) are replicated along ``pp``;
+because the shard_map transpose psums gradients of replicated inputs over
+``pp``, tied embedding/head weights need none of the reference's dedicated
+shared-weight process groups (``parallel_state.py:347-379``).
+
+Schedule shape: fill-drain over ``T = M + P - 1`` ticks (GPipe-style; the
+1F1B reordering in :mod:`.scheduler` has identical bubble fraction and only
+changes *eager* peak memory — under one jit, peak memory is governed by the
+remat policy instead).  Known redundancy: embedding and head/loss run every
+tick on every stage (masked to the owning stage), costing roughly
+``(V / 6H) / layers_per_stage`` extra compute; acceptable next to the
+(P-1)/(M+P-1) bubble and avoids materializing all microbatch outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel.mesh import BATCH_AXES, PIPELINE_AXIS, get_mesh
+from neuronx_distributed_tpu.pipeline.partition import layers_per_stage
+
+# Param-tree keys understood by the engine.
+EMBED = "embed"
+LAYERS = "layers"
+HEAD = "head"
+
+BlockFn = Callable[[Any, jax.Array], jax.Array]
+EmbedFn = Callable[[Any, jax.Array], jax.Array]
+# head_loss_fn(head_params, hidden, labels) -> (loss_sum, token_count)
+HeadLossFn = Callable[[Any, jax.Array, jax.Array], Tuple[jax.Array, jax.Array]]
+
+
+def microbatch(x: jax.Array, num_microbatches: int, mesh: Optional[Mesh] = None) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...] (the reference's microbatch split,
+    ``pipeline/model.py:560-580``).
+
+    No sharding constraint is applied: a constraint on an operand feeding a
+    partial-manual shard_map trips an XLA SPMD-partitioner CHECK (observed on
+    XLA/jax 0.9), and none is needed — when dp divides the microbatch size,
+    the dp-contiguous blocks of the global batch dim land exactly on the
+    inner dim, so GSPMD propagates ``P(None, dp, ...)`` through the reshape
+    on its own."""
+    if x.shape[0] % num_microbatches != 0:
+        raise ValueError(
+            f"batch size {x.shape[0]} not divisible by num_microbatches {num_microbatches}"
+        )
+    del mesh
+    return x.reshape(num_microbatches, x.shape[0] // num_microbatches, *x.shape[1:])
+
+
+def stacked_layer_specs(block_specs: Any) -> Any:
+    """Prepend the pp axis to per-block param specs: a block kernel spec
+    ``P(None, 'tp')`` becomes ``P('pp', None, 'tp')`` for the [L, ...] stack."""
+    return jax.tree.map(
+        lambda s: P(PIPELINE_AXIS, *s), block_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def make_pipelined_loss_fn(
+    embed_fn: EmbedFn,
+    block_fn: BlockFn,
+    head_loss_fn: HeadLossFn,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    remat_block: bool = True,
+    remat_policy: Optional[Callable] = None,
+):
+    """Build ``loss_fn(params, ids, labels) -> (loss_sum, token_count)``.
+
+    ``params`` must be ``{EMBED: ..., LAYERS: stacked [L, ...], HEAD: ...}``.
+    The returned function is differentiable and jittable; wrap its mean in
+    ``jax.value_and_grad`` for training (the trainer does this).
+    """
+    mesh = mesh if mesh is not None else get_mesh()
+    pp = mesh.shape[PIPELINE_AXIS]
+
+    blk = block_fn
+    if remat_block:
+        blk = jax.checkpoint(block_fn, policy=remat_policy, prevent_cse=False)
+
+    def stage_fn(stage_params, x):
+        def body(h, layer_params):
+            return blk(layer_params, h), None
+
+        x, _ = lax.scan(body, x, stage_params)
+        return x
+
+    def loss_fn(params, ids: jax.Array, labels: jax.Array):
+        """ids/labels: [B, S] global batch."""
+        ids_mb = microbatch(ids, num_microbatches, mesh)
+        labels_mb = microbatch(labels, num_microbatches, mesh)
+        L = jax.tree.leaves(params[LAYERS])[0].shape[0]
+        layers_per_stage(L, pp)  # validate divisibility
+
+        if pp == 1:
+            # Degenerate case: no pipeline machinery, plain scan over layers.
+            def one_mb(carry, mb):
+                i, l = mb
+                x = stage_fn(params[LAYERS], embed_fn(params[EMBED], i))
+                ls, n = head_loss_fn(params[HEAD], x, l)
+                s, c = carry
+                return (s + ls, c + n), None
+
+            (loss_sum, tok), _ = lax.scan(
+                one_mb, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                (ids_mb, labels_mb),
+            )
+            return loss_sum, tok
+
+        M = num_microbatches
+        T = M + pp - 1
+
+        def f(layer_stack, embed_params, head_params, ids_mb, labels_mb):
+            # layer_stack leaves are the local [L/pp, ...] slice.
+            rank = lax.axis_index(PIPELINE_AXIS)
+            is_first = rank == 0
+            is_last = rank == pp - 1
+
+            mb_shape = ids_mb.shape[1:]
+            probe = jax.eval_shape(embed_fn, embed_params, jnp.zeros(mb_shape, ids_mb.dtype))
+
+            def tick(carry, t):
+                buf, loss_sum, tok_sum = carry
+                feed_t = jnp.clip(t, 0, M - 1)
+                ids_t = lax.dynamic_index_in_dim(ids_mb, feed_t, axis=0, keepdims=False)
+                x0 = embed_fn(embed_params, ids_t)
+                x_in = jnp.where(is_first, x0, buf)
+
+                y = stage_fn(layer_stack, x_in)
+
+                out_t = t - (pp - 1)
+                lbl = lax.dynamic_index_in_dim(
+                    labels_mb, jnp.clip(out_t, 0, M - 1), axis=0, keepdims=False
+                )
+                ls, n = head_loss_fn(head_params, y, lbl)
+                use = jnp.logical_and(is_last, out_t >= 0)
+                loss_sum = loss_sum + jnp.where(use, ls, 0.0).astype(jnp.float32)
+                tok_sum = tok_sum + jnp.where(use, n, 0.0).astype(jnp.float32)
+
+                nxt = lax.ppermute(
+                    y, PIPELINE_AXIS, [(i, (i + 1) % pp) for i in range(pp)]
+                )
+                return (nxt, loss_sum, tok_sum), None
+
+            init = (
+                jnp.zeros(probe.shape, probe.dtype),
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.float32),
+            )
+            (_, loss_sum, tok_sum), _ = lax.scan(tick, init, jnp.arange(T))
+            # only the last stage accumulated; make the result pp-invariant
+            loss_sum = lax.psum(loss_sum, PIPELINE_AXIS)
+            tok_sum = lax.psum(tok_sum, PIPELINE_AXIS)
+            return loss_sum, tok_sum
+
+        shmap = jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(PIPELINE_AXIS), P(), P(), P(), P()),
+            out_specs=(P(), P()),
+            axis_names=frozenset({PIPELINE_AXIS}),
+            check_vma=False,
+        )
+        return shmap(params[LAYERS], params[EMBED], params[HEAD], ids_mb, labels_mb)
+
+    return loss_fn
+
+
+@dataclasses.dataclass
+class PipelinedModel:
+    """Facade over a pipeline-staged model (the PP analogue of the trainer's
+    ``ParallelModel``; reference ``NxDPPModel``, ``pipeline/model.py:45``).
+
+    ``loss_fn(params, ids, labels) -> (loss_sum, token_count)`` runs the full
+    microbatch schedule; ``forward_fn(params, ids) -> logits`` is the
+    fwd-only path."""
+
+    params: Any
+    param_specs: Any
+    mesh: Mesh
+    num_microbatches: int
+    loss_fn: Callable
+    forward_fn: Callable
+
+    @property
+    def param_shardings(self):
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            self.param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def num_parameters(self) -> int:
+        return sum(int(x.size) for x in jax.tree.leaves(self.params))
+
+
+def build_pipelined_model(
+    embed_fn: EmbedFn,
+    block_fn: BlockFn,
+    head_loss_fn: HeadLossFn,
+    head_fn: Callable[[Any, jax.Array], jax.Array],
+    embed_init: Callable[[jax.Array], Any],
+    block_init: Callable[[jax.Array], Any],
+    head_init: Callable[[jax.Array], Any],
+    num_layers: int,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    remat_block: bool = True,
+    remat_policy: Optional[Callable] = None,
+    seed: int = 0,
+) -> PipelinedModel:
+    """Initialize a pipelined model with stage parameters born sharded.
+
+    ``*_init`` are flax ``Module.init`` thunks taking a PRNG key and
+    returning a (possibly Partitioned-boxed) variable dict; block params are
+    initialized per-layer under ``vmap`` into the stacked ``[L, ...]`` layout
+    and placed pp-sharded (the GSPMD replacement for the reference's
+    partition + sequential materialize-and-move,
+    ``pipeline/model.py:1111-1125``)."""
+    from flax import linen as nn
+
+    mesh = mesh if mesh is not None else get_mesh()
+    pp = mesh.shape[PIPELINE_AXIS]
+    layers_per_stage(num_layers, pp)
+
+    rng = jax.random.PRNGKey(seed)
+    r_embed, r_head, r_layers = jax.random.split(rng, 3)
+
+    def _params_of(tree):
+        return tree["params"] if isinstance(tree, dict) and "params" in tree else tree
+
+    def _specs_of(init, key):
+        abs_tree = jax.eval_shape(init, key)
+        return _params_of(nn.get_partition_spec(abs_tree))
+
+    embed_specs = _specs_of(embed_init, r_embed)
+    head_specs = _specs_of(head_init, r_head)
+    block_specs = _specs_of(block_init, r_layers)
+    layer_specs = stacked_layer_specs(block_specs)
+
+    def _shardings(specs):
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+        )
+
+    embed_params = jax.jit(
+        lambda r: _params_of(nn.unbox(embed_init(r))), out_shardings=_shardings(embed_specs)
+    )(r_embed)
+    head_params = jax.jit(
+        lambda r: _params_of(nn.unbox(head_init(r))), out_shardings=_shardings(head_specs)
+    )(r_head)
+    layer_keys = jax.random.split(r_layers, num_layers)
+    layer_params = jax.jit(
+        lambda ks: jax.vmap(lambda k: _params_of(nn.unbox(block_init(k))))(ks),
+        out_shardings=_shardings(layer_specs),
+    )(layer_keys)
+
+    params = {EMBED: embed_params, LAYERS: layer_params, HEAD: head_params}
+    specs = {EMBED: embed_specs, LAYERS: layer_specs, HEAD: head_specs}
+
+    loss_fn = make_pipelined_loss_fn(
+        embed_fn,
+        block_fn,
+        head_loss_fn,
+        num_microbatches,
+        mesh=mesh,
+        remat_block=remat_block,
+        remat_policy=remat_policy,
+    )
+    forward_fn = make_pipelined_forward_fn(
+        embed_fn, block_fn, head_fn, num_microbatches, mesh=mesh
+    )
+    return PipelinedModel(
+        params=params,
+        param_specs=specs,
+        mesh=mesh,
+        num_microbatches=num_microbatches,
+        loss_fn=loss_fn,
+        forward_fn=forward_fn,
+    )
+
+
+def make_pipelined_forward_fn(
+    embed_fn: EmbedFn,
+    block_fn: BlockFn,
+    head_fn: Callable[[Any, jax.Array], jax.Array],
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+):
+    """Forward-only pipeline (the reference's ``InferenceSchedule`` path,
+    ``pipeline/model.py:run_eval``): returns ``fn(params, ids) -> outputs``
+    with outputs stacked back to the global batch.
+
+    Implementation: the hidden states exiting the last stage are collected
+    per tick and broadcast from the last stage once at the end (one transfer,
+    not one per microbatch), then the head runs under plain GSPMD.
+    """
+    mesh = mesh if mesh is not None else get_mesh()
+    pp = mesh.shape[PIPELINE_AXIS]
+
+    def stage_fn(stage_params, x):
+        def body(h, layer_params):
+            return block_fn(layer_params, h), None
+
+        x, _ = lax.scan(body, x, stage_params)
+        return x
+
+    def forward_fn(params, ids: jax.Array):
+        ids_mb = microbatch(ids, num_microbatches, mesh)
+        M = num_microbatches
+
+        if pp == 1:
+            def one_mb(_, i):
+                return None, head_fn(params[HEAD], stage_fn(params[LAYERS], embed_fn(params[EMBED], i)))
+
+            _, outs = lax.scan(one_mb, None, ids_mb)
+            return outs.reshape(ids.shape[0], *outs.shape[2:])
+
+        T = M + pp - 1
+
+        def f(layer_stack, embed_params, ids_mb):
+            rank = lax.axis_index(PIPELINE_AXIS)
+            is_first = rank == 0
+            is_last = rank == pp - 1
+            mb_shape = ids_mb.shape[1:]
+            probe = jax.eval_shape(embed_fn, embed_params, jnp.zeros(mb_shape, ids_mb.dtype))
+
+            def tick(carry, t):
+                buf, outs = carry
+                feed_t = jnp.clip(t, 0, M - 1)
+                ids_t = lax.dynamic_index_in_dim(ids_mb, feed_t, axis=0, keepdims=False)
+                x_in = jnp.where(is_first, embed_fn(embed_params, ids_t), buf)
+                y = stage_fn(layer_stack, x_in)
+                out_t = t - (pp - 1)
+                write = jnp.where(jnp.logical_and(is_last, out_t >= 0), y, 0.0).astype(y.dtype)
+                outs = lax.dynamic_update_index_in_dim(
+                    outs, outs[jnp.clip(out_t, 0, M - 1)] + write, jnp.clip(out_t, 0, M - 1), axis=0
+                )
+                nxt = lax.ppermute(y, PIPELINE_AXIS, [(i, (i + 1) % pp) for i in range(pp)])
+                return (nxt, outs), None
+
+            init = (
+                jnp.zeros(probe.shape, probe.dtype),
+                jnp.zeros((M, *probe.shape), probe.dtype),
+            )
+            (_, outs), _ = lax.scan(tick, init, jnp.arange(T))
+            # gather the last stage's buffer to every pp rank (single psum —
+            # all other ranks contributed zeros)
+            return lax.psum(outs, PIPELINE_AXIS)
+
+        shmap = jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(PIPELINE_AXIS), P(), P()),
+            out_specs=P(),
+            axis_names=frozenset({PIPELINE_AXIS}),
+            check_vma=False,
+        )
+        hidden = shmap(params[LAYERS], params[EMBED], ids_mb)
+        logits = head_fn(params[HEAD], hidden.reshape(ids.shape[0], *hidden.shape[2:]))
+        return logits
+
+    return forward_fn

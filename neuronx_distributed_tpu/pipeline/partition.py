@@ -1,0 +1,63 @@
+"""Stage partitioning for pipeline parallelism.
+
+TPU-native counterpart of the reference's FX-based partitioner
+(``pipeline/partition.py``: ``partition_traced_model`` ``:17-42``,
+``analyze_pipeline_module`` ``:75-222``, shared-weight analysis ``:225-250``).
+The reference traces the model with torch.fx, marks cut nodes, and splits the
+graph; on TPU the model is a *stack of identical transformer blocks* whose
+parameters carry a leading layer axis, so a "partition" is just an assignment
+of layer indices to stages — jaxprs are already functional and stage IO is
+the homogeneous hidden-state tensor.
+
+Shared weights (the reference's embedding/lm-head tying machinery,
+``partition.py:225-250`` + dedicated grad process groups,
+``parallel_state.py:347-379``) need no analysis here: non-stage parameters
+(embedding, head, final norm) are replicated along the ``pp`` mesh axis, so a
+weight referenced by several stages receives its summed gradient from the
+shard_map transpose automatically.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+
+def partition_uniform(num_layers: int, num_stages: int) -> List[Tuple[int, int]]:
+    """Balanced contiguous ``[start, end)`` layer spans, one per stage.
+
+    When ``num_layers`` is not divisible, earlier stages receive the extra
+    layers — they also hold more in-flight microbatches under 1F1B, but the
+    imbalance is at most one layer (matching the reference's convention of
+    user-chosen ``pipeline_cuts``)."""
+    if num_stages < 1:
+        raise ValueError("num_stages must be >= 1")
+    if num_layers < num_stages:
+        raise ValueError(f"cannot split {num_layers} layers into {num_stages} stages")
+    base, extra = divmod(num_layers, num_stages)
+    spans = []
+    start = 0
+    for s in range(num_stages):
+        size = base + (1 if s < extra else 0)
+        spans.append((start, start + size))
+        start += size
+    return spans
+
+
+def spans_from_cuts(cuts: Sequence[int], num_layers: int) -> List[Tuple[int, int]]:
+    """Spans from explicit cut points (the reference's ``pipeline_cuts``:
+    layer indices that begin a new stage)."""
+    bounds = [0, *cuts, num_layers]
+    if bounds != sorted(bounds) or len(set(bounds)) != len(bounds):
+        raise ValueError(f"cuts {cuts} must be strictly increasing within (0, {num_layers})")
+    return [(bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)]
+
+
+def layers_per_stage(num_layers: int, num_stages: int) -> int:
+    """Uniform layer count per stage; raises unless evenly divisible (the
+    stacked-parameter engine requires homogeneous stages)."""
+    if num_layers % num_stages != 0:
+        raise ValueError(
+            f"num_layers={num_layers} must be divisible by num_stages={num_stages} "
+            "for the stacked pipeline engine; pad the model or choose another pp size"
+        )
+    return num_layers // num_stages

@@ -1,0 +1,177 @@
+"""Pipeline-parallel task schedules (pure logic, backend-agnostic).
+
+TPU-native counterpart of the reference's declarative schedules
+(``pipeline/scheduler.py``: task taxonomy ``:4-49``, ``PipeSchedule`` ABC
+``:52-125``, fwd-only ``InferenceSchedule`` ``:128-138``, 1F1B
+``TrainSchedule`` ``:141-273``).  The reference drives an eager per-task
+executor with these; here the production engine
+(:mod:`neuronx_distributed_tpu.pipeline.engine`) compiles the whole schedule
+into one jitted ``lax.scan``, so this module serves three purposes:
+
+- it documents and *verifies* the schedule arithmetic (unit tests assert
+  per-stage task sequences, mirroring the reference's scheduler tests);
+- it computes the bubble / peak-activation analytics used to pick
+  ``num_microbatches``;
+- it remains available for a host-driven multi-dispatch executor.
+
+The 1F1B shape: stage ``s`` of ``P`` runs ``min(M, P-1-s)`` warmup forwards,
+then alternates one-forward-one-backward in the steady state, then drains the
+remaining backwards.  Every stage executes exactly ``M`` forwards and ``M``
+backwards; earlier stages hold at most ``P-s`` in-flight microbatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Task:
+    """One schedulable unit; ``microbatch`` indexes the microbatch it acts on."""
+
+    microbatch: int
+
+
+class ForwardStep(Task):
+    pass
+
+
+class BackwardStep(Task):
+    pass
+
+
+class RecvForward(Task):
+    """Receive the previous stage's activation for ``microbatch``."""
+
+
+class SendForward(Task):
+    """Send this stage's activation for ``microbatch`` to the next stage."""
+
+
+class RecvBackward(Task):
+    """Receive the next stage's activation-gradient for ``microbatch``."""
+
+
+class SendBackward(Task):
+    """Send the activation-gradient for ``microbatch`` to the previous stage."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceGrads:
+    """End-of-batch gradient reduction (reference ``ReduceGradsTask``)."""
+
+
+class PipeSchedule:
+    """Base schedule: yields the ordered task list for one stage
+    (reference ``PipeSchedule``, ``pipeline/scheduler.py:52-125``)."""
+
+    def __init__(self, num_microbatches: int, num_stages: int, stage_id: int):
+        if not 0 <= stage_id < num_stages:
+            raise ValueError(f"stage_id {stage_id} out of range for {num_stages} stages")
+        if num_microbatches < 1:
+            raise ValueError("num_microbatches must be >= 1")
+        self.num_microbatches = num_microbatches
+        self.num_stages = num_stages
+        self.stage_id = stage_id
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.num_stages - 1
+
+    def steps(self) -> Iterator[List[object]]:
+        """Yield groups of tasks; tasks within a group may run concurrently."""
+        raise NotImplementedError
+
+    def tasks(self) -> List[object]:
+        """Flat ordered task list."""
+        return [t for group in self.steps() for t in group]
+
+    def num_in_flight(self) -> int:
+        """Peak number of microbatches whose activations this stage holds."""
+        raise NotImplementedError
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference ``InferenceSchedule``,
+    ``pipeline/scheduler.py:128-138``)."""
+
+    def steps(self) -> Iterator[List[object]]:
+        for mb in range(self.num_microbatches):
+            group: List[object] = []
+            if not self.is_first_stage:
+                group.append(RecvForward(mb))
+            group.append(ForwardStep(mb))
+            if not self.is_last_stage:
+                group.append(SendForward(mb))
+            yield group
+
+    def num_in_flight(self) -> int:
+        return 1
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference ``TrainSchedule``, ``pipeline/scheduler.py:141-273``).
+
+    Warmup forwards fill the pipeline, the steady state interleaves one
+    forward with one backward (receiving before sending so neighbor pairs
+    never deadlock — the reference's recv-before-send rule,
+    ``scheduler.py:174-180``), and the cooldown drains the backwards."""
+
+    @property
+    def num_warmup(self) -> int:
+        return min(self.num_microbatches, self.num_stages - 1 - self.stage_id)
+
+    def steps(self) -> Iterator[List[object]]:
+        M, warmup = self.num_microbatches, self.num_warmup
+        steady = M - warmup
+
+        for mb in range(warmup):
+            group: List[object] = []
+            if not self.is_first_stage:
+                group.append(RecvForward(mb))
+            group.append(ForwardStep(mb))
+            if not self.is_last_stage:
+                group.append(SendForward(mb))
+            yield group
+
+        for i in range(steady):
+            f_mb, b_mb = warmup + i, i
+            group = []
+            if not self.is_first_stage:
+                group.append(RecvForward(f_mb))
+            group.append(ForwardStep(f_mb))
+            # recv the backward before sending the forward: the conjugate
+            # neighbor (later stage) is sending this grad before it posts its
+            # own forward recv, so the pair always matches up.
+            if not self.is_last_stage:
+                group.append(RecvBackward(b_mb))
+                group.append(SendForward(f_mb))
+            group.append(BackwardStep(b_mb))
+            if not self.is_first_stage:
+                group.append(SendBackward(b_mb))
+            yield group
+
+        for mb in range(steady, M):
+            group = []
+            if not self.is_last_stage:
+                group.append(RecvBackward(mb))
+            group.append(BackwardStep(mb))
+            if not self.is_first_stage:
+                group.append(SendBackward(mb))
+            yield group
+
+        yield [ReduceGrads()]
+
+    def num_in_flight(self) -> int:
+        return min(self.num_microbatches, self.num_stages - self.stage_id)
+
+
+def bubble_fraction(num_microbatches: int, num_stages: int) -> float:
+    """Pipeline bubble fraction (P-1)/(M+P-1) — identical for GPipe-style
+    fill-drain and 1F1B; 1F1B only lowers peak activation memory."""
+    return (num_stages - 1) / (num_microbatches + num_stages - 1)

@@ -191,6 +191,51 @@ def make_train_step(
     )
 
 
+def make_pipelined_train_step(
+    config: TrainingConfig,
+    model: "Any",
+    optimizer: ParallelOptimizer,
+):
+    """Train step for a :class:`~neuronx_distributed_tpu.pipeline.engine.PipelinedModel`
+    (the PP branch of the reference's ``NxDModel.run_train`` →
+    ``NxDPPModel.run_train``, ``trainer/model.py:23-28``).
+
+    The batch is ``{"ids": [B, S], "labels": [B, S]}`` with
+    ``B = num_microbatches * microbatch_size * dp``; loss is the exact
+    token-masked mean over the global batch, identical to the non-PP path."""
+    oc = config.optimizer
+    mesh = model.mesh
+    param_shardings = model.param_shardings
+    state_shardings = optimizer.state_shardings
+
+    def _step(params, opt_state, batch, rng):
+        def mean_loss(p):
+            loss_sum, tok = model.loss_fn(p, batch["ids"], batch["labels"])
+            return loss_sum / jnp.maximum(tok, 1.0)
+
+        loss, grads = jax.value_and_grad(mean_loss)(params)
+        if oc.grad_clipping:
+            grads, grad_norm = clip_grad_norm(grads, oc.max_grad_norm)
+        else:
+            from neuronx_distributed_tpu.parallel.grads import get_grad_norm
+
+            grad_norm = get_grad_norm(grads)
+        updates, opt_state = optimizer.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, "grad_norm": grad_norm}
+
+    batch_shardings = {
+        "ids": NamedSharding(mesh, P(BATCH_AXES)),
+        "labels": NamedSharding(mesh, P(BATCH_AXES)),
+    }
+    return jax.jit(
+        _step,
+        in_shardings=(param_shardings, state_shardings, batch_shardings, None),
+        out_shardings=(param_shardings, state_shardings, None),
+        donate_argnums=(0, 1),
+    )
+
+
 def default_batch_spec() -> P:
     """Batch arrays sharded over the data-parallel axes on dim 0."""
     return P(BATCH_AXES)
