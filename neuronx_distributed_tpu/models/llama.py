@@ -66,6 +66,10 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     sequence_parallel: bool = True
     remat: str = "selective"  # none | selective | full
+    # "dense": GSPMD einsum core (CPU-friendly; always used for cached decode).
+    # "flash": pallas flash kernel under shard_map; rings KV over the cp axis
+    #          when context_parallel_size > 1 (long-context training).
+    attention_impl: str = "dense"
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -140,8 +144,12 @@ class CoreAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, q, k, v, q_offset=0):
+    def __call__(self, q, k, v, q_offset=0, allow_flash=True):
         cfg = self.config
+        if cfg.attention_impl == "flash" and allow_flash:
+            from neuronx_distributed_tpu.ops.ring_attention import ring_attention
+
+            return ring_attention(q, k, v, causal=True)
         B, S, NQ, D = q.shape
         T = k.shape[1]
         NKV = k.shape[2]
@@ -188,8 +196,13 @@ class LlamaAttention(nn.Module):
             new_cache = (ck, cv)
             k, v = ck, cv
 
-        # rematerialization is applied at block granularity in LlamaModel
-        out = CoreAttention(cfg, name="core")(q, k, v, cache_offset if kv_cache is not None else 0)
+        # rematerialization is applied at block granularity in LlamaModel;
+        # cached decode keeps the dense core (it needs the cache-offset mask)
+        out = CoreAttention(cfg, name="core")(
+            q, k, v,
+            cache_offset if kv_cache is not None else 0,
+            allow_flash=kv_cache is None,
+        )
 
         B, S = x.shape[0], q.shape[1]
         out = out.reshape(B, S, cfg.num_heads * D)

@@ -4,7 +4,14 @@ work to the Neuron compiler (SURVEY §2.9); on TPU these are first-class."""
 
 from neuronx_distributed_tpu.ops.flash_attention import (
     flash_attention,
+    flash_attention_with_lse,
     mha_reference,
 )
+from neuronx_distributed_tpu.ops.ring_attention import ring_attention
 
-__all__ = ["flash_attention", "mha_reference"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "mha_reference",
+    "ring_attention",
+]

@@ -266,7 +266,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret):
+def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, interpret):
+    """Backward kernels; ``delta_rows [B,HQ,S]`` is the softmax correction term
+    (``rowsum(dO*O)``, minus the lse cotangent when one exists — see
+    :func:`flash_attention_with_lse`)."""
     B, HQ, S, D = q.shape
     _, HKV, T, _ = k.shape
     G = HQ // HKV
@@ -275,8 +278,7 @@ def _bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret
     nq, nk = S // bq, T // bk
     kv_offset = T - S
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,HQ,S]
-    delta = jnp.broadcast_to(delta[..., None], (B, HQ, S, LANES))
+    delta = jnp.broadcast_to(delta_rows[..., None], (B, HQ, S, LANES))
 
     dq = pl.pallas_call(
         functools.partial(
@@ -366,10 +368,57 @@ def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
+    delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     dq, dk, dv = _bwd_impl(
-        q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, _auto_interpret(interpret)
+        q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
+        _auto_interpret(interpret),
     )
     return dq, dk, dv
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """:func:`flash_attention` that also returns the per-row logsumexp
+    ``[B, HQ, S]`` (fp32) — the combinable partial form needed by ring
+    attention, where per-device chunk outputs are merged by lse weighting.
+
+    The backward accepts a cotangent for the lse output: since
+    ``d lse_i / d s_ij = p_ij``, the lse cotangent enters the score gradient
+    as ``ds_ij += dlse_i * p_ij``, i.e. it simply subtracts from the standard
+    ``delta = rowsum(dO*O)`` correction — so the same kernels serve both entry
+    points.
+    """
+    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, _auto_interpret(interpret))
+    return o, lse[..., 0]
+
+
+def _fa_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, _auto_interpret(interpret))
+    return (o, lse[..., 0]), (q, k, v, o, lse)
+
+
+def _fa_lse_bwd(causal, sm_scale, block_q, block_k, interpret, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta_rows = delta_rows - dlse.astype(jnp.float32)
+    dq, dk, dv = _bwd_impl(
+        q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
+        _auto_interpret(interpret),
+    )
+    return dq, dk, dv
+
+
+flash_attention_with_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)

@@ -1,0 +1,180 @@
+"""Ring-attention context parallelism over the ``cp`` mesh axis.
+
+Long-context scaling the reference does NOT have (SURVEY §5.7: "No ring
+attention, no context parallel ... anywhere in the repo" — its only sequence
+story is Megatron-SP, bounded by TP degree).  Here the sequence axis is
+sharded over a dedicated ``cp`` mesh axis and KV chunks rotate around the
+ring with ``lax.ppermute`` while each device's queries stay put — attention
+memory per device is O((S/cp)^2) and the sequence scales with the mesh, the
+TPU-native realization of Ring Attention (Liu et al., blockwise parallel
+transformers).
+
+Design notes
+------------
+- Runs under ``jax.shard_map`` on the global mesh: batch sharded over
+  ``dp``/``ep``, heads over ``tp`` (q additionally over ``kvr``), sequence
+  over ``cp``.  Inside the shard the per-chunk partials come from the pallas
+  flash kernel (:func:`flash_attention_with_lse`) or a dense fp32 oracle, and
+  are merged with logsumexp weighting — exactly the flash combine, applied
+  across devices instead of across kv blocks.
+- Each iteration prefetches the NEXT chunk's KV with ``ppermute`` before
+  computing on the current one, so XLA's latency-hiding scheduler overlaps
+  ICI transfer with MXU compute.
+- Causality at chunk granularity: with contiguous sequence chunks, chunk
+  ``src`` is fully visible to queries on chunk ``idx`` iff ``src < idx``,
+  causal-masked iff ``src == idx`` (step 0), fully masked otherwise.  Masked
+  partials are dropped by setting their lse to a large negative — all devices
+  still execute the same program (SPMD-uniform, no data-dependent control
+  flow).
+- The whole ring is differentiable by construction: the combine is plain
+  jnp math, ``ppermute`` transposes to the inverse rotation, and the flash
+  kernel's vjp accepts the lse cotangent the combine introduces.  No custom
+  backward pass needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.ops.flash_attention import (
+    NEG_INF,
+    flash_attention_with_lse,
+)
+from neuronx_distributed_tpu.parallel.mesh import (
+    BATCH_AXES,
+    CONTEXT_AXIS,
+    KV_REPLICA_AXIS,
+    TENSOR_AXIS,
+    get_mesh,
+)
+
+
+def _dense_chunk_attn(q, k, v, causal: bool, sm_scale: float) -> Tuple[jax.Array, jax.Array]:
+    """Dense per-chunk attention returning ``(o, lse)``; q ``[B,HQ,S,D]``,
+    k/v ``[B,HKV,T,D]``.  fp32 softmax; used off-TPU and as the test oracle."""
+    G = q.shape[1] // k.shape[1]
+    kk = jnp.repeat(k, G, axis=1)
+    vv = jnp.repeat(v, G, axis=1)
+    s = jnp.einsum("bhsd,bhtd->bhst", q, kk, preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        mask = jnp.arange(k.shape[2])[None, :] <= jnp.arange(q.shape[2])[:, None] + (
+            k.shape[2] - q.shape[2]
+        )
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B,HQ,S]
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bhst,bhtd->bhsd", p.astype(v.dtype), vv, preferred_element_type=jnp.float32)
+    return o.astype(q.dtype), lse
+
+
+def _combine(o1, lse1, o2, lse2):
+    """Merge two normalized partial attention outputs by their logsumexps.
+    ``o1`` is the fp32 running accumulator; ``o2`` a fresh partial."""
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse)[..., None]
+    w2 = jnp.exp(lse2 - lse)[..., None]
+    return o1 * w1 + o2.astype(jnp.float32) * w2, lse
+
+
+def _ring_shard(
+    q, k, v, *, cp: int, causal: bool, sm_scale: float, use_flash: bool,
+    block_q: int, block_k: int, interpret: Optional[bool],
+):
+    """Per-shard body; q ``[B,HQ,S/cp,D]``, k/v ``[B,HKV,S/cp,D]`` local chunks."""
+
+    def chunk(qc, kc, vc, diag: bool):
+        if use_flash:
+            return flash_attention_with_lse(
+                qc, kc, vc, diag and causal, sm_scale, block_q, block_k, interpret
+            )
+        return _dense_chunk_attn(qc, kc, vc, diag and causal, sm_scale)
+
+    if cp == 1:
+        o, _ = chunk(q, k, v, True)
+        return o
+
+    idx = jax.lax.axis_index(CONTEXT_AXIS)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    # Prefetch step-1 KV before computing on the current chunk: the ppermute
+    # and the diagonal-chunk flash kernel have no data dependence, so the ICI
+    # transfer hides under the MXU work.  The accumulator stays fp32 across
+    # the whole ring; one cast at the end.
+    k_next, v_next = jax.lax.ppermute((k, v), CONTEXT_AXIS, perm)
+    o, lse = chunk(q, k, v, True)
+    o = o.astype(jnp.float32)
+    for t in range(1, cp):
+        k, v = k_next, v_next
+        if t < cp - 1:
+            k_next, v_next = jax.lax.ppermute((k, v), CONTEXT_AXIS, perm)
+        o_t, lse_t = chunk(q, k, v, False)
+        if causal:
+            # KV now came from device (idx - t) mod cp; a chunk strictly to
+            # the left is fully visible, anything else fully masked.
+            src = (idx - t) % cp
+            lse_t = jnp.where(src < idx, lse_t, NEG_INF)
+        o, lse = _combine(o, lse, o_t, lse_t)
+    return o.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    use_flash: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Context-parallel attention in model layout: ``q [B, S, NQ, D]``,
+    ``k/v [B, S, NKV, D]`` (``NQ`` a multiple of ``NKV``), sequence dim
+    sharded over ``cp`` → ``[B, S, NQ, D]``.
+
+    Heads shard over ``tp`` (q heads are kv-major, so the flat NQ dim carries
+    ``(tp, kvr)`` like ``qkv.Q_HEAD_AXES``); batch over ``dp``/``ep``.  With
+    ``cp == 1`` this degrades to plain (flash) attention — safe to call
+    unconditionally.
+
+    ``use_flash`` defaults to True (pallas kernel; interpreted off-TPU).
+    """
+    mesh = get_mesh()
+    cp = mesh.shape[CONTEXT_AXIS]
+    B, S, NQ, D = q.shape
+    scale = (D ** -0.5) if sm_scale is None else sm_scale
+
+    if S % cp != 0:
+        raise ValueError(f"sequence length {S} not divisible by cp degree {cp}")
+
+    # [B, S, H, D] -> [B, H, S, D] kernel layout
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    # Manual only over the axes the ring needs; batch/pipeline axes stay
+    # under GSPMD so the op composes inside any jit regardless of how the
+    # caller shards the batch dim.
+    q_spec = P(None, (TENSOR_AXIS, KV_REPLICA_AXIS), CONTEXT_AXIS, None)
+    kv_spec = P(None, TENSOR_AXIS, CONTEXT_AXIS, None)
+
+    def body(qs, ks, vs):
+        return _ring_shard(
+            qs, ks, vs, cp=cp, causal=causal, sm_scale=scale,
+            use_flash=use_flash, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+
+    o = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        axis_names=frozenset({CONTEXT_AXIS, TENSOR_AXIS, KV_REPLICA_AXIS}),
+        check_vma=False,
+    )(qt, kt, vt)
+    return o.transpose(0, 2, 1, 3)
