@@ -149,6 +149,9 @@ class CoreAttention(nn.Module):
         if cfg.attention_impl == "flash" and allow_flash:
             from neuronx_distributed_tpu.ops.ring_attention import ring_attention
 
+            # ring_attention has no query-offset notion; only the q-aligned
+            # training case may take this path
+            assert q_offset == 0, "flash path requires q_offset == 0"
             return ring_attention(q, k, v, causal=True)
         B, S, NQ, D = q.shape
         T = k.shape[1]

@@ -45,7 +45,6 @@ from neuronx_distributed_tpu.ops.flash_attention import (
     flash_attention_with_lse,
 )
 from neuronx_distributed_tpu.parallel.mesh import (
-    BATCH_AXES,
     CONTEXT_AXIS,
     KV_REPLICA_AXIS,
     TENSOR_AXIS,

@@ -1,0 +1,25 @@
+"""Inference trace/serve layer (reference L7a, ``trace/trace.py``):
+AOT-compiled context+decode serving with donated KV caches, jax.export
+serialization, and a latency benchmark harness."""
+
+from neuronx_distributed_tpu.trace.engine import (
+    InferenceConfig,
+    ParallelInferenceModel,
+    init_kv_caches,
+    parallel_model_trace,
+)
+from neuronx_distributed_tpu.trace.export import (
+    LoadedInferenceModel,
+    parallel_model_load,
+    parallel_model_save,
+)
+
+__all__ = [
+    "InferenceConfig",
+    "ParallelInferenceModel",
+    "LoadedInferenceModel",
+    "init_kv_caches",
+    "parallel_model_trace",
+    "parallel_model_save",
+    "parallel_model_load",
+]

@@ -1,0 +1,284 @@
+"""Inference trace engine: AOT-compiled context-encoding + token-generation.
+
+TPU-native replacement for the reference's inference stack
+(``src/neuronx_distributed/trace/trace.py:24-214`` and the split
+context/decode models of
+``examples/inference/llama2/neuron_modeling_llama.py:292-342,437-465``).
+Where the reference spawns one process per TP rank, traces each shard through
+``torch_neuronx`` into a NEFF and juggles concurrent collective loading
+(``trace.py:32-53``), here one SPMD program per phase is lowered ahead of time
+with ``jax.jit(...).lower(...).compile()`` over the global mesh — the XLA TPU
+compiler plays neuronx-cc, and GSPMD plays the per-shard process fleet.
+
+Two executables, mirroring the reference's split:
+
+- **context**: prefill the padded prompt, build the KV caches, return the
+  last-position logits;
+- **decode**: one token step against the caches; the caches are DONATED so
+  XLA aliases the update in place — the functional analogue of the
+  reference's KV-cache-as-aliased-parameters trick
+  (``neuron_modeling_llama.py:437-450``).
+
+The decode offset is a traced scalar, so one compiled program serves every
+step (static shapes, dynamic position). Prompts are batch-uniform in length
+(the reference's benchmark convention); per-example padding masks are a
+planned extension.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.parallel.mesh import (
+    BATCH_AXES,
+    TENSOR_AXIS,
+    get_mesh,
+    model_parallel_is_initialized,
+    named_sharding,
+)
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def parallel_model_trace(
+    fn: Callable,
+    *example_args,
+    donate_argnums: Sequence[int] = (),
+    static_argnums: Sequence[int] = (),
+):
+    """AOT-compile ``fn`` for the given example arguments (shapes/dtypes are
+    taken from them; values are ignored).
+
+    Functional analogue of the reference's ``parallel_model_trace``
+    (``trace/trace.py:118-186``): instead of per-rank subprocesses feeding
+    neuronx-cc, the jit is lowered once over the live mesh and the XLA
+    compiler emits the sharded program. Returns the compiled executable
+    (callable with real arrays)."""
+    jitted = jax.jit(
+        fn, donate_argnums=tuple(donate_argnums), static_argnums=tuple(static_argnums)
+    )
+    shapes = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+        if not isinstance(x, jax.ShapeDtypeStruct)
+        else x,
+        example_args,
+    )
+    lowered = jitted.lower(*shapes)
+    compiled = lowered.compile()
+    logger.info(
+        "traced %s: %s flops (per XLA cost analysis)",
+        getattr(fn, "__name__", "fn"),
+        (compiled.cost_analysis() or {}).get("flops", "n/a"),
+    )
+    return compiled
+
+
+@dataclasses.dataclass(frozen=True)
+class InferenceConfig:
+    """Serving shapes — fixed at trace time, like the reference's compiled
+    context/decode NEFF pair."""
+
+    batch_size: int
+    context_len: int
+    max_total_len: int
+    kv_cache_dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.max_total_len < self.context_len:
+            raise ValueError(
+                f"max_total_len ({self.max_total_len}) < context_len ({self.context_len})"
+            )
+
+
+def init_kv_caches(
+    num_layers: int,
+    batch_size: int,
+    max_total_len: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype: Any = jnp.bfloat16,
+):
+    """Zero KV caches ``[B, T, NKV, D]`` per layer, kv-heads sharded over tp
+    and batch over dp when a mesh is live."""
+    shape = (batch_size, max_total_len, num_kv_heads, head_dim)
+    caches = [
+        (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)) for _ in range(num_layers)
+    ]
+    if model_parallel_is_initialized():
+        spec = named_sharding(BATCH_AXES, None, TENSOR_AXIS, None)
+        caches = jax.tree.map(lambda x: jax.device_put(x, spec), caches)
+    return caches
+
+
+class _ServingBase:
+    """Shared generate/benchmark loop over ``(context, decode)`` executables;
+    concrete classes provide ``self.context``, ``self.decode``,
+    ``self.params`` and ``self.config``."""
+
+    config: InferenceConfig
+    params: Any
+    context: Callable
+    decode: Callable
+
+    def _sample(self, logits, rng, temperature):
+        if temperature > 0.0 and rng is None:
+            raise ValueError("temperature sampling requires an rng key")
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(rng, logits / temperature, axis=-1).astype(jnp.int32)
+
+    def generate(
+        self,
+        prompt_ids: jax.Array,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        rng: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Prefill + fixed-length decode; returns ``[B, C + max_new_tokens]``.
+        (The reference drives its compiled pair through HF ``generate``,
+        ``neuron_modeling_llama.py:437-465``; the loop here is explicit.)"""
+        cfg = self.config
+        B, C = prompt_ids.shape
+        if (B, C) != (cfg.batch_size, cfg.context_len):
+            raise ValueError(
+                f"prompt shape {(B, C)} does not match traced shape "
+                f"{(cfg.batch_size, cfg.context_len)}"
+            )
+        if C + max_new_tokens > cfg.max_total_len:
+            raise ValueError(
+                f"context {C} + new {max_new_tokens} exceeds max_total_len {cfg.max_total_len}"
+            )
+        logits, caches = self.context(self.params, prompt_ids.astype(jnp.int32))
+        toks = [prompt_ids]
+        for step in range(max_new_tokens):
+            step_rng = jax.random.fold_in(rng, step) if rng is not None else None
+            nxt = self._sample(logits, step_rng, temperature)[:, None]
+            toks.append(nxt)
+            if step == max_new_tokens - 1:
+                break
+            logits, caches = self.decode(
+                self.params, nxt, jnp.int32(C + step), caches
+            )
+        return jnp.concatenate(toks, axis=1)
+
+    def benchmark(
+        self, max_new_tokens: int = 64, warmup: int = 1, prompt_ids=None
+    ) -> dict:
+        """Decode latency/throughput — the neuronperf-equivalent harness
+        (reference ``examples/inference/benchmark.py:53-77``): per-token
+        p50/p99 ms, context-encode ms, tokens/s."""
+        cfg = self.config
+        if prompt_ids is None:
+            prompt_ids = jnp.zeros((cfg.batch_size, cfg.context_len), jnp.int32)
+        for _ in range(warmup):
+            jax.block_until_ready(self.generate(prompt_ids, min(2, max_new_tokens)))
+
+        t0 = time.perf_counter()
+        logits, caches = jax.block_until_ready(
+            self.context(self.params, prompt_ids)
+        )
+        context_ms = (time.perf_counter() - t0) * 1e3
+
+        lat = []
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        for step in range(max_new_tokens):
+            t0 = time.perf_counter()
+            logits, caches = self.decode(
+                self.params, nxt, jnp.int32(cfg.context_len + step), caches
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+            jax.block_until_ready(nxt)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        lat_arr = np.asarray(lat)
+        total_s = lat_arr.sum() / 1e3
+        return {
+            "context_ms": context_ms,
+            "token_p50_ms": float(np.percentile(lat_arr, 50)),
+            "token_p99_ms": float(np.percentile(lat_arr, 99)),
+            "tokens_per_s": float(cfg.batch_size * max_new_tokens / total_s),
+            "new_tokens": max_new_tokens,
+            "batch_size": cfg.batch_size,
+        }
+
+
+class ParallelInferenceModel(_ServingBase):
+    """Compiled serving wrapper — the ``TensorParallelNeuronModel`` analogue
+    (``trace/trace.py:24-68``), holding the context + decode executables and
+    a greedy/temperature ``generate`` loop.
+
+    ``module`` must follow the framework KV-cache protocol (as
+    ``LlamaForCausalLM`` does): ``apply(params, ids, positions, kv_caches,
+    cache_offset) -> (logits, new_caches)``.
+    """
+
+    def __init__(
+        self,
+        module,
+        params,
+        config: InferenceConfig,
+        num_layers: Optional[int] = None,
+        num_kv_heads: Optional[int] = None,
+        head_dim: Optional[int] = None,
+    ):
+        mcfg = getattr(module, "config", None)
+        self.module = module
+        self.params = params
+        self.config = config
+        self.num_layers = num_layers if num_layers is not None else mcfg.num_layers
+        self.num_kv_heads = num_kv_heads if num_kv_heads is not None else mcfg.num_kv_heads
+        self.head_dim = head_dim if head_dim is not None else mcfg.head_dim_
+        self._build()
+
+    # -- phase functions (pure; also used by the export path) --------------
+
+    def _context_fn(self, params, ids):
+        B, C = ids.shape
+        positions = jnp.broadcast_to(jnp.arange(C), (B, C))
+        caches = init_kv_caches(
+            self.num_layers, B, self.config.max_total_len, self.num_kv_heads,
+            self.head_dim, self.config.kv_cache_dtype,
+        )
+        logits, caches = self.module.apply(params, ids, positions, caches, 0)
+        return logits[:, -1, :], caches
+
+    def _decode_fn(self, params, tok, offset, caches):
+        B = tok.shape[0]
+        positions = jnp.broadcast_to(offset, (B, 1)).astype(jnp.int32)
+        logits, caches = self.module.apply(params, tok, positions, caches, offset)
+        return logits[:, -1, :], caches
+
+    def _build(self):
+        from jax.sharding import NamedSharding
+
+        def sds(x):
+            # carry mesh shardings into the AOT signature — compiled
+            # executables are strict about argument placement
+            sh = getattr(x, "sharding", None)
+            sh = sh if isinstance(sh, NamedSharding) else None
+            return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x), sharding=sh)
+
+        cfg = self.config
+        B, C, T = cfg.batch_size, cfg.context_len, cfg.max_total_len
+        ids_spec = jax.ShapeDtypeStruct((B, C), jnp.int32)
+        tok_spec = jax.ShapeDtypeStruct((B, 1), jnp.int32)
+        off_spec = jax.ShapeDtypeStruct((), jnp.int32)
+        cache_spec = jax.tree.map(
+            sds,
+            init_kv_caches(self.num_layers, B, T, self.num_kv_heads, self.head_dim,
+                           cfg.kv_cache_dtype),
+        )
+        params_spec = jax.tree.map(sds, self.params)
+        self.context = parallel_model_trace(self._context_fn, params_spec, ids_spec)
+        # donate caches (arg 3) → in-place KV update
+        self.decode = parallel_model_trace(
+            self._decode_fn, params_spec, tok_spec, off_spec, cache_spec,
+            donate_argnums=(3,),
+        )
+        self._arg_specs = (params_spec, ids_spec, tok_spec, off_spec, cache_spec)
