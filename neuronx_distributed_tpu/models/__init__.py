@@ -1,0 +1,28 @@
+"""Model families (capability evidence mirroring the reference's example
+ports, SURVEY §2.16: Llama-2/3 training+inference, GPT-NeoX, BERT)."""
+
+from neuronx_distributed_tpu.models.bert import (
+    BertConfig,
+    BertForPreTraining,
+    BertModel,
+)
+from neuronx_distributed_tpu.models.gpt_neox import (
+    GPTNeoXConfig,
+    GPTNeoXForCausalLM,
+)
+from neuronx_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+)
+
+__all__ = [
+    "BertConfig",
+    "BertForPreTraining",
+    "BertModel",
+    "GPTNeoXConfig",
+    "GPTNeoXForCausalLM",
+    "LlamaConfig",
+    "LlamaForCausalLM",
+    "LlamaModel",
+]

@@ -1,0 +1,79 @@
+"""Shared model-building blocks across the model families."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel.layers import shard_activation
+from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy
+from neuronx_distributed_tpu.parallel.mesh import TENSOR_AXES
+
+
+def maybe_remat(block_cls, remat: str, static_argnums: Tuple[int, ...] = ()):
+    """Apply the configured rematerialization mode to a transformer block
+    class.  'full' recomputes everything in bwd; 'selective' saves matmul
+    outputs (the XLA analogue of the reference checkpointing
+    CoreAttention+MLP only, ``modeling_llama_nxd.py:184-214``).
+
+    ``static_argnums`` indexes ``__call__``'s python-static args counting the
+    module itself as arg 0 (flax's convention)."""
+    if remat not in ("none", "selective", "full"):
+        raise ValueError(f"unknown remat mode {remat!r}")
+    if remat == "none":
+        return block_cls
+    policy = (
+        None
+        if remat == "full"
+        else jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    )
+    return nn.remat(block_cls, policy=policy, prevent_cse=False,
+                    static_argnums=static_argnums)
+
+
+def causal_lm_loss(module, params, batch, rng=None) -> jax.Array:
+    """Next-token loss over vocab-sharded logits; ``batch = {ids, labels[,
+    mask]}``, labels < 0 (ignore convention) drop out of the mean.  Works for
+    any causal-LM module whose ``apply(params, ids)`` returns logits."""
+    logits = module.apply(params, batch["ids"])
+    labels = batch["labels"]
+    per_tok = parallel_cross_entropy(logits, labels)
+    mask = batch.get("mask")
+    if mask is None:
+        mask = (labels >= 0).astype(jnp.float32)
+    else:
+        mask = mask.astype(jnp.float32) * (labels >= 0)
+    return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def dense_mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Multi-head attention core, ``q/k/v [B, S, N, D]`` with heads sharded
+    over the TP axes (each shard computes its own heads, no collective —
+    the layout the reference's per-rank ``CoreAttention`` computes on,
+    ``examples/training/tp_dp_bert_hf_pretrain/tp_dp_bert_large_hf_pretrain_hdf5.py:419``).
+
+    ``mask``: optional boolean, broadcastable to ``[B, N, S, T]``, True =
+    attend.  fp32 softmax regardless of input dtype.
+    """
+    B, S, N, D = q.shape
+    T = k.shape[1]
+    q = shard_activation(q, P(P.UNCONSTRAINED, None, TENSOR_AXES, None))
+    scores = jnp.einsum("bsnd,btnd->bnst", q, k, preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(D).astype(jnp.float32)
+    if causal:
+        cmask = jnp.arange(T)[None, :] <= jnp.arange(S)[:, None] + (T - S)
+        scores = jnp.where(cmask[None, None], scores, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", probs, v, preferred_element_type=q.dtype)

@@ -290,17 +290,9 @@ class LlamaModel(nn.Module):
             name="embed",
         )(ids)
 
-        block_cls = LlamaBlock
-        if cfg.remat in ("selective", "full"):
-            # 'full' recomputes everything in bwd; 'selective' saves the
-            # matmul outputs inside the block (the XLA analogue of the
-            # reference checkpointing CoreAttention+MLP only).
-            policy = (
-                None
-                if cfg.remat == "full"
-                else jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
-            )
-            block_cls = nn.remat(LlamaBlock, policy=policy, prevent_cse=False)
+        from neuronx_distributed_tpu.models.common import maybe_remat
+
+        block_cls = maybe_remat(LlamaBlock, cfg.remat)
 
         new_caches = []
         for i in range(cfg.num_layers):
@@ -428,16 +420,5 @@ def build_pipelined_llama(cfg: LlamaConfig, num_microbatches: int, seed: int = 0
     )
 
 
-def causal_lm_loss(module: LlamaForCausalLM, params, batch, rng=None) -> jax.Array:
-    """Next-token loss with masking; batch = {ids, labels[, mask]}.
-
-    Labels < 0 (ignore convention) are masked out of the mean."""
-    logits = module.apply(params, batch["ids"])
-    labels = batch["labels"]
-    per_tok = parallel_cross_entropy(logits, labels)
-    mask = batch.get("mask")
-    if mask is None:
-        mask = (labels >= 0).astype(jnp.float32)
-    else:
-        mask = mask.astype(jnp.float32) * (labels >= 0)
-    return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+# shared next-token loss (batch = {ids, labels[, mask]}, labels < 0 ignored)
+from neuronx_distributed_tpu.models.common import causal_lm_loss  # noqa: E402,F401

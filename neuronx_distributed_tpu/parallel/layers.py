@@ -220,15 +220,18 @@ class ParallelEmbedding(nn.Module):
     param_dtype: Dtype = jnp.float32
     embedding_init: Initializer = nn.initializers.normal(stddev=0.02)
 
-    @nn.compact
-    def __call__(self, ids: jax.Array) -> jax.Array:
-        embedding = self.param(
+    def setup(self):
+        # setup-style (not compact) so ``attend`` can reuse the table for
+        # tied LM heads
+        self.embedding = self.param(
             "embedding",
             nn.with_partitioning(self.embedding_init, (TENSOR_AXES, None)),
             (self.num_embeddings, self.features),
             self.param_dtype,
         )
-        y = jnp.take(jnp.asarray(embedding, self.dtype), ids, axis=0)
+
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        y = jnp.take(jnp.asarray(self.embedding, self.dtype), ids, axis=0)
         if self.sequence_parallel_output:
             # Model enters its first SP region right after the embedding
             # (reference scatter_to_sequence_parallel_region,
@@ -237,3 +240,14 @@ class ParallelEmbedding(nn.Module):
         else:
             y = shard_activation(y, trailing_spec(y.ndim, last=None))
         return y
+
+    def attend(self, x: jax.Array) -> jax.Array:
+        """Project hidden states onto the (tied) table: ``[..., H] →
+        [..., V]`` with the vocab dim sharded — the tied-embedding LM head
+        (the reference handles tying via shared-weight registration,
+        ``pipeline/partition.py:225-250``; here it is literal param reuse)."""
+        y = jnp.einsum(
+            "...h,vh->...v", x.astype(self.dtype), jnp.asarray(self.embedding, self.dtype),
+            preferred_element_type=self.dtype,
+        )
+        return shard_activation(y, trailing_spec(y.ndim, last=TENSOR_AXES))

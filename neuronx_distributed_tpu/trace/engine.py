@@ -275,10 +275,13 @@ class ParallelInferenceModel(_ServingBase):
                            cfg.kv_cache_dtype),
         )
         params_spec = jax.tree.map(sds, self.params)
-        self.context = parallel_model_trace(self._context_fn, params_spec, ids_spec)
-        # donate caches (arg 3) → in-place KV update
-        self.decode = parallel_model_trace(
-            self._decode_fn, params_spec, tok_spec, off_spec, cache_spec,
-            donate_argnums=(3,),
-        )
+        # keep the jitted phase fns: lower+compile here, and the export path
+        # reuses them (their lowering cache) instead of re-jitting from scratch
+        self._context_jit = jax.jit(self._context_fn)
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(3,))
+        self.context = self._context_jit.lower(params_spec, ids_spec).compile()
+        # donated caches (arg 3) → in-place KV update
+        self.decode = self._decode_jit.lower(
+            params_spec, tok_spec, off_spec, cache_spec
+        ).compile()
         self._arg_specs = (params_spec, ids_spec, tok_spec, off_spec, cache_spec)

@@ -41,8 +41,9 @@ def parallel_model_save(path: str, model: ParallelInferenceModel) -> str:
     os.makedirs(path, exist_ok=True)
     params_spec, ids_spec, tok_spec, off_spec, cache_spec = model._arg_specs
 
-    ctx_exp = jax_export.export(jax.jit(model._context_fn))(params_spec, ids_spec)
-    dec_exp = jax_export.export(jax.jit(model._decode_fn, donate_argnums=(3,)))(
+    # export from the model's own jitted phase fns (shares their trace cache)
+    ctx_exp = jax_export.export(model._context_jit)(params_spec, ids_spec)
+    dec_exp = jax_export.export(model._decode_jit)(
         params_spec, tok_spec, off_spec, cache_spec
     )
     with open(os.path.join(path, _CONTEXT), "wb") as f:
