@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
+from neuronx_distributed_tpu.models.common import causal_lm_loss, maybe_remat  # noqa: F401
 from neuronx_distributed_tpu.parallel.layers import (
     ColumnParallelLinear,
     ParallelEmbedding,
@@ -290,8 +291,6 @@ class LlamaModel(nn.Module):
             name="embed",
         )(ids)
 
-        from neuronx_distributed_tpu.models.common import maybe_remat
-
         block_cls = maybe_remat(LlamaBlock, cfg.remat)
 
         new_caches = []
@@ -420,5 +419,3 @@ def build_pipelined_llama(cfg: LlamaConfig, num_microbatches: int, seed: int = 0
     )
 
 
-# shared next-token loss (batch = {ids, labels[, mask]}, labels < 0 ignored)
-from neuronx_distributed_tpu.models.common import causal_lm_loss  # noqa: E402,F401

@@ -40,6 +40,11 @@ from neuronx_distributed_tpu.parallel.mesh import (
     named_sharding,
 )
 from neuronx_distributed_tpu.parallel.norm import LayerNorm, RMSNorm
+from neuronx_distributed_tpu.parallel.pad import (
+    pad_axis_to,
+    pad_llama_params,
+    pad_to_multiple,
+)
 from neuronx_distributed_tpu.parallel.qkv import (
     GQAQKVColumnParallelLinear,
     KV_HEAD_AXES,
@@ -80,5 +85,8 @@ __all__ = [
     "vocab_parallel_cross_entropy",
     "LayerNorm",
     "RMSNorm",
+    "pad_axis_to",
+    "pad_llama_params",
+    "pad_to_multiple",
     "mappings",
 ]

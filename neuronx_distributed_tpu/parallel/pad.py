@@ -1,0 +1,80 @@
+"""Attention-head padding so ``num_heads`` divides the TP degree.
+
+Reference: ``parallel_layers/pad.py:7-103`` (``pad_model`` walks torch
+modules, zero-padding QKV output dims and o-proj input dims to the padded
+head count).  The functional form here transforms a params pytree: Q/K/V
+kernels (head dims) gain zero slices, the attention output projection
+(input-side head dim) gains zero rows — so the padded model's outputs are
+bit-identical: padded q/k/v heads produce attention outputs that meet only
+zero rows in the o-projection.
+
+GQA note: q heads are kv-major (q head ``j*G + g`` reads kv head ``j``), so
+padding must keep the group size ``G = num_heads / num_kv_heads`` constant —
+kv heads pad from ``NKV`` to ``NKV'`` and q heads from ``NKV*G`` to
+``NKV'*G``; appended (zero) q-head groups then pair exactly with the
+appended (zero) kv heads and every real pairing is preserved.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+# reference ``get_number_of_extra_heads`` arithmetic (``pad.py:15-24``)
+from neuronx_distributed_tpu.utils.common import pad_to_multiple  # noqa: F401
+
+
+def pad_axis_to(x: jax.Array, axis: int, new_size: int) -> jax.Array:
+    """Zero-pad ``x`` along ``axis`` up to ``new_size``."""
+    old = x.shape[axis]
+    if old == new_size:
+        return x
+    if old > new_size:
+        raise ValueError(f"cannot pad axis {axis} from {old} down to {new_size}")
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, new_size - old)
+    return jnp.pad(x, pads)
+
+
+def pad_llama_params(
+    params: Any,
+    old_heads: int,
+    new_heads: int,
+    head_dim: int,
+    old_kv_heads: Optional[int] = None,
+    new_kv_heads: Optional[int] = None,
+) -> Any:
+    """Pad a Llama params tree from ``old_heads`` to ``new_heads`` q heads
+    (MHA: kv counts default to the q counts).  The group size must stay
+    constant: ``new_heads / new_kv_heads == old_heads / old_kv_heads`` —
+    that is what keeps the padded model's function identical (see module
+    docstring).  Run the result under a config with the padded counts."""
+    old_kv = old_heads if old_kv_heads is None else old_kv_heads
+    new_kv = new_heads if new_kv_heads is None else new_kv_heads
+    if old_heads % old_kv or new_heads % new_kv:
+        raise ValueError("q heads must be a multiple of kv heads")
+    if old_heads // old_kv != new_heads // new_kv:
+        raise ValueError(
+            f"padding must preserve the q-per-kv group size: "
+            f"{old_heads}/{old_kv} != {new_heads}/{new_kv}"
+        )
+
+    def _pad(path_key, leaf):
+        if re.search(r"qkv/q_(kernel|bias)$", path_key):
+            return pad_axis_to(leaf, leaf.ndim - 2, new_heads)
+        if re.search(r"qkv/(k|v)_(kernel|bias)$", path_key):
+            return pad_axis_to(leaf, leaf.ndim - 2, new_kv)
+        if re.search(r"o_proj/kernel$", path_key):
+            return pad_axis_to(leaf, 0, new_heads * head_dim)
+        return leaf
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    out = []
+    for path, leaf in flat:
+        key = "/".join(getattr(p, "key", str(getattr(p, "idx", p))) for p in path)
+        out.append(_pad(key, leaf))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,43 @@
+"""Cross-cutting utils (reference ``utils/``, SURVEY §2.15): logging,
+Chrome-trace timeline, pytree serialization, multihost coordination."""
+
+from neuronx_distributed_tpu.utils.common import (
+    divide,
+    ensure_divisibility,
+    pad_to_multiple,
+)
+from neuronx_distributed_tpu.utils.distributed import (
+    broadcast_from_host0,
+    initialize_distributed,
+    is_primary,
+    rendezvous,
+)
+from neuronx_distributed_tpu.utils.logger import get_logger
+from neuronx_distributed_tpu.utils.serialization import (
+    TensorMeta,
+    decode_obj,
+    deserialize_tree,
+    encode_obj,
+    find_loss_from_output_and_spec,
+    serialize_tree,
+)
+from neuronx_distributed_tpu.utils.timeline import Timeline, device_trace
+
+__all__ = [
+    "divide",
+    "ensure_divisibility",
+    "pad_to_multiple",
+    "broadcast_from_host0",
+    "initialize_distributed",
+    "is_primary",
+    "rendezvous",
+    "get_logger",
+    "TensorMeta",
+    "serialize_tree",
+    "deserialize_tree",
+    "encode_obj",
+    "decode_obj",
+    "find_loss_from_output_and_spec",
+    "Timeline",
+    "device_trace",
+]
