@@ -1,0 +1,17 @@
+"""Data pipeline: native (C++) memory-mapped token-dataset loader with
+deterministic DP sharding and background prefetch; numpy fallback with
+identical semantics."""
+
+from neuronx_distributed_tpu.data.loader import (
+    TokenDataLoader,
+    TokenDataset,
+    read_token_file,
+    write_token_file,
+)
+
+__all__ = [
+    "TokenDataLoader",
+    "TokenDataset",
+    "read_token_file",
+    "write_token_file",
+]

@@ -60,10 +60,13 @@ def deserialize_tree(skeleton: Any, arrays: List[Any]) -> Any:
     """Inverse of :func:`serialize_tree`: re-substitute ``arrays`` for the
     :class:`TensorMeta` stubs (order must match)."""
     it = iter(arrays)
+    _END = object()
 
     def one(x):
         if isinstance(x, TensorMeta):
-            arr = next(it)
+            arr = next(it, _END)
+            if arr is _END:
+                raise ValueError("fewer arrays than TensorMeta stubs")
             got = TensorMeta.of(arr)
             if got != x:
                 raise ValueError(f"array mismatch: expected {x}, got {got}")

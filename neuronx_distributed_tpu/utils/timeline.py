@@ -63,13 +63,16 @@ class Timeline:
         if not self.enabled:
             return
         with self._lock:
-            self._open_events[name] = self._now_us()
+            # key by (name, thread): same-named regions may run concurrently
+            # on prefetch/worker threads
+            self._open_events[(name, threading.get_ident())] = self._now_us()
 
     def mark_event_end(self, name: str) -> None:
         if not self.enabled:
             return
+        tid = threading.get_ident()
         with self._lock:
-            start = self._open_events.pop(name, None)
+            start = self._open_events.pop((name, tid), None)
             if start is None:
                 logger.warning("timeline: end without start for %r", name)
                 return
@@ -81,7 +84,7 @@ class Timeline:
                     "ts": start,
                     "dur": self._now_us() - start,
                     "pid": jax.process_index(),
-                    "tid": threading.get_ident() % 2**31,
+                    "tid": tid % 2**31,
                 }
             )
 

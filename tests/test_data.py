@@ -1,0 +1,151 @@
+"""Native C++ data loader tests: file-format roundtrip, epoch coverage /
+DP-partition correctness, determinism, resume-skip, and bit-identical parity
+between the native and numpy paths (same splitmix64 Fisher-Yates)."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.data import (
+    TokenDataLoader,
+    TokenDataset,
+    read_token_file,
+    write_token_file,
+)
+from neuronx_distributed_tpu.data.loader import _load_native, _shuffled_chunks
+
+
+@pytest.fixture
+def token_file(tmp_path):
+    toks = np.arange(1, 4097, dtype=np.int32) % 50000
+    path = str(tmp_path / "corpus.nxdt")
+    write_token_file(path, toks)
+    return path, toks
+
+
+def test_token_file_roundtrip(token_file):
+    path, toks = token_file
+    back = read_token_file(path)
+    np.testing.assert_array_equal(back.astype(np.int64), toks.astype(np.int64))
+
+
+def test_native_library_builds():
+    """The C++ loader must compile on this toolchain (g++ is baked in); the
+    numpy fallback is for g++-less environments only."""
+    assert _load_native() is not None
+
+
+def _collect(loader):
+    return list(loader)
+
+
+def test_epoch_covers_every_chunk_once(token_file):
+    path, toks = token_file
+    ds = TokenDataset(path)
+    seq = 64
+    total = ds.num_chunks(seq)
+    seen = []
+    for rank in range(4):
+        dl = TokenDataLoader(ds, batch_size=2, seq_len=seq, dp_rank=rank,
+                             dp_size=4, seed=7)
+        for b in dl:
+            assert b["ids"].shape == (2, seq) and b["labels"].shape == (2, seq)
+            # label shift invariant
+            np.testing.assert_array_equal(b["ids"][:, 1:], b["labels"][:, :-1])
+            seen.extend(b["ids"][:, 0].tolist())
+        dl.close()
+    # chunk i starts at token i*seq -> starting tokens identify chunks; all
+    # distinct means no chunk was served twice across ranks
+    assert len(seen) == len(set(seen))
+    assert len(seen) >= (total // 2 // 4) * 2 * 4 - 8  # whole-batch truncation only
+    ds.close()
+
+
+def test_determinism_and_epoch_variation(token_file):
+    path, _ = token_file
+    ds = TokenDataset(path)
+
+    def run(epoch):
+        dl = TokenDataLoader(ds, batch_size=2, seq_len=32, seed=123)
+        dl.set_epoch(epoch)
+        out = np.concatenate([b["ids"] for b in dl])
+        dl.close()
+        return out
+
+    a, b = run(0), run(0)
+    np.testing.assert_array_equal(a, b)
+    c = run(1)
+    assert not np.array_equal(a, c)
+    ds.close()
+
+
+def test_native_matches_numpy_fallback(token_file):
+    path, toks = token_file
+    ds = TokenDataset(path)
+    assert ds.is_native
+    dl = TokenDataLoader(ds, batch_size=2, seq_len=32, dp_rank=1, dp_size=2, seed=5)
+    dl.set_epoch(3)
+    native = np.concatenate([b["ids"] for b in dl])
+    dl.close()
+    ds.close()
+
+    # numpy fallback reconstruction from the shared shuffle
+    total = (toks.size - 1) // 32
+    order = _shuffled_chunks(total, seed=5, epoch=3)
+    mine = order[1::2]
+    mine = mine[: (len(mine) // 2) * 2]
+    want = np.stack([toks[int(c) * 32:int(c) * 32 + 32] for c in mine]).astype(np.int32)
+    np.testing.assert_array_equal(native, want.reshape(native.shape))
+
+
+def test_skip_resume(token_file):
+    path, _ = token_file
+    ds = TokenDataset(path)
+    dl = TokenDataLoader(ds, batch_size=2, seq_len=32, seed=9)
+    dl.set_epoch(0)
+    full = [b["ids"] for b in dl]
+    dl.set_epoch(0, skip_batches=3)
+    resumed = [b["ids"] for b in dl]
+    assert len(resumed) == len(full) - 3
+    for a, b in zip(full[3:], resumed):
+        np.testing.assert_array_equal(a, b)
+    dl.close()
+    ds.close()
+
+
+def test_uint16_storage(tmp_path):
+    toks = np.arange(2000, dtype=np.uint16)
+    path = str(tmp_path / "small.nxdt")
+    write_token_file(path, toks)
+    ds = TokenDataset(path)
+    dl = TokenDataLoader(ds, batch_size=1, seq_len=100, seed=0)
+    batch = next(iter(dl))
+    assert batch["ids"].dtype == np.int32
+    dl.close()
+    ds.close()
+
+
+def test_bad_file_rejected(tmp_path):
+    path = str(tmp_path / "junk.nxdt")
+    with open(path, "wb") as f:
+        f.write(b"garbage-not-a-token-file-0123456789")
+    with pytest.raises(ValueError):
+        TokenDataset(path)
+
+
+def test_exhausted_until_set_epoch(token_file):
+    """Both paths are single-shot per set_epoch (identical semantics)."""
+    path, _ = token_file
+    ds = TokenDataset(path)
+    dl = TokenDataLoader(ds, batch_size=2, seq_len=32, seed=9)
+    dl.set_epoch(0)
+    assert len(list(dl)) == dl.num_batches
+    assert list(dl) == []  # exhausted
+    dl.set_epoch(1)
+    assert len(list(dl)) == dl.num_batches
+    dl.close()
+    ds.close()
+
+
+def test_negative_tokens_rejected(tmp_path):
+    with pytest.raises(ValueError, match="non-negative"):
+        write_token_file(str(tmp_path / "bad.nxdt"), np.array([5, -1, 7]))
