@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Inference runner CLI — trace / infer / benchmark / check-accuracy, the
+framework-native analogue of the reference's
+``examples/inference/runner.py:232-260`` command surface.
+
+  # trace and save a compiled serving artifact
+  python examples/inference/runner.py trace --preset tiny --tp 2 \
+      --batch-size 2 --context-len 32 --max-total-len 64 \
+      --out /tmp/traced --virtual-devices 8
+
+  # generate from the saved artifact
+  python examples/inference/runner.py infer --model /tmp/traced \
+      --max-new-tokens 16
+
+  # per-token latency stats
+  python examples/inference/runner.py benchmark --model /tmp/traced \
+      --max-new-tokens 64
+
+  # cached decode vs teacher-forced full forward
+  python examples/inference/runner.py check-accuracy --preset tiny --tp 2 \
+      --batch-size 2 --context-len 32 --max-total-len 64 --virtual-devices 8
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def build_model(args):
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.parallel.mesh import get_mesh
+    from neuronx_distributed_tpu.trace import InferenceConfig, ParallelInferenceModel
+
+    nxd.initialize_model_parallel(tensor_parallel_size=args.tp)
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = getattr(LlamaConfig, args.preset)(
+        max_seq_len=args.max_total_len,
+        sequence_parallel=False,
+        remat="none",
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    module = LlamaForCausalLM(cfg)
+    ids0 = jnp.zeros((args.batch_size, args.context_len), jnp.int32)
+    params = module.init(jax.random.PRNGKey(args.seed), ids0)
+    specs = nn.get_partition_spec(params)
+    mesh = get_mesh()
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        nn.unbox(params), specs,
+        is_leaf=lambda x: isinstance(x, P) or not isinstance(x, dict))
+    icfg = InferenceConfig(
+        batch_size=args.batch_size, context_len=args.context_len,
+        max_total_len=args.max_total_len,
+        kv_cache_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    return cfg, module, params, ParallelInferenceModel(module, params, icfg)
+
+
+def cmd_trace(args):
+    from neuronx_distributed_tpu.trace import parallel_model_save
+
+    _, _, _, model = build_model(args)
+    path = parallel_model_save(args.out, model)
+    print(f"saved traced model to {path}")
+
+
+def _prompt_ids(seed, batch_size, context_len, vocab):
+    import jax
+
+    return jax.random.randint(
+        jax.random.PRNGKey(seed + 1), (batch_size, context_len), 0, vocab)
+
+
+def cmd_infer(args):
+    import jax
+
+    from neuronx_distributed_tpu.trace import parallel_model_load
+
+    model = parallel_model_load(args.model)
+    cfg = model.config
+    prompt = _prompt_ids(args.seed, cfg.batch_size, cfg.context_len, 256)
+    out = model.generate(prompt, args.max_new_tokens,
+                         temperature=args.temperature,
+                         rng=jax.random.PRNGKey(args.seed) if args.temperature else None)
+    print(json.dumps({"generated": out[:, cfg.context_len:].tolist()}))
+
+
+def cmd_benchmark(args):
+    from neuronx_distributed_tpu.trace import parallel_model_load
+
+    model = parallel_model_load(args.model)
+    stats = model.benchmark(max_new_tokens=args.max_new_tokens)
+    print(json.dumps(stats, indent=2))
+
+
+def cmd_check_accuracy(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cfg, module, params, model = build_model(args)
+    prompt = _prompt_ids(args.seed, args.batch_size, args.context_len, cfg.vocab_size)
+    out = model.generate(prompt, args.max_new_tokens)
+    full = jax.jit(module.apply)(params, out)
+    ok = True
+    for t in range(args.context_len, args.context_len + args.max_new_tokens):
+        pred = np.asarray(jnp.argmax(full[:, t - 1, :], axis=-1))
+        if not (pred == np.asarray(out[:, t])).all():
+            ok = False
+            print(f"mismatch at position {t}")
+    print(json.dumps({"inference_success": int(ok)}))
+    sys.exit(0 if ok else 1)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp, traced=False):
+        sp.add_argument("--virtual-devices", type=int, default=None)
+        sp.add_argument("--seed", type=int, default=0)
+        sp.add_argument("--max-new-tokens", type=int, default=16)
+        if traced:
+            sp.add_argument("--model", required=True, help="saved artifact dir")
+        else:
+            sp.add_argument("--preset", default="tiny",
+                            choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b"])
+            sp.add_argument("--tp", type=int, default=1)
+            sp.add_argument("--batch-size", type=int, default=1)
+            sp.add_argument("--context-len", type=int, default=128)
+            sp.add_argument("--max-total-len", type=int, default=256)
+
+    sp = sub.add_parser("trace", help="compile + save a serving artifact")
+    common(sp)
+    sp.add_argument("--out", required=True)
+    sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("infer", help="generate from a saved artifact")
+    common(sp, traced=True)
+    sp.add_argument("--temperature", type=float, default=0.0)
+    sp.set_defaults(fn=cmd_infer)
+
+    sp = sub.add_parser("benchmark", help="p50/p99 per-token latency")
+    common(sp, traced=True)
+    sp.set_defaults(fn=cmd_benchmark)
+
+    sp = sub.add_parser("check-accuracy", help="cached decode vs teacher forcing")
+    common(sp)
+    sp.set_defaults(fn=cmd_check_accuracy)
+
+    args = p.parse_args()
+    if args.virtual_devices:
+        from neuronx_distributed_tpu.utils.common import ensure_virtual_devices
+
+        ensure_virtual_devices(args.virtual_devices)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""BERT-large MLM+NSP pretraining launcher (reference:
+``examples/training/tp_dp_bert_hf_pretrain/tp_dp_bert_large_hf_pretrain_hdf5.py``).
+
+  python examples/training/bert_pretrain.py --preset tiny --tp 2 \
+      --steps 20 --batch-size 8 --seq-len 128 --virtual-devices 8
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="tiny", choices=["tiny", "bert_large"])
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--mask-prob", type=float, default=0.15)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--virtual-devices", type=int, default=None)
+    args = p.parse_args()
+
+    from neuronx_distributed_tpu.utils.common import ensure_virtual_devices
+
+    if args.virtual_devices:
+        ensure_virtual_devices(args.virtual_devices)
+
+    import jax
+    import jax.numpy as jnp
+
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.bert import (
+        BertConfig,
+        BertForPreTraining,
+        pretraining_loss,
+    )
+    from neuronx_distributed_tpu.trainer import (
+        Throughput,
+        default_batch_spec,
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        make_train_step,
+    )
+    from neuronx_distributed_tpu.utils import initialize_distributed
+
+    initialize_distributed()
+    nxd.initialize_model_parallel(tensor_parallel_size=args.tp)
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = getattr(BertConfig, args.preset)(
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32, param_dtype=jnp.float32)
+    config = nxd.training_config(tensor_parallel_size=args.tp, learning_rate=args.lr)
+    model = initialize_parallel_model(
+        config, lambda: BertForPreTraining(cfg),
+        (jnp.zeros((1, args.seq_len), jnp.int32),), seed=args.seed)
+    opt = initialize_parallel_optimizer(config, model)
+    spec = default_batch_spec()
+    step_fn = make_train_step(
+        config, model, opt, pretraining_loss,
+        batch_spec={"ids": spec, "mlm_labels": spec, "nsp_labels": spec})
+
+    MASK = 103  # [MASK] in the BERT vocab
+    # skip the special-token id range on the real vocab; tiny vocabs have no
+    # such range to skip
+    lo = 999 if cfg.vocab_size > 1000 else MASK + 1
+
+    def next_batch(step):
+        k = jax.random.fold_in(jax.random.PRNGKey(args.seed), step)
+        k1, k2, k3 = jax.random.split(k, 3)
+        ids = jax.random.randint(k1, (args.batch_size, args.seq_len), lo, cfg.vocab_size)
+        mask = jax.random.bernoulli(k2, args.mask_prob, ids.shape)
+        labels = jnp.where(mask, ids, -100)
+        return {
+            "ids": jnp.where(mask, MASK, ids),
+            "mlm_labels": labels,
+            "nsp_labels": jax.random.randint(k3, (args.batch_size,), 0, 2),
+        }
+
+    params, state = model.params, opt.state
+    thr = Throughput(args.batch_size)
+    for step in range(args.steps):
+        params, state, m = step_fn(params, state, next_batch(step),
+                                   jax.random.fold_in(jax.random.PRNGKey(0), step))
+        seqs = thr.step()
+        if step % 10 == 0 or step == args.steps - 1:
+            print(json.dumps({"step": step, "loss": round(float(m["loss"]), 4),
+                              "seq_per_sec": round(seqs, 2)}), flush=True)
+    print(f"done: final loss {float(m['loss']):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""GPT-NeoX pretraining launcher (reference:
+``examples/training/tp_dp_gpt_neox_hf_pretrain/`` 6.9B/20B harnesses).
+
+  python examples/training/gpt_neox_pretrain.py --preset tiny --tp 2 \
+      --steps 20 --batch-size 8 --seq-len 128 --virtual-devices 8
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="tiny", choices=["tiny", "neox_6_9b", "neox_20b"])
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--no-sp", action="store_true")
+    p.add_argument("--no-zero1", action="store_true")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--data", default=None, help="NXDT token file (synthetic if unset)")
+    p.add_argument("--virtual-devices", type=int, default=None)
+    args = p.parse_args()
+
+    from neuronx_distributed_tpu.utils.common import ensure_virtual_devices
+
+    if args.virtual_devices:
+        ensure_virtual_devices(args.virtual_devices)
+
+    import jax
+    import jax.numpy as jnp
+
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.gpt_neox import (
+        GPTNeoXConfig,
+        GPTNeoXForCausalLM,
+        causal_lm_loss,
+    )
+    from neuronx_distributed_tpu.trainer import (
+        Throughput,
+        default_batch_spec,
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        make_train_step,
+    )
+    from neuronx_distributed_tpu.utils import initialize_distributed
+
+    initialize_distributed()
+    nxd.initialize_model_parallel(tensor_parallel_size=args.tp)
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = getattr(GPTNeoXConfig, args.preset)(
+        max_seq_len=args.seq_len,
+        sequence_parallel=not args.no_sp,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    config = nxd.training_config(
+        tensor_parallel_size=args.tp, learning_rate=args.lr,
+        zero_one_enabled=not args.no_zero1)
+    model = initialize_parallel_model(
+        config, lambda: GPTNeoXForCausalLM(cfg),
+        (jnp.zeros((1, args.seq_len), jnp.int32),), seed=args.seed)
+    opt = initialize_parallel_optimizer(config, model)
+    step_fn = make_train_step(
+        config, model, opt, causal_lm_loss,
+        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()})
+
+    if args.data:
+        from neuronx_distributed_tpu.data import TokenDataLoader, TokenDataset
+
+        loader = TokenDataLoader(TokenDataset(args.data), args.batch_size,
+                                 args.seq_len, seed=args.seed)
+        loader.set_epoch(0)
+        it = iter(loader)
+
+        def next_batch(step):
+            b = next(it)
+            return {"ids": jnp.asarray(b["ids"]), "labels": jnp.asarray(b["labels"])}
+    else:
+        def next_batch(step):
+            k = jax.random.fold_in(jax.random.PRNGKey(args.seed), step)
+            ids = jax.random.randint(k, (args.batch_size, args.seq_len), 0, cfg.vocab_size)
+            return {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+
+    params, state = model.params, opt.state
+    thr = Throughput(args.batch_size)
+    for step in range(args.steps):
+        params, state, m = step_fn(params, state, next_batch(step),
+                                   jax.random.fold_in(jax.random.PRNGKey(0), step))
+        seqs = thr.step()
+        if step % 10 == 0 or step == args.steps - 1:
+            print(json.dumps({"step": step, "loss": round(float(m["loss"]), 4),
+                              "seq_per_sec": round(seqs, 2)}), flush=True)
+    print(f"done: final loss {float(m['loss']):.4f}")
+
+
+if __name__ == "__main__":
+    main()

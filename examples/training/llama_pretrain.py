@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Llama pretraining launcher — the framework-native analogue of the
+reference's ``tp_zero1_llama2_7b_hf_pretrain.py`` / ``run_llama_nxd.py``
+harnesses: TP x SP x DP (+ ZeRO-1) training with checkpoint/resume, the
+native token data loader (or synthetic data), throughput/MFU metrics and an
+optional host timeline.
+
+Examples
+--------
+Synthetic smoke on the 8-device CPU mesh:
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python examples/training/llama_pretrain.py --preset tiny --tp 2 \
+      --steps 20 --batch-size 8 --seq-len 128
+
+Real corpus (NXDT token file, see neuronx_distributed_tpu.data):
+
+  python examples/training/llama_pretrain.py --preset llama2_7b --tp 8 \
+      --data /path/corpus.nxdt --batch-size 64 --seq-len 4096 \
+      --ckpt-dir /path/ckpts --resume
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="tiny",
+                   choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b"])
+    p.add_argument("--tp", type=int, default=1, help="tensor parallel degree")
+    p.add_argument("--cp", type=int, default=1, help="context parallel degree (ring attention)")
+    p.add_argument("--kv-multiplier", type=int, default=1,
+                   help="KV replication when num_kv_heads < tp")
+    p.add_argument("--no-sp", action="store_true", help="disable sequence parallelism")
+    p.add_argument("--no-zero1", action="store_true", help="disable ZeRO-1 state sharding")
+    p.add_argument("--attention", default="dense", choices=["dense", "flash"])
+    p.add_argument("--remat", default="selective", choices=["none", "selective", "full"])
+    p.add_argument("--batch-size", type=int, default=8, help="global batch size")
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--warmup-steps", type=int, default=10)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--data", default=None, help="NXDT token file (synthetic data if unset)")
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--ckpt-every", type=int, default=50)
+    p.add_argument("--keep-ckpts", type=int, default=3)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--metrics-file", default=None, help="JSON results file")
+    p.add_argument("--timeline", default=None, help="Chrome-trace output path")
+    p.add_argument("--bf16", action="store_true", help="bf16 compute (default fp32 off-TPU)")
+    p.add_argument("--virtual-devices", type=int, default=None,
+                   help="force an N-device virtual CPU mesh (dev/test runs)")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        causal_lm_loss,
+    )
+    from neuronx_distributed_tpu.trainer import (
+        Throughput,
+        TrainingMetrics,
+        default_batch_spec,
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        load_checkpoint,
+        make_train_step,
+        mfu,
+        newest_tag,
+        save_checkpoint,
+        transformer_flops_per_token,
+    )
+    from neuronx_distributed_tpu.utils import Timeline, initialize_distributed
+    from neuronx_distributed_tpu.utils.common import ensure_virtual_devices
+
+    if args.virtual_devices:
+        ensure_virtual_devices(args.virtual_devices)
+    initialize_distributed()
+    nxd.initialize_model_parallel(
+        tensor_parallel_size=args.tp,
+        context_parallel_size=args.cp,
+        kv_size_multiplier=args.kv_multiplier,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    compute_dtype = jnp.bfloat16 if (args.bf16 or on_tpu) else jnp.float32
+    cfg = getattr(LlamaConfig, args.preset)(
+        max_seq_len=args.seq_len,
+        sequence_parallel=not args.no_sp,
+        attention_impl=args.attention,
+        remat=args.remat,
+        dtype=compute_dtype,
+        param_dtype=jnp.float32,
+    )
+    config = nxd.training_config(
+        tensor_parallel_size=args.tp,
+        context_parallel_size=args.cp,
+        kv_size_multiplier=args.kv_multiplier,
+        learning_rate=args.lr,
+        zero_one_enabled=not args.no_zero1,
+    )
+
+    model = initialize_parallel_model(
+        config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, args.seq_len), jnp.int32),),
+        seed=args.seed,
+    )
+    import optax
+
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, args.lr, args.warmup_steps, max(args.steps, args.warmup_steps + 1))
+    opt = initialize_parallel_optimizer(config, model, learning_rate=schedule)
+    step_fn = make_train_step(
+        config, model, opt, causal_lm_loss,
+        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()},
+    )
+    params, opt_state = model.params, opt.state
+
+    start_step = 0
+    if args.resume and args.ckpt_dir and newest_tag(args.ckpt_dir):
+        params, opt_state, _, user = load_checkpoint(
+            args.ckpt_dir, model_template=params, optimizer_template=opt_state)
+        start_step = (user or {}).get("step", 0)
+        print(f"resumed from step {start_step}")
+
+    # data: NXDT corpus through the native loader, or synthetic
+    dp = nxd.get_data_parallel_size()
+    if args.data:
+        from neuronx_distributed_tpu.data import TokenDataLoader, TokenDataset
+
+        ds = TokenDataset(args.data)
+        loader = TokenDataLoader(
+            ds, batch_size=args.batch_size, seq_len=args.seq_len,
+            dp_rank=0, dp_size=1, seed=args.seed)  # single-controller: full batch
+        loader.set_epoch(0, skip_batches=start_step % max(len(loader), 1))
+        data_iter = iter(loader)
+
+        def next_batch(step):
+            nonlocal data_iter
+            b = next(data_iter, None)
+            if b is None:
+                loader.set_epoch(step // max(len(loader), 1))
+                data_iter = iter(loader)
+                b = next(data_iter)
+            return {"ids": jnp.asarray(b["ids"]), "labels": jnp.asarray(b["labels"])}
+    else:
+        def next_batch(step):
+            k = jax.random.fold_in(jax.random.PRNGKey(args.seed), step)
+            ids = jax.random.randint(k, (args.batch_size, args.seq_len), 0, cfg.vocab_size)
+            return {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+
+    flops_tok = transformer_flops_per_token(
+        cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+        args.seq_len, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_)
+    tl = Timeline(args.timeline)
+    thr = Throughput(args.batch_size)
+    metrics = TrainingMetrics(args.metrics_file) if args.metrics_file else None
+
+    for step in range(start_step, args.steps):
+        with tl.event("train_step"):
+            batch = next_batch(step)
+            params, opt_state, m = step_fn(params, opt_state, batch,
+                                           jax.random.fold_in(jax.random.PRNGKey(0), step))
+            loss = float(m["loss"])
+        seqs = thr.step()
+        toks = seqs * args.seq_len
+        if step % 10 == 0 or step == args.steps - 1:
+            line = {
+                "step": step, "loss": round(loss, 4),
+                "seq_per_sec": round(seqs, 2),
+                "tokens_per_sec": round(toks, 1),
+                "grad_norm": round(float(m["grad_norm"]), 4),
+            }
+            print(json.dumps(line), flush=True)
+        tl.mark_step_end(step)
+        if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
+            save_checkpoint(args.ckpt_dir, f"step_{step + 1}", params, opt_state,
+                            user_content={"step": step + 1},
+                            num_kept_ckpts=args.keep_ckpts)
+
+    if args.ckpt_dir:
+        save_checkpoint(args.ckpt_dir, f"step_{args.steps}", params, opt_state,
+                        user_content={"step": args.steps}, num_kept_ckpts=args.keep_ckpts)
+    if metrics:
+        peak = 197e12 if on_tpu else 1e12
+        metrics.update(final_loss=loss, peak_seq_per_sec=thr.peak,
+                       mfu=mfu(toks, flops_tok, peak), steps=args.steps)
+        metrics.write()
+    print(f"done: final loss {loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

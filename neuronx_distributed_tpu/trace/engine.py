@@ -38,6 +38,7 @@ import numpy as np
 from neuronx_distributed_tpu.parallel.mesh import (
     BATCH_AXES,
     TENSOR_AXIS,
+    get_data_parallel_size,
     get_mesh,
     model_parallel_is_initialized,
     named_sharding,
@@ -112,7 +113,23 @@ def init_kv_caches(
         (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)) for _ in range(num_layers)
     ]
     if model_parallel_is_initialized():
-        spec = named_sharding(BATCH_AXES, None, TENSOR_AXIS, None)
+        mesh = get_mesh()
+        # shard only the dims the shapes actually divide (small serving
+        # batches are often < dp; few kv heads may be < tp) — and say so,
+        # since replication multiplies per-device cache memory
+        batch_axes = BATCH_AXES if batch_size % get_data_parallel_size() == 0 else None
+        kv_axes = TENSOR_AXIS if num_kv_heads % mesh.shape[TENSOR_AXIS] == 0 else None
+        if batch_axes is None and get_data_parallel_size() > 1:
+            logger.warning(
+                "kv cache batch dim (%d) not divisible by dp (%d); replicating",
+                batch_size, get_data_parallel_size(),
+            )
+        if kv_axes is None and mesh.shape[TENSOR_AXIS] > 1:
+            logger.warning(
+                "kv cache head dim (%d) not divisible by tp (%d); replicating",
+                num_kv_heads, mesh.shape[TENSOR_AXIS],
+            )
+        spec = named_sharding(batch_axes, None, kv_axes, None)
         caches = jax.tree.map(lambda x: jax.device_put(x, spec), caches)
     return caches
 

@@ -17,3 +17,40 @@ def divide(numerator: int, denominator: int) -> int:
 def pad_to_multiple(n: int, multiple: int) -> int:
     """Smallest value >= n that is divisible by ``multiple``."""
     return ((n + multiple - 1) // multiple) * multiple
+
+
+def ensure_virtual_devices(n: int) -> None:
+    """Force an ``n``-device virtual CPU mesh for dev/test parity runs.
+
+    When the resolved platform is already CPU with >= ``n`` devices this is a
+    no-op; otherwise the backend is reset onto CPU with ``n`` virtual
+    devices — including when a hardware platform is configured (probing a
+    hardware plugin just to count devices can block for minutes in sandboxed
+    environments, so we never initialize one here; a warning is logged
+    instead).  Do not call this on a run that should use the attached
+    accelerators."""
+    import jax
+
+    from neuronx_distributed_tpu.utils.logger import get_logger
+
+    # resolved config value, not the env var (the env may be stale relative
+    # to jax.config — see tests/conftest.py)
+    platform = jax.config.jax_platforms
+    if platform == "cpu":
+        try:
+            if len(jax.devices()) >= n:
+                return
+        except Exception:
+            pass
+    else:
+        get_logger(__name__).warning(
+            "ensure_virtual_devices: forcing a %d-device virtual CPU mesh "
+            "(configured platform %r is NOT probed or used)", n, platform,
+        )
+    import jax.extend.backend as jeb
+
+    jeb.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
+    if len(jax.devices()) < n:
+        raise RuntimeError(f"could not provision {n} devices (have {len(jax.devices())})")
