@@ -71,6 +71,9 @@ class LlamaConfig:
     # "flash": pallas flash kernel under shard_map; rings KV over the cp axis
     #          when context_parallel_size > 1 (long-context training).
     attention_impl: str = "dense"
+    # causal-load-balanced cp layout: ids/positions must be fed in
+    # ops.zigzag_permute order (labels/loss are permutation-invariant)
+    cp_zigzag: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -153,7 +156,10 @@ class CoreAttention(nn.Module):
             # ring_attention has no query-offset notion; only the q-aligned
             # training case may take this path
             assert q_offset == 0, "flash path requires q_offset == 0"
-            return ring_attention(q, k, v, causal=True)
+            return ring_attention(
+                q, k, v, causal=True,
+                layout="zigzag" if cfg.cp_zigzag else "contiguous",
+            )
         B, S, NQ, D = q.shape
         T = k.shape[1]
         NKV = k.shape[2]

@@ -7,11 +7,17 @@ from neuronx_distributed_tpu.ops.flash_attention import (
     flash_attention_with_lse,
     mha_reference,
 )
-from neuronx_distributed_tpu.ops.ring_attention import ring_attention
+from neuronx_distributed_tpu.ops.ring_attention import (
+    ring_attention,
+    zigzag_permute,
+    zigzag_unpermute,
+)
 
 __all__ = [
     "flash_attention",
     "flash_attention_with_lse",
     "mha_reference",
     "ring_attention",
+    "zigzag_permute",
+    "zigzag_unpermute",
 ]

@@ -120,6 +120,106 @@ def _ring_shard(
     return o.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# zigzag layout: causal load balancing
+# ---------------------------------------------------------------------------
+#
+# Contiguous chunks make causal ring attention imbalanced: device 0's queries
+# see only their own chunk while device cp-1's see everything, so every
+# device burns worst-case FLOPs on partials that get masked.  The zigzag
+# layout splits the sequence into 2*cp chunks and gives device i the PAIR
+# (i, 2cp-1-i) — one early, one late — so per ring step each device computes
+# exactly two always-useful chunk attentions:
+#
+#   step 0          : causal(qa, kv_a), causal(qb, kv_b), full(qb, kv_a)
+#   step t, src<idx : full(qa, kv_src)          + full(qb, kv_src)
+#   step t, src>idx : full(qb, kv_d) (d=2cp-1-src) + full(qb, kv_src)
+#
+# full(qb, kv_src) is unconditional (an early chunk is visible to every late
+# chunk), and the conditional pair is selected with jnp.where on same-shape
+# operands, so the program stays SPMD-uniform while doing 2*C^2 useful work
+# per device per step — the ideal causal total, perfectly balanced.
+
+
+def zigzag_indices(seq_len: int, cp: int) -> jax.Array:
+    """Global permutation placing chunk pair (i, 2cp-1-i) on shard i."""
+    if seq_len % (2 * cp) != 0:
+        raise ValueError(f"seq_len {seq_len} not divisible by 2*cp={2 * cp}")
+    c = seq_len // (2 * cp)
+    chunks = jnp.arange(seq_len).reshape(2 * cp, c)
+    order = []
+    for i in range(cp):
+        order += [i, 2 * cp - 1 - i]
+    return chunks[jnp.asarray(order)].reshape(-1)
+
+
+def zigzag_permute(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:
+    """Reorder a sequence-major array into zigzag layout."""
+    return jnp.take(x, zigzag_indices(x.shape[axis], cp), axis=axis)
+
+
+def zigzag_unpermute(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:
+    """Inverse of :func:`zigzag_permute`."""
+    idx = zigzag_indices(x.shape[axis], cp)
+    inv = jnp.zeros_like(idx).at[idx].set(jnp.arange(idx.shape[0]))
+    return jnp.take(x, inv, axis=axis)
+
+
+def _ring_shard_zigzag(
+    q, k, v, *, cp: int, sm_scale: float, use_flash: bool,
+    block_q: int, block_k: int, interpret: Optional[bool],
+):
+    """Causal zigzag ring body; local q/k/v ``[B, H, 2C, D]`` hold the
+    chunk pair (a=idx, b=2cp-1-idx), a in rows [:C], b in rows [C:]."""
+
+    def chunk(qc, kc, vc, diag: bool):
+        if use_flash:
+            return flash_attention_with_lse(
+                qc, kc, vc, diag, sm_scale, block_q, block_k, interpret
+            )
+        return _dense_chunk_attn(qc, kc, vc, diag, sm_scale)
+
+    C = q.shape[2] // 2
+    qa, qb = q[:, :, :C], q[:, :, C:]
+    idx = jax.lax.axis_index(CONTEXT_AXIS)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    # step 0: both diagonals + the intra-pair cross term
+    k_next, v_next = (jax.lax.ppermute((k, v), CONTEXT_AXIS, perm) if cp > 1
+                      else (k, v))
+    ka, kb = k[:, :, :C], k[:, :, C:]
+    va, vb = v[:, :, :C], v[:, :, C:]
+    o_a, lse_a = chunk(qa, ka, va, True)
+    o_b, lse_b = chunk(qb, kb, vb, True)
+    o_ba, lse_ba = chunk(qb, ka, va, False)
+    o_a = o_a.astype(jnp.float32)
+    o_b, lse_b = _combine(o_b.astype(jnp.float32), lse_b, o_ba, lse_ba)
+
+    for t in range(1, cp):
+        k, v = k_next, v_next
+        if t < cp - 1:
+            k_next, v_next = jax.lax.ppermute((k, v), CONTEXT_AXIS, perm)
+        src = (idx - t) % cp
+        ka, kb = k[:, :, :C], k[:, :, C:]
+        va, vb = v[:, :, :C], v[:, :, C:]
+        # unconditional: early kv chunk 'src' is before late q chunk b
+        o_t, lse_t = chunk(qb, ka, va, False)
+        o_b, lse_b = _combine(o_b, lse_b, o_t, lse_t)
+        # conditional pair, both cases same shape: src < idx → (qa, kv_src);
+        # src > idx → (qb, kv_d) with d = 2cp-1-src < b
+        early = src < idx
+        q_sel = jnp.where(early, qa, qb)
+        k_sel = jnp.where(early, ka, kb)
+        v_sel = jnp.where(early, va, vb)
+        o_s, lse_s = chunk(q_sel, k_sel, v_sel, False)
+        o_a, lse_a = _combine(o_a, lse_a, o_s,
+                              jnp.where(early, lse_s, NEG_INF))
+        o_b, lse_b = _combine(o_b, lse_b, o_s,
+                              jnp.where(early, NEG_INF, lse_s))
+    out = jnp.concatenate([o_a, o_b], axis=2)
+    return out.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -130,6 +230,7 @@ def ring_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Context-parallel attention in model layout: ``q [B, S, NQ, D]``,
     ``k/v [B, S, NKV, D]`` (``NQ`` a multiple of ``NKV``), sequence dim
@@ -141,6 +242,12 @@ def ring_attention(
     unconditionally.
 
     ``use_flash`` defaults to True (pallas kernel; interpreted off-TPU).
+
+    ``layout``: ``"contiguous"`` — shard i holds the i-th sequence chunk
+    (simple, but causal work is imbalanced); ``"zigzag"`` — the inputs are
+    already in :func:`zigzag_permute` order (pair (i, 2cp-1-i) per shard),
+    causal only, perfectly load-balanced with zero masked-out compute.  The
+    output stays in the input's layout.
     """
     mesh = get_mesh()
     cp = mesh.shape[CONTEXT_AXIS]
@@ -149,6 +256,15 @@ def ring_attention(
 
     if S % cp != 0:
         raise ValueError(f"sequence length {S} not divisible by cp degree {cp}")
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError("zigzag layout is a causal-only optimization")
+        if cp == 1:
+            layout = "contiguous"  # degenerate: same thing
+        elif S % (2 * cp) != 0:
+            raise ValueError(f"zigzag needs seq_len divisible by 2*cp={2 * cp}")
 
     # [B, S, H, D] -> [B, H, S, D] kernel layout
     qt = q.transpose(0, 2, 1, 3)
@@ -161,12 +277,19 @@ def ring_attention(
     q_spec = P(None, (TENSOR_AXIS, KV_REPLICA_AXIS), CONTEXT_AXIS, None)
     kv_spec = P(None, TENSOR_AXIS, CONTEXT_AXIS, None)
 
-    def body(qs, ks, vs):
-        return _ring_shard(
-            qs, ks, vs, cp=cp, causal=causal, sm_scale=scale,
-            use_flash=use_flash, block_q=block_q, block_k=block_k,
-            interpret=interpret,
-        )
+    if layout == "zigzag":
+        def body(qs, ks, vs):
+            return _ring_shard_zigzag(
+                qs, ks, vs, cp=cp, sm_scale=scale, use_flash=use_flash,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            )
+    else:
+        def body(qs, ks, vs):
+            return _ring_shard(
+                qs, ks, vs, cp=cp, causal=causal, sm_scale=scale,
+                use_flash=use_flash, block_q=block_q, block_k=block_k,
+                interpret=interpret,
+            )
 
     o = jax.shard_map(
         body,

@@ -207,3 +207,91 @@ def test_llama_flash_ring_matches_dense(devices8):
         ),
         g_d, g_f,
     )
+
+
+# ---------------------------------------------------------------------------
+# zigzag layout
+# ---------------------------------------------------------------------------
+
+
+def test_zigzag_permute_roundtrip():
+    from neuronx_distributed_tpu.ops import zigzag_permute, zigzag_unpermute
+
+    x = jnp.arange(2 * 32 * 3).reshape(2, 32, 3)
+    z = zigzag_permute(x, cp=4, axis=1)
+    assert not np.array_equal(np.asarray(z), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(zigzag_unpermute(z, cp=4, axis=1)),
+                                  np.asarray(x))
+
+
+@pytest.mark.parametrize("use_flash", [False, True], ids=["dense-chunk", "flash-chunk"])
+def test_zigzag_ring_matches_dense(cp_mesh, use_flash):
+    from neuronx_distributed_tpu.ops import zigzag_permute, zigzag_unpermute
+
+    B, HKV, S, D = 1, 2, 64, 8
+    G = 2
+    q, k, v = _qkv(jax.random.PRNGKey(7), B, HKV * G, HKV, S, S, D)
+    ref = mha_reference(q, k, v, causal=True)
+    qm, km, vm = _model_layout(q, k, v)
+    qz = zigzag_permute(qm, cp=4, axis=1)
+    kz = zigzag_permute(km, cp=4, axis=1)
+    vz = zigzag_permute(vm, cp=4, axis=1)
+    out = jax.jit(
+        lambda a, b, c: ring_attention(a, b, c, causal=True, use_flash=use_flash,
+                                       block_q=8, block_k=8, layout="zigzag")
+    )(qz, kz, vz)
+    out = zigzag_unpermute(out, cp=4, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(out.transpose(0, 2, 1, 3)), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_zigzag_ring_grads_match_dense(cp_mesh):
+    from neuronx_distributed_tpu.ops import zigzag_permute, zigzag_unpermute
+
+    B, H, S, D = 1, 2, 32, 8
+    q, k, v = _qkv(jax.random.PRNGKey(8), B, H, H, S, S, D)
+
+    def loss_zig(q, k, v):
+        qm, km, vm = _model_layout(q, k, v)
+        qz, kz, vz = (zigzag_permute(x, cp=4, axis=1) for x in (qm, km, vm))
+        o = ring_attention(qz, kz, vz, causal=True, use_flash=False, layout="zigzag")
+        o = zigzag_unpermute(o, cp=4, axis=1)
+        return jnp.sum(o ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_z = jax.jit(jax.grad(loss_zig, argnums=(0, 1, 2)))(q, k, v)
+    g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_z, g_d, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
+
+
+def test_llama_zigzag_matches_dense(devices8):
+    """Full model in zigzag layout: permuted ids/positions through the
+    flash+zigzag core must reproduce the dense model's logits (unpermuted)."""
+    from conftest import sharded_params
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.ops import zigzag_permute, zigzag_unpermute
+
+    initialize_model_parallel(
+        tensor_parallel_size=2, context_parallel_size=2, devices=devices8)
+    base = dict(sequence_parallel=True, dtype=jnp.float32, param_dtype=jnp.float32,
+                max_seq_len=32)
+    cfg_d = LlamaConfig.tiny(attention_impl="dense", **base)
+    cfg_z = LlamaConfig.tiny(attention_impl="flash", cp_zigzag=True, **base)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, cfg_d.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(32), ids.shape)
+
+    model_d = LlamaForCausalLM(cfg_d)
+    model_z = LlamaForCausalLM(cfg_z)
+    params = sharded_params(model_d.init(jax.random.PRNGKey(1), ids))
+
+    logits_d = jax.jit(model_d.apply)(params, ids)
+    ids_z = zigzag_permute(ids, cp=2, axis=1)
+    pos_z = zigzag_permute(positions, cp=2, axis=1)
+    logits_z = jax.jit(model_z.apply)(params, ids_z, pos_z)
+    logits_z = zigzag_unpermute(logits_z, cp=2, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(logits_z), np.asarray(logits_d), rtol=2e-4, atol=2e-4)
