@@ -1,23 +1,34 @@
 """Benchmark: Llama pretrain throughput on the available chip(s).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — always,
+even when the TPU backend fails to initialize (round-1 failure mode: a
+plugin hiccup raised out of ``jax.devices()`` and zeroed the whole round's
+perf story).  Structure:
+
+- the parent process never imports jax; it launches measurement attempts as
+  subprocesses, so a cached backend-init error cannot poison a retry;
+- a ladder of configs is tried in order (flash attention + big batch first,
+  then dense, then smaller batches, then a CPU smoke run) and the first
+  success wins;
+- on total failure the parent emits a structured-error JSON line with
+  ``value 0.0`` and the tail of the last stderr, rc=0.
 
 The reference publishes no absolute numbers (BASELINE.md), so ``vs_baseline``
-is measured against the north-star target of 35% MFU (BASELINE.json): a value
-of 1.0 means exactly 35% MFU on this chip; >1 beats the target.
+is measured against the north-star target of 35% MFU (BASELINE.json): 1.0
+means exactly 35% MFU on this chip; >1 beats the target.
 
 Model: Llama-shaped decoder sized to fit a single v5e chip's 16 GB HBM for
 full training (fp32 master params + fp32 Adam states + bf16 compute), seq
 2048 — the single-chip slice of the Llama-2-7B TP=8 pretrain config
-(tp_zero1_llama2_7b_hf_pretrain.sh:19-36 in the reference).
+(reference tp_zero1_llama2_7b_hf_pretrain.sh:19-36).
 """
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-
 
 # v5e (lite) peak bf16 FLOPs per chip
 PEAK_FLOPS = {
@@ -29,6 +40,18 @@ PEAK_FLOPS = {
     "cpu": 1e12,  # nominal, for smoke runs
 }
 
+# (platform, attention_impl, batch) tried in order; first success wins.
+LADDER = [
+    ("tpu", "flash", 8),
+    ("tpu", "flash", 4),
+    ("tpu", "dense", 4),
+    ("tpu", "dense", 2),
+    ("cpu", "dense", 2),
+]
+ATTEMPT_TIMEOUT_S = 900
+PROBE_TIMEOUT_S = 420
+RETRY_SLEEP_S = 20
+
 
 def peak_flops_for(device) -> float:
     kind = getattr(device, "device_kind", "cpu").lower()
@@ -38,7 +61,13 @@ def peak_flops_for(device) -> float:
     return 197e12
 
 
-def main():
+def run_measurement(platform: str, attn: str, batch: int) -> dict:
+    """Child-process body: build the model, time steps, return the result.
+
+    Raises on any failure; the parent ladder decides what to try next."""
+    import jax
+    import jax.numpy as jnp
+
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models.llama import (
         LlamaConfig,
@@ -57,15 +86,19 @@ def main():
     devices = jax.devices()
     n = len(devices)
     on_tpu = devices[0].platform != "cpu"
+    if platform == "tpu" and not on_tpu:
+        # never report a silent-CPU-fallback number as a TPU measurement
+        raise RuntimeError(f"requested tpu but jax.devices() -> {devices[0].platform}")
 
     if on_tpu:
-        # ~400M-param Llama slice: 7B's hidden/4 layout, seq 2048
+        # ~400M-param Llama slice: 7B's hidden layout /4, seq 2048
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_layers=12, num_heads=12, num_kv_heads=12, head_dim=128,
             max_seq_len=2048, sequence_parallel=n > 1, remat="selective",
+            attention_impl=attn,
         )
-        batch, seq, steps, warmup = 2, 2048, 10, 3
+        seq, steps, warmup = 2048, 10, 3
     else:  # CPU smoke mode
         cfg = LlamaConfig.tiny(sequence_parallel=False, remat="none")
         batch, seq, steps, warmup = 2, 64, 3, 1
@@ -105,12 +138,125 @@ def main():
     )
     achieved_mfu = mfu(tokens_per_sec_per_chip, fpt, peak_flops_for(devices[0]))
 
-    print(json.dumps({
+    return {
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_per_chip, 2),
-        "unit": f"tokens/s/chip (mfu={achieved_mfu:.3f}, model={model.num_parameters()/1e6:.0f}M, seq={seq})",
+        "unit": (
+            f"tokens/s/chip (mfu={achieved_mfu:.3f}, attn={attn}, batch={batch},"
+            f" model={model.num_parameters()/1e6:.0f}M, seq={seq},"
+            f" device={devices[0].device_kind})"
+        ),
         "vs_baseline": round(achieved_mfu / 0.35, 3),
+    }
+
+
+def child_main(args) -> int:
+    if args.platform == "cpu":
+        # the JAX_PLATFORMS env value may be latched by a sitecustomize that
+        # imports jax first; the config update always wins
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if args.probe:
+        import jax
+
+        devs = jax.devices()
+        if args.platform == "tpu" and devs[0].platform == "cpu":
+            print("probe failed: jax fell back to cpu", file=sys.stderr)
+            return 1
+        print(f"probe ok: {len(devs)}x {devs[0].device_kind}", file=sys.stderr)
+        return 0
+    try:
+        result = run_measurement(args.platform, args.attn, args.batch)
+    except Exception as e:  # noqa: BLE001 — report, parent decides
+        print(f"bench attempt failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(result))
+    return 0
+
+
+def _run_child(extra_args, timeout_s, env=None):
+    cmd = [sys.executable, os.path.abspath(__file__), "--run", *extra_args]
+    try:
+        return subprocess.run(
+            cmd, env=env or dict(os.environ), capture_output=True, text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+
+
+def parent_main() -> int:
+    last_err = ""
+    # Step 1: bounded TPU-backend probe — a hung or broken plugin must not
+    # consume the whole time budget (round-1 failure: init raised; observed
+    # alternative: init hangs indefinitely).
+    tpu_ok = False
+    for attempt in range(2):
+        proc = _run_child(["--probe", "--platform=tpu"], PROBE_TIMEOUT_S)
+        if proc is not None and proc.returncode == 0:
+            tpu_ok = True
+            break
+        last_err = (
+            f"tpu probe: timed out after {PROBE_TIMEOUT_S}s" if proc is None
+            else f"tpu probe rc={proc.returncode}: "
+            + " | ".join((proc.stderr or "").strip().splitlines()[-3:])
+        )
+        print(last_err, file=sys.stderr)
+        if attempt == 0:
+            time.sleep(RETRY_SLEEP_S)
+
+    # Step 2: measurement ladder, first success wins.  Two timed-out TPU
+    # attempts disqualify the remaining TPU rungs (a hang, not an OOM).
+    tpu_timeouts = 0
+    for platform, attn, batch in LADDER:
+        if platform == "tpu" and (not tpu_ok or tpu_timeouts >= 2):
+            continue
+        env = dict(os.environ)
+        if platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+        proc = _run_child(
+            [f"--platform={platform}", f"--attn={attn}", f"--batch={batch}"],
+            ATTEMPT_TIMEOUT_S, env,
+        )
+        if proc is None:
+            last_err = f"{platform}/{attn}/b{batch}: timed out after {ATTEMPT_TIMEOUT_S}s"
+            print(last_err, file=sys.stderr)
+            if platform == "tpu":
+                tpu_timeouts += 1
+            continue
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        parsed = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    print(json.dumps(parsed))
+                    return 0
+        tail = (proc.stderr or "").strip().splitlines()[-12:]
+        last_err = f"{platform}/{attn}/b{batch} rc={proc.returncode}: " + " | ".join(tail[-3:])
+        print("\n".join(tail), file=sys.stderr)
+    # Total failure: still emit one well-formed JSON line, rc 0.
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": f"tokens/s/chip (error: {last_err[:400]})",
+        "vs_baseline": 0.0,
     }))
+    return 0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--run", action="store_true", help="internal: run one measurement")
+    p.add_argument("--probe", action="store_true", help="internal: just init the backend")
+    p.add_argument("--platform", default="tpu")
+    p.add_argument("--attn", default="dense")
+    p.add_argument("--batch", type=int, default=2)
+    args = p.parse_args()
+    sys.exit(child_main(args) if args.run else parent_main())
 
 
 if __name__ == "__main__":

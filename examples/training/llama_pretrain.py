@@ -37,6 +37,10 @@ def parse_args():
     p.add_argument("--preset", default="tiny",
                    choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b"])
     p.add_argument("--tp", type=int, default=1, help="tensor parallel degree")
+    p.add_argument("--pp", type=int, default=1, help="pipeline parallel degree")
+    p.add_argument("--microbatches", type=int, default=1,
+                   help="pipeline microbatches (pp>1)")
+    p.add_argument("--pp-schedule", default="1f1b", choices=["1f1b", "gpipe"])
     p.add_argument("--cp", type=int, default=1, help="context parallel degree (ring attention)")
     p.add_argument("--kv-multiplier", type=int, default=1,
                    help="KV replication when num_kv_heads < tp")
@@ -92,26 +96,33 @@ def main():
     initialize_distributed()
     nxd.initialize_model_parallel(
         tensor_parallel_size=args.tp,
+        pipeline_parallel_size=args.pp,
         context_parallel_size=args.cp,
         kv_size_multiplier=args.kv_multiplier,
     )
 
     on_tpu = jax.default_backend() == "tpu"
-    compute_dtype = jnp.bfloat16 if (args.bf16 or on_tpu) else jnp.float32
+    # one TrainingConfig drives dtypes, mesh, pipeline and optimizer
+    config = nxd.training_config(
+        tensor_parallel_size=args.tp,
+        pipeline_parallel_size=args.pp,
+        context_parallel_size=args.cp,
+        kv_size_multiplier=args.kv_multiplier,
+        num_microbatches=args.microbatches,
+        schedule=args.pp_schedule,
+        learning_rate=args.lr,
+        zero_one_enabled=not args.no_zero1,
+        compute_dtype="bfloat16" if (args.bf16 or on_tpu) else "float32",
+        param_dtype="float32",
+        seed=args.seed,
+    )
     cfg = getattr(LlamaConfig, args.preset)(
         max_seq_len=args.seq_len,
         sequence_parallel=not args.no_sp,
         attention_impl=args.attention,
         remat=args.remat,
-        dtype=compute_dtype,
-        param_dtype=jnp.float32,
-    )
-    config = nxd.training_config(
-        tensor_parallel_size=args.tp,
-        context_parallel_size=args.cp,
-        kv_size_multiplier=args.kv_multiplier,
-        learning_rate=args.lr,
-        zero_one_enabled=not args.no_zero1,
+        dtype=config.jnp_compute_dtype,
+        param_dtype=config.jnp_param_dtype,
     )
 
     model = initialize_parallel_model(
@@ -145,7 +156,12 @@ def main():
         loader = TokenDataLoader(
             ds, batch_size=args.batch_size, seq_len=args.seq_len,
             dp_rank=0, dp_size=1, seed=args.seed)  # single-controller: full batch
-        loader.set_epoch(0, skip_batches=start_step % max(len(loader), 1))
+        # resume at the right epoch so the shuffle order matches an
+        # uninterrupted run (epoch = step // batches-per-epoch)
+        loader.set_epoch(
+            start_step // max(len(loader), 1),
+            skip_batches=start_step % max(len(loader), 1),
+        )
         data_iter = iter(loader)
 
         def next_batch(step):

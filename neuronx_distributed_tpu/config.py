@@ -53,7 +53,13 @@ class ActivationCheckpointConfig:
 
 @dataclasses.dataclass(frozen=True)
 class TrainingConfig:
-    """Top-level config (the ``nxd_config`` dict equivalent)."""
+    """Top-level config (the ``nxd_config`` dict equivalent).
+
+    Every field is consumed: ``mesh`` sizes the global Mesh, ``pipeline``
+    selects the PP engine and microbatching when ``pipeline_parallel_size >
+    1`` (``initialize_parallel_model``), ``param_dtype``/``compute_dtype``
+    drive model construction via :meth:`jnp_param_dtype` /
+    :meth:`jnp_compute_dtype` and are verified against the built module."""
 
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
@@ -67,6 +73,18 @@ class TrainingConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     seed: int = 1234
+
+    @property
+    def jnp_param_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.dtype(self.param_dtype)
+
+    @property
+    def jnp_compute_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.dtype(self.compute_dtype)
 
     def replace(self, **kw: Any) -> "TrainingConfig":
         return dataclasses.replace(self, **kw)

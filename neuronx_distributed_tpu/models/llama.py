@@ -317,6 +317,14 @@ class LlamaForCausalLM(nn.Module):
 
     config: LlamaConfig
 
+    @nn.nowrap
+    def build_pipelined(self, num_microbatches: int, schedule: str = "1f1b", seed: int = 0):
+        """Pipeline-capable-model protocol consumed by
+        ``initialize_parallel_model`` when ``pipeline_parallel_size > 1``."""
+        return build_pipelined_llama(
+            self.config, num_microbatches=num_microbatches, seed=seed, schedule=schedule
+        )
+
     @nn.compact
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0):
         cfg = self.config
@@ -359,7 +367,9 @@ class LlamaHead(nn.Module):
         )(h)
 
 
-def build_pipelined_llama(cfg: LlamaConfig, num_microbatches: int, seed: int = 0):
+def build_pipelined_llama(
+    cfg: LlamaConfig, num_microbatches: int, seed: int = 0, schedule: str = "1f1b"
+):
     """Construct a :class:`~neuronx_distributed_tpu.pipeline.engine.PipelinedModel`
     for pipeline-parallel Llama training.
 
@@ -422,6 +432,15 @@ def build_pipelined_llama(cfg: LlamaConfig, num_microbatches: int, seed: int = 0
             else None
         ),
         seed=seed,
+        schedule=schedule,
+        # inter-stage residual sharding: sequence-sharded under SP (the
+        # constraint LlamaBlock applies at its exit) — the 1F1B engine
+        # re-applies it on cond branches that bypass the model
+        act_spec=(
+            trailing_spec(3, seq=SEQUENCE_AXES, last=None)
+            if cfg.sequence_parallel
+            else None
+        ),
     )
 
 

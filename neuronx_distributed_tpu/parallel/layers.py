@@ -51,9 +51,18 @@ _U = P.UNCONSTRAINED
 
 
 def shard_activation(x: jax.Array, spec: P) -> jax.Array:
-    """Constrain ``x``'s sharding over the global mesh (no-op if no mesh)."""
+    """Constrain ``x``'s sharding over the global mesh (no-op if no mesh).
+
+    Inside a partial-manual ``shard_map`` region (the pipeline engine makes
+    ``pp`` manual) the constraint must be expressed against the *abstract*
+    context mesh — a NamedSharding over the concrete mesh carries all-Auto
+    axis types and is rejected by jax 0.9's canonicalization when any axis
+    is Manual in context."""
     if not model_parallel_is_initialized():
         return x
+    abstract = jax.sharding.get_abstract_mesh()
+    if abstract.axis_names:  # inside jit/shard_map: use the context mesh
+        return jax.lax.with_sharding_constraint(x, NamedSharding(abstract, spec))
     return jax.lax.with_sharding_constraint(x, NamedSharding(get_mesh(), spec))
 
 

@@ -25,13 +25,18 @@ because the shard_map transpose psums gradients of replicated inputs over
 ``pp``, tied embedding/head weights need none of the reference's dedicated
 shared-weight process groups (``parallel_state.py:347-379``).
 
-Schedule shape: fill-drain over ``T = M + P - 1`` ticks (GPipe-style; the
-1F1B reordering in :mod:`.scheduler` has identical bubble fraction and only
-changes *eager* peak memory — under one jit, peak memory is governed by the
-remat policy instead).  Known redundancy: embedding and head/loss run every
-tick on every stage (masked to the owning stage), costing roughly
-``(V / 6H) / layers_per_stage`` extra compute; acceptable next to the
-(P-1)/(M+P-1) bubble and avoids materializing all microbatch outputs.
+Two schedules are provided:
+
+- :func:`make_pipelined_loss_fn` — differentiable fill-drain (GPipe) over
+  ``T = M + P - 1`` ticks; autodiff of the scan stores residuals for all
+  ``T`` ticks, so peak activation memory grows with ``M``.  Kept as the
+  differentiable oracle and for ``schedule="gpipe"``.
+- :func:`make_1f1b_loss_and_grad_fn` — the production path
+  (``schedule="1f1b"``): manual backward with a circular activation stash
+  bounded by ``2(P-1)+1`` microbatches, independent of ``M`` — the 1F1B
+  memory property of the reference's ``TrainSchedule``
+  (``pipeline/scheduler.py:141-273``), realized as a synchronous
+  one-forward-plus-one-backward tick.
 """
 
 from __future__ import annotations
@@ -44,8 +49,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from neuronx_distributed_tpu.parallel.mesh import BATCH_AXES, PIPELINE_AXIS, get_mesh
+from neuronx_distributed_tpu.parallel.mesh import (
+    BATCH_AXES,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    PIPELINE_AXIS,
+    get_mesh,
+)
 from neuronx_distributed_tpu.pipeline.partition import layers_per_stage
+from neuronx_distributed_tpu.pipeline.scheduler import build_sync_slot_tables
 
 # Param-tree keys understood by the engine.
 EMBED = "embed"
@@ -62,18 +74,25 @@ def microbatch(x: jax.Array, num_microbatches: int, mesh: Optional[Mesh] = None)
     """[B, ...] -> [M, B/M, ...] (the reference's microbatch split,
     ``pipeline/model.py:560-580``).
 
-    No sharding constraint is applied: a constraint on an operand feeding a
-    partial-manual shard_map trips an XLA SPMD-partitioner CHECK (observed on
-    XLA/jax 0.9), and none is needed — when dp divides the microbatch size,
-    the dp-contiguous blocks of the global batch dim land exactly on the
-    inner dim, so GSPMD propagates ``P(None, dp, ...)`` through the reshape
-    on its own."""
+    The microbatch size ``B/M`` must additionally be divisible by the
+    data-parallel degree: the engines make dp a *manual* shard_map axis (the
+    batch is split explicitly per dp rank), mirroring the reference's
+    ``DistributedSampler`` contract of equal per-rank batches."""
     if x.shape[0] % num_microbatches != 0:
         raise ValueError(
             f"batch size {x.shape[0]} not divisible by num_microbatches {num_microbatches}"
         )
-    del mesh
-    return x.reshape(num_microbatches, x.shape[0] // num_microbatches, *x.shape[1:])
+    mb = x.shape[0] // num_microbatches
+    if mesh is not None:
+        from neuronx_distributed_tpu.parallel.mesh import get_data_parallel_size
+
+        dp = get_data_parallel_size(mesh)
+        if mb % dp != 0:
+            raise ValueError(
+                f"microbatch size {mb} (batch {x.shape[0]} / {num_microbatches} "
+                f"microbatches) must be divisible by the data-parallel degree {dp}"
+            )
+    return x.reshape(num_microbatches, mb, *x.shape[1:])
 
 
 def stacked_layer_specs(block_specs: Any) -> Any:
@@ -115,8 +134,10 @@ def make_pipelined_loss_fn(
 
     def loss_fn(params, ids: jax.Array, labels: jax.Array):
         """ids/labels: [B, S] global batch."""
-        ids_mb = microbatch(ids, num_microbatches, mesh)
-        labels_mb = microbatch(labels, num_microbatches, mesh)
+        # dp divisibility only binds on the pp>1 shard_map path (manual dp
+        # batch split); pp==1 runs under GSPMD auto sharding
+        ids_mb = microbatch(ids, num_microbatches, mesh if pp > 1 else None)
+        labels_mb = microbatch(labels, num_microbatches, mesh if pp > 1 else None)
         L = jax.tree.leaves(params[LAYERS])[0].shape[0]
         layers_per_stage(L, pp)  # validate divisibility
 
@@ -176,22 +197,283 @@ def make_pipelined_loss_fn(
                 jnp.zeros((), jnp.float32),
             )
             (_, loss_sum, tok_sum), _ = lax.scan(tick, init, jnp.arange(T))
-            # only the last stage accumulated; make the result pp-invariant
-            loss_sum = lax.psum(loss_sum, PIPELINE_AXIS)
-            tok_sum = lax.psum(tok_sum, PIPELINE_AXIS)
+            # only the last stage accumulated (and each dp shard saw only
+            # its batch slice); make the result mesh-invariant
+            loss_sum = lax.psum(loss_sum, (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS))
+            tok_sum = lax.psum(tok_sum, (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS))
             return loss_sum, tok_sum
 
+        # dp/ep are manual alongside pp: the batch dim is split explicitly
+        # (auto-dp batch sharding under a partial-manual shard_map trips an
+        # XLA SPMD-partitioner CHECK when SP constraints are present), and
+        # the shard_map transpose psums parameter cotangents over dp — the
+        # explicit form of the reference's bucketed DP grad all-reduce
+        # (grads.py:193-246).
         shmap = jax.shard_map(
             f,
             mesh=mesh,
-            in_specs=(P(PIPELINE_AXIS), P(), P(), P(), P()),
+            in_specs=(P(PIPELINE_AXIS), P(), P(), P(None, BATCH_AXES), P(None, BATCH_AXES)),
             out_specs=(P(), P()),
-            axis_names=frozenset({PIPELINE_AXIS}),
+            axis_names=frozenset({DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS}),
             check_vma=False,
         )
         return shmap(params[LAYERS], params[EMBED], params[HEAD], ids_mb, labels_mb)
 
     return loss_fn
+
+
+def make_1f1b_loss_and_grad_fn(
+    embed_fn: EmbedFn,
+    block_fn: BlockFn,
+    head_loss_fn: HeadLossFn,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    remat_block: bool = True,
+    remat_policy: Optional[Callable] = None,
+    act_spec: Optional[P] = None,
+):
+    """Build ``fn(params, ids, labels) -> ((loss_sum, token_count), grads)``
+    running the true 1F1B schedule in one jit — the production PP train path
+    (reference ``TrainSchedule`` 1F1B, ``pipeline/scheduler.py:141-273``).
+
+    Unlike :func:`make_pipelined_loss_fn` (whose fill-drain scan is
+    differentiated by autodiff, storing residuals for all ``M + P - 1``
+    ticks), this computes gradients *manually* inside the scan with bounded
+    state, exactly like the reference's eager 1F1B executor:
+
+    - a circular **activation stash** of ``2(P-1)+1`` microbatch inputs per
+      stage (the 1F1B in-flight bound — O(P), independent of ``M``)
+      replaces autodiff residuals; the backward recomputes the stage forward
+      under ``jax.vjp`` from the stashed input (activation recomputation);
+    - the timetable is the *synchronous* 1F1B of
+      :func:`..scheduler.build_sync_slot_tables`: every tick, every stage
+      runs one forward and one backward, **uniformly across ranks** — no
+      rank-divergent ``lax.cond`` anywhere.  This is a hard constraint, not
+      a style choice: GSPMD freely inserts reshard collective-permutes
+      (e.g. for the GQA kvr regroup or SP gathers) whose channel spans the
+      whole mesh, and any collective inside a branch not taken by every
+      channel participant deadlocks — observed on XLA:CPU and equally true
+      of TPU executables;
+    - uniformity means embedding and head+loss run every tick on every rank
+      (their results masked by ``where``).  The embedding is a cheap gather;
+      the head costs ``(V/6H)/layers_per_stage`` extra compute (≈12% for a
+      7B/PP4 shape, <4% for 70B/PP4) — the price of deadlock-freedom, paid
+      only on the PP path.  The backward is one uniform ``jax.vjp`` of a
+      scalar-``where`` objective: the real loss on the last rank, an
+      inner product ``sum(y * g_in)`` injecting the incoming cotangent on
+      the others — the select's transpose zeroes head grads off the last
+      rank automatically;
+    - gradients accumulate in param dtype; embed/head grads (masked to
+      their owning stage) are psum'd over ``pp`` at the end, which is also
+      what makes tied weights correct with no dedicated process groups
+      (reference ``parallel_state.py:347-379``).
+
+    ``act_spec`` is the inter-stage activation PartitionSpec (e.g. the
+    sequence-parallel residual sharding).  It must be supplied whenever the
+    model annotates activations with explicit sharding constraints: XLA
+    requires every ``lax.cond``'s branches to produce identically-sharded
+    results, so the engine re-applies the same constraint on the branches
+    that bypass the model (stash reads, zero fills).
+    """
+    mesh = mesh if mesh is not None else get_mesh()
+    pp = mesh.shape[PIPELINE_AXIS]
+    M = num_microbatches
+
+    blk = block_fn
+    if remat_block:
+        blk = jax.checkpoint(block_fn, policy=remat_policy, prevent_cse=False)
+
+    def stage_fn(stage_params, x):
+        def body(h, layer_params):
+            return blk(layer_params, h), None
+
+        x, _ = lax.scan(body, x, stage_params)
+        return x
+
+    if pp == 1:
+        # no pipeline: autodiff the plain microbatched loss
+        plain = make_pipelined_loss_fn(
+            embed_fn, block_fn, head_loss_fn, M, mesh=mesh,
+            remat_block=remat_block, remat_policy=remat_policy,
+        )
+
+        def loss_and_grad_pp1(params, ids, labels):
+            (loss_sum, tok), grads = jax.value_and_grad(plain, has_aux=True)(
+                params, ids, labels
+            )
+            return (loss_sum, tok), grads
+
+        return loss_and_grad_pp1
+
+    tables = build_sync_slot_tables(M, pp)
+    T = tables.num_slots
+    Kf = tables.fwd_stash_size
+    Kb = tables.bwd_stash_size
+    import numpy as np
+
+    fwd_tab = np.asarray(tables.fwd_mb, np.int32)          # [P, T]
+    bwd_tab = np.asarray(tables.bwd_mb, np.int32)          # [P, T]
+    in_fwd_tab = np.full_like(fwd_tab, -1)
+    in_fwd_tab[1:] = fwd_tab[:-1]                          # arrival of fwd acts
+    in_bwd_tab = np.full_like(bwd_tab, -1)
+    in_bwd_tab[:-1] = bwd_tab[1:]                          # arrival of grads
+
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+
+    def loss_and_grad(params, ids: jax.Array, labels: jax.Array):
+        ids_mb = microbatch(ids, M, mesh if pp > 1 else None)
+        labels_mb = microbatch(labels, M, mesh if pp > 1 else None)
+        L = jax.tree.leaves(params[LAYERS])[0].shape[0]
+        layers_per_stage(L, pp)  # validate divisibility
+
+        def f(layer_stack, embed_params, head_params, ids_mb, labels_mb):
+            rank = lax.axis_index(PIPELINE_AXIS)
+            is_first = rank == 0
+            is_last = rank == pp - 1
+
+            mb_shape = ids_mb.shape[1:]
+            probe = jax.eval_shape(
+                embed_fn, embed_params, jnp.zeros(mb_shape, ids_mb.dtype)
+            )
+            act = jax.ShapeDtypeStruct(probe.shape, probe.dtype)
+
+            def cact(a):
+                """Pin activation sharding so lax.cond branches agree."""
+                if act_spec is None:
+                    return a
+                from neuronx_distributed_tpu.parallel.layers import shard_activation
+
+                return shard_activation(a, act_spec)
+
+            my_f = jnp.take(jnp.asarray(fwd_tab), rank, axis=0)
+            my_b = jnp.take(jnp.asarray(bwd_tab), rank, axis=0)
+            in_f = jnp.take(jnp.asarray(in_fwd_tab), rank, axis=0)
+            in_b = jnp.take(jnp.asarray(in_bwd_tab), rank, axis=0)
+
+            def masked_add(acc, delta, flag):
+                """acc += delta where flag, NaN-safe on garbage slots."""
+                return jax.tree.map(
+                    lambda a, d: a + jnp.where(flag, d, jnp.zeros_like(d)), acc, delta
+                )
+
+            def tick(carry, xs):
+                stash, gstash, gl, ge, gh, loss_sum, tok_sum = carry
+                mf, mb, inf, inb = xs
+                # both parts run uniformly every tick (bubble slots compute
+                # on garbage and are masked out) — divergent control flow
+                # around the collective-bearing stage compute is forbidden.
+                do_f = mf >= 0
+                do_b = mb >= 0
+
+                # ---------- forward part ----------
+                ids_f = lax.dynamic_index_in_dim(ids_mb, mf, 0, keepdims=False)
+                x_emb = cact(embed_fn(embed_params, ids_f).astype(act.dtype))
+                x_stash = cact(
+                    lax.dynamic_index_in_dim(stash, mf % Kf, 0, keepdims=False)
+                )
+                x_in = jnp.where(is_first, x_emb, x_stash)
+                # stage 0 stashes its input for the backward (other stages
+                # rewrite the identical received value); bubbles must not
+                # clobber a live entry.
+                stash = lax.dynamic_update_index_in_dim(
+                    stash, jnp.where(do_f, x_in, x_stash), mf % Kf, 0
+                )
+                y = cact(stage_fn(layer_stack, x_in))
+
+                # ---------- backward part ----------
+                x_b = lax.dynamic_index_in_dim(stash, mb % Kf, 0, keepdims=False)
+                g_in = lax.dynamic_index_in_dim(gstash, mb % Kb, 0, keepdims=False)
+                lbl = lax.dynamic_index_in_dim(labels_mb, mb, 0, keepdims=False)
+                ids_b = lax.dynamic_index_in_dim(ids_mb, mb, 0, keepdims=False)
+
+                def objective(lp, hp, xx):
+                    """Last stage: the real loss.  Middle stages: <y, g_in>,
+                    whose vjp injects the incoming cotangent.  A scalar
+                    ``where`` selects between them — the select's transpose
+                    zeroes the head grads on non-last ranks."""
+                    yy = stage_fn(lp, xx)
+                    ls, n = head_loss_fn(hp, yy, lbl)
+                    dot = jnp.sum(yy.astype(jnp.float32) * g_in.astype(jnp.float32))
+                    obj = jnp.where(is_last, ls.astype(jnp.float32), dot)
+                    return obj, (ls.astype(jnp.float32), n.astype(jnp.float32))
+
+                (obj, (ls, n)), vjp_fn = jax.vjp(
+                    lambda lp, hp, xx: objective(lp, hp, xx), layer_stack,
+                    head_params, x_b, has_aux=False,
+                )
+                dl, dh, dx = vjp_fn(
+                    (jnp.ones((), jnp.float32), (jnp.zeros((), jnp.float32),
+                                                 jnp.zeros((), jnp.float32)))
+                )
+                dx = cact(dx)
+
+                _, vjp_e = jax.vjp(
+                    lambda ep: embed_fn(ep, ids_b).astype(act.dtype), embed_params
+                )
+                (de,) = vjp_e(dx)
+
+                gl = masked_add(gl, dl, do_b)
+                gh = masked_add(gh, dh, do_b)
+                ge = masked_add(ge, de, jnp.logical_and(do_b, is_first))
+                use = jnp.logical_and(do_b, is_last)
+                loss_sum = loss_sum + jnp.where(use, ls, 0.0)
+                tok_sum = tok_sum + jnp.where(use, n, 0.0)
+
+                # ---------- end-of-slot neighbor transport ----------
+                y_in = lax.ppermute(y, PIPELINE_AXIS, fwd_perm)
+                # the two permutes are data-independent; impose an order so
+                # concurrent runtimes (XLA:CPU thunk executor) can't have
+                # different ranks enter them in different order and deadlock
+                y_in, dx = lax.optimization_barrier((y_in, dx))
+                g_down = lax.ppermute(dx, PIPELINE_AXIS, bwd_perm)
+
+                wf = inf % Kf
+                cur = lax.dynamic_index_in_dim(stash, wf, 0, keepdims=False)
+                stash = lax.dynamic_update_index_in_dim(
+                    stash, jnp.where(inf >= 0, y_in, cur), wf, 0
+                )
+                wb = inb % Kb
+                curg = lax.dynamic_index_in_dim(gstash, wb, 0, keepdims=False)
+                gstash = lax.dynamic_update_index_in_dim(
+                    gstash, jnp.where(inb >= 0, g_down, curg), wb, 0
+                )
+                return (stash, gstash, gl, ge, gh, loss_sum, tok_sum), None
+
+            init = (
+                jnp.zeros((Kf, *act.shape), act.dtype),
+                jnp.zeros((Kb, *act.shape), act.dtype),
+                jax.tree.map(jnp.zeros_like, layer_stack),
+                jax.tree.map(jnp.zeros_like, embed_params),
+                jax.tree.map(jnp.zeros_like, head_params),
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.float32),
+            )
+            (_, _, gl, ge, gh, loss_sum, tok_sum), _ = lax.scan(
+                tick, init, (my_f, my_b, in_f, in_b)
+            )
+            all_axes = (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS)
+            loss_sum = lax.psum(loss_sum, all_axes)
+            tok_sum = lax.psum(tok_sum, all_axes)
+            # dp grad reduction is explicit here (dp is a manual axis):
+            # layer grads live per-stage, embed/head grads on one stage only
+            gl = jax.tree.map(lambda g: lax.psum(g, (DATA_AXIS, EXPERT_AXIS)), gl)
+            ge = jax.tree.map(lambda g: lax.psum(g, all_axes), ge)
+            gh = jax.tree.map(lambda g: lax.psum(g, all_axes), gh)
+            return (loss_sum, tok_sum), {LAYERS: gl, EMBED: ge, HEAD: gh}
+
+        # dp/ep manual alongside pp — see make_pipelined_loss_fn's note
+        shmap = jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(PIPELINE_AXIS), P(), P(), P(None, BATCH_AXES), P(None, BATCH_AXES)),
+            out_specs=((P(), P()), {LAYERS: P(PIPELINE_AXIS), EMBED: P(), HEAD: P()}),
+            axis_names=frozenset({DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS}),
+            check_vma=False,
+        )
+        return shmap(params[LAYERS], params[EMBED], params[HEAD], ids_mb, labels_mb)
+
+    return loss_and_grad
 
 
 @dataclasses.dataclass
@@ -200,8 +482,11 @@ class PipelinedModel:
     ``ParallelModel``; reference ``NxDPPModel``, ``pipeline/model.py:45``).
 
     ``loss_fn(params, ids, labels) -> (loss_sum, token_count)`` runs the full
-    microbatch schedule; ``forward_fn(params, ids) -> logits`` is the
-    fwd-only path."""
+    microbatch schedule (differentiable, fill-drain);
+    ``loss_and_grad_fn(params, ids, labels) -> ((loss_sum, tok), grads)`` is
+    the production train path (1F1B manual-backward when
+    ``schedule="1f1b"``, autodiff of ``loss_fn`` otherwise);
+    ``forward_fn(params, ids) -> logits`` is the fwd-only path."""
 
     params: Any
     param_specs: Any
@@ -209,6 +494,8 @@ class PipelinedModel:
     num_microbatches: int
     loss_fn: Callable
     forward_fn: Callable
+    loss_and_grad_fn: Optional[Callable] = None
+    schedule: str = "1f1b"
 
     @property
     def param_shardings(self):
@@ -236,6 +523,8 @@ def build_pipelined_model(
     remat_block: bool = True,
     remat_policy: Optional[Callable] = None,
     seed: int = 0,
+    schedule: str = "1f1b",
+    act_spec: Optional[P] = None,
 ) -> PipelinedModel:
     """Initialize a pipelined model with stage parameters born sharded.
 
@@ -298,6 +587,25 @@ def build_pipelined_model(
     forward_fn = make_pipelined_forward_fn(
         embed_fn, block_fn, head_fn, num_microbatches, mesh=mesh
     )
+    if schedule == "1f1b":
+        loss_and_grad_fn = make_1f1b_loss_and_grad_fn(
+            embed_fn,
+            block_fn,
+            head_loss_fn,
+            num_microbatches,
+            mesh=mesh,
+            remat_block=remat_block,
+            remat_policy=remat_policy,
+            act_spec=act_spec,
+        )
+    elif schedule == "gpipe":
+        def loss_and_grad_fn(params, ids, labels):
+            (loss_sum, tok), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, ids, labels
+            )
+            return (loss_sum, tok), grads
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r} (1f1b | gpipe)")
     return PipelinedModel(
         params=params,
         param_specs=specs,
@@ -305,6 +613,8 @@ def build_pipelined_model(
         num_microbatches=num_microbatches,
         loss_fn=loss_fn,
         forward_fn=forward_fn,
+        loss_and_grad_fn=loss_and_grad_fn,
+        schedule=schedule,
     )
 
 
@@ -334,7 +644,7 @@ def make_pipelined_forward_fn(
         return x
 
     def forward_fn(params, ids: jax.Array):
-        ids_mb = microbatch(ids, num_microbatches, mesh)
+        ids_mb = microbatch(ids, num_microbatches, mesh if pp > 1 else None)
         M = num_microbatches
 
         if pp == 1:
@@ -376,12 +686,13 @@ def make_pipelined_forward_fn(
             # all other ranks contributed zeros)
             return lax.psum(outs, PIPELINE_AXIS)
 
+        # dp/ep manual alongside pp — see make_pipelined_loss_fn's note
         shmap = jax.shard_map(
             f,
             mesh=mesh,
-            in_specs=(P(PIPELINE_AXIS), P(), P()),
-            out_specs=P(),
-            axis_names=frozenset({PIPELINE_AXIS}),
+            in_specs=(P(PIPELINE_AXIS), P(), P(None, BATCH_AXES)),
+            out_specs=P(None, BATCH_AXES),
+            axis_names=frozenset({DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS}),
             check_vma=False,
         )
         hidden = shmap(params[LAYERS], params[EMBED], ids_mb)

@@ -23,7 +23,7 @@ backwards; earlier stages hold at most ``P-s`` in-flight microbatches.
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterator, List, Sequence
+from typing import Iterator, List, Sequence, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -175,3 +175,177 @@ def bubble_fraction(num_microbatches: int, num_stages: int) -> float:
     """Pipeline bubble fraction (P-1)/(M+P-1) — identical for GPipe-style
     fill-drain and 1F1B; 1F1B only lowers peak activation memory."""
     return (num_stages - 1) / (num_microbatches + num_stages - 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotTables:
+    """Global-clock realization of a 1F1B schedule.  The single-jit engine
+    (:func:`..engine.make_1f1b_loss_and_grad_fn`) consumes the synchronous
+    variant (:func:`build_sync_slot_tables`); the asynchronous
+    :func:`build_slot_tables` (one op per stage per slot, derived from
+    :class:`TrainSchedule`) is the verification oracle the tests check both
+    against, and the timetable a host-driven multi-dispatch executor would
+    follow.
+
+    Each stage performs at most one compute op per slot. ``fwd_mb[s][t]`` /
+    ``bwd_mb[s][t]`` give the microbatch whose forward/backward stage ``s``
+    runs at slot ``t`` (-1 = none).  ``fwd_stash_size`` / ``bwd_stash_size``
+    bound the circular activation / incoming-grad stashes indexed by
+    ``microbatch % size`` — the engine's peak-activation memory is
+    ``fwd_stash_size`` microbatch activations per stage (≤ P, vs M for
+    fill-drain autodiff; the reference's in-flight bound,
+    ``pipeline/scheduler.py:141-273``)."""
+
+    num_microbatches: int
+    num_stages: int
+    num_slots: int
+    fwd_mb: Tuple[Tuple[int, ...], ...]  # [P][T]
+    bwd_mb: Tuple[Tuple[int, ...], ...]  # [P][T]
+    fwd_stash_size: int
+    bwd_stash_size: int
+
+
+def build_slot_tables(num_microbatches: int, num_stages: int) -> SlotTables:
+    """Assign every stage's :class:`TrainSchedule` op sequence to global
+    slots, greedily and dependency-honoring:
+
+    - ``fwd(s, m)`` needs ``fwd(s-1, m)`` completed in an earlier slot (the
+      activation arrives via the engine's end-of-slot ppermute);
+    - ``bwd(s, m)`` needs ``fwd(s, m)`` done and, for ``s < P-1``,
+      ``bwd(s+1, m)`` completed in an earlier slot.
+
+    Every stage consumes its ops in TrainSchedule order (warmup forwards →
+    1F1B steady state → backward drain), so the result *is* the 1F1B
+    timetable with bubbles made explicit."""
+    M, P = num_microbatches, num_stages
+    seqs: List[List[Task]] = []
+    for s in range(P):
+        seqs.append([
+            t for t in TrainSchedule(M, P, s).tasks()
+            if isinstance(t, (ForwardStep, BackwardStep))
+        ])
+
+    fwd_done = [[-1] * M for _ in range(P)]
+    bwd_done = [[-1] * M for _ in range(P)]
+    idx = [0] * P
+    fwd_rows: List[List[int]] = [[] for _ in range(P)]
+    bwd_rows: List[List[int]] = [[] for _ in range(P)]
+
+    t = 0
+    while any(idx[s] < len(seqs[s]) for s in range(P)):
+        for s in range(P):
+            f_op, b_op = -1, -1
+            if idx[s] < len(seqs[s]):
+                op = seqs[s][idx[s]]
+                m = op.microbatch
+                if isinstance(op, ForwardStep):
+                    if s == 0 or 0 <= fwd_done[s - 1][m] < t:
+                        f_op = m
+                        fwd_done[s][m] = t
+                        idx[s] += 1
+                else:
+                    ready = 0 <= fwd_done[s][m] < t or (s == P - 1 and fwd_done[s][m] >= 0)
+                    if s < P - 1:
+                        ready = ready and 0 <= bwd_done[s + 1][m] < t
+                    if ready:
+                        b_op = m
+                        bwd_done[s][m] = t
+                        idx[s] += 1
+            fwd_rows[s].append(f_op)
+            bwd_rows[s].append(b_op)
+        t += 1
+        if t > 4 * (M + P) + 8:  # pragma: no cover - schedule bug guard
+            raise RuntimeError(f"1F1B slot assignment did not converge (M={M}, P={P})")
+
+    T = t
+
+    def _min_stash(intervals_by_index) -> int:
+        """Smallest K such that mb%K circular indexing never collides two
+        live intervals."""
+        for K in range(1, P + 2):
+            ok = True
+            for s_ints in intervals_by_index:
+                by_slot: dict = {}
+                for m, (lo, hi) in s_ints:
+                    by_slot.setdefault(m % K, []).append((lo, hi))
+                for spans in by_slot.values():
+                    spans.sort()
+                    for a, b in zip(spans, spans[1:]):
+                        if b[0] <= a[1]:
+                            ok = False
+            if ok:
+                return K
+        raise RuntimeError("no valid stash size <= P+1")  # pragma: no cover
+
+    # fwd stash entry for (s, m): written at end of the slot the activation
+    # is produced upstream (or during the fwd slot itself at stage 0), read
+    # at the bwd slot.
+    fwd_ints = []
+    for s in range(P):
+        ints = []
+        for m in range(M):
+            lo = fwd_done[s][m] if s == 0 else fwd_done[s - 1][m] + 1
+            ints.append((m, (lo, bwd_done[s][m])))
+        fwd_ints.append(ints)
+    # bwd (incoming-grad) stash entry for (s, m): written at end of the slot
+    # bwd(s+1, m) ran, read at bwd(s, m).  Last stage seeds its own grads.
+    bwd_ints = []
+    for s in range(P - 1):
+        ints = []
+        for m in range(M):
+            ints.append((m, (bwd_done[s + 1][m] + 1, bwd_done[s][m])))
+        bwd_ints.append(ints)
+
+    return SlotTables(
+        num_microbatches=M,
+        num_stages=P,
+        num_slots=T,
+        fwd_mb=tuple(tuple(r) for r in fwd_rows),
+        bwd_mb=tuple(tuple(r) for r in bwd_rows),
+        fwd_stash_size=_min_stash(fwd_ints),
+        bwd_stash_size=_min_stash(bwd_ints) if bwd_ints else 1,
+    )
+
+
+def build_sync_slot_tables(num_microbatches: int, num_stages: int) -> SlotTables:
+    """The *synchronous* 1F1B timetable driving the single-jit engine: every
+    tick, every stage runs one forward **and** one backward (on different
+    microbatches), so an SPMD program needs no rank-divergent control flow
+    around the collective-bearing stage compute — required because XLA
+    collectives inside a ``lax.cond`` deadlock when their participant set is
+    not a subset of the branch takers.
+
+    Closed form (stage ``s`` of ``P``, microbatch ``m`` of ``M``):
+
+    - forward of ``m`` at tick ``s + m``;
+    - backward of ``m`` at tick ``2(P-1) - s + m``;
+
+    giving ``T = M + 2(P-1)`` ticks.  Dependency check: ``bwd(s, m)`` needs
+    ``bwd(s+1, m)`` (tick ``2(P-1)-s-1+m``, one earlier) and ``fwd(s, m)``
+    (tick ``s+m``, earlier — equal only at the last stage, where the tick
+    body runs its forward before its backward).  In-flight microbatches at
+    stage ``s`` = ``2(P-1-s) + 1``: the same O(P) bound as classic 1F1B
+    (which holds ``P - s``) at twice the constant, in exchange for bubble-
+    free steady-state ticks; still independent of ``M`` — the point of 1F1B
+    over fill-drain (reference ``pipeline/scheduler.py:141-273``)."""
+    M, P = num_microbatches, num_stages
+    T = M + 2 * (P - 1)
+    fwd_rows = [
+        [t - s if 0 <= t - s < M else -1 for t in range(T)] for s in range(P)
+    ]
+    bwd_rows = [
+        [t - 2 * (P - 1) + s if 0 <= t - 2 * (P - 1) + s < M else -1 for t in range(T)]
+        for s in range(P)
+    ]
+    return SlotTables(
+        num_microbatches=M,
+        num_stages=P,
+        num_slots=T,
+        fwd_mb=tuple(tuple(r) for r in fwd_rows),
+        bwd_mb=tuple(tuple(r) for r in bwd_rows),
+        # entry for mb m is written at fwd time and read at bwd time,
+        # 2(P-1-s) ticks later; mod-K indexing needs K > that span.
+        fwd_stash_size=2 * (P - 1) + 1,
+        # incoming grad is consumed the tick after it arrives
+        bwd_stash_size=2,
+    )

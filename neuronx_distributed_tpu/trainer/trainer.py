@@ -79,14 +79,20 @@ class ParallelOptimizer:
 def initialize_parallel_model(
     config: TrainingConfig,
     model_fn: Callable[[], nn.Module],
-    example_inputs: Tuple[Any, ...],
+    example_inputs: Tuple[Any, ...] = (),
     seed: Optional[int] = None,
-) -> ParallelModel:
+):
     """Build the module and materialize its params already sharded
     (reference ``initialize_parallel_model``, ``trainer/trainer.py:95-160``).
 
     ``example_inputs`` are abstract-evaluated only — no compute runs on them.
-    """
+
+    When ``config.mesh.pipeline_parallel_size > 1`` the module must expose
+    ``build_pipelined(num_microbatches, schedule, seed)`` (the Llama family
+    does); the returned :class:`~..pipeline.engine.PipelinedModel` honors
+    ``config.pipeline.num_microbatches`` / ``config.pipeline.schedule`` —
+    the same one-config contract as the reference's pp>1 branch
+    (``trainer/trainer.py:112-115``)."""
     if not mesh_lib.model_parallel_is_initialized():
         mesh_lib.initialize_model_parallel(
             tensor_parallel_size=config.mesh.tensor_parallel_size,
@@ -97,6 +103,41 @@ def initialize_parallel_model(
         )
     mesh = get_mesh()
     module = model_fn()
+
+    mcfg = getattr(module, "config", None)
+    if mcfg is not None:
+        for field, want in (
+            ("dtype", config.jnp_compute_dtype),
+            ("param_dtype", config.jnp_param_dtype),
+        ):
+            have = getattr(mcfg, field, None)
+            if have is not None and jnp.dtype(have) != want:
+                logger.warning(
+                    "model %s=%s differs from TrainingConfig.%s=%s — the model "
+                    "config wins; build the model from config.jnp_*_dtype to align",
+                    field, jnp.dtype(have).name, field, want.name,
+                )
+
+    if config.mesh.pipeline_parallel_size > 1:
+        builder = getattr(module, "build_pipelined", None)
+        if builder is None:
+            raise ValueError(
+                f"pipeline_parallel_size={config.mesh.pipeline_parallel_size} "
+                f"but {type(module).__name__} has no build_pipelined(); "
+                "use a pipeline-capable model family or pp=1"
+            )
+        pc = config.pipeline
+        pmodel = builder(
+            num_microbatches=pc.num_microbatches,
+            schedule=pc.schedule,
+            seed=config.seed if seed is None else seed,
+        )
+        logger.info(
+            "initialized pipelined model: %.2fM params, schedule=%s, microbatches=%d",
+            pmodel.num_parameters() / 1e6, pc.schedule, pc.num_microbatches,
+        )
+        return pmodel
+
     rng = jax.random.PRNGKey(config.seed if seed is None else seed)
 
     abs_params = jax.eval_shape(module.init, rng, *example_inputs)
@@ -144,9 +185,9 @@ def initialize_parallel_optimizer(
 
 def make_train_step(
     config: TrainingConfig,
-    model: ParallelModel,
+    model: "ParallelModel | Any",
     optimizer: ParallelOptimizer,
-    loss_fn: Callable[..., Any],
+    loss_fn: Optional[Callable[..., Any]] = None,
     batch_spec: Optional[Any] = None,
 ):
     """Build the one jitted SPMD train step (replaces the reference's
@@ -155,7 +196,20 @@ def make_train_step(
 
     ``loss_fn(module, params, batch, rng) -> loss`` must return a scalar mean
     loss over the *global* batch; the DP gradient mean is then implicit in
-    autodiff over the dp-sharded batch."""
+    autodiff over the dp-sharded batch.
+
+    A :class:`~..pipeline.engine.PipelinedModel` (from
+    ``initialize_parallel_model`` with pp>1) is dispatched to
+    :func:`make_pipelined_train_step` — its built-in schedule loss replaces
+    ``loss_fn``, so one config drives TP-only and PP paths identically
+    (the reference's ``NxDModel.run_train`` contract,
+    ``trainer/model.py:23-28``)."""
+    from neuronx_distributed_tpu.pipeline.engine import PipelinedModel
+
+    if isinstance(model, PipelinedModel):
+        return make_pipelined_train_step(config, model, optimizer)
+    if loss_fn is None:
+        raise ValueError("loss_fn is required for non-pipelined models")
     oc = config.optimizer
     mesh = model.mesh
 
@@ -202,18 +256,28 @@ def make_pipelined_train_step(
 
     The batch is ``{"ids": [B, S], "labels": [B, S]}`` with
     ``B = num_microbatches * microbatch_size * dp``; loss is the exact
-    token-masked mean over the global batch, identical to the non-PP path."""
+    token-masked mean over the global batch, identical to the non-PP path.
+
+    Gradients come from ``model.loss_and_grad_fn`` — the manual-backward
+    1F1B schedule when the model was built with ``schedule="1f1b"`` (the
+    production path, matching the reference's ``TrainSchedule``), or
+    autodiff of the fill-drain loss otherwise."""
     oc = config.optimizer
     mesh = model.mesh
     param_shardings = model.param_shardings
     state_shardings = optimizer.state_shardings
 
-    def _step(params, opt_state, batch, rng):
-        def mean_loss(p):
-            loss_sum, tok = model.loss_fn(p, batch["ids"], batch["labels"])
-            return loss_sum / jnp.maximum(tok, 1.0)
+    loss_and_grad = model.loss_and_grad_fn
+    if loss_and_grad is None:  # models built before the 1F1B engine existed
+        def loss_and_grad(p, ids, labels):
+            return jax.value_and_grad(model.loss_fn, has_aux=True)(p, ids, labels)
 
-        loss, grads = jax.value_and_grad(mean_loss)(params)
+    def _step(params, opt_state, batch, rng):
+        (loss_sum, tok), grads = loss_and_grad(params, batch["ids"], batch["labels"])
+        tok = jnp.maximum(tok, 1.0)
+        loss = loss_sum / tok
+        # d(mean)/dp = d(sum)/dp / tok — tok depends only on the labels
+        grads = jax.tree.map(lambda g: (g / tok).astype(g.dtype), grads)
         if oc.grad_clipping:
             grads, grad_norm = clip_grad_norm(grads, oc.max_grad_norm)
         else:

@@ -33,6 +33,10 @@ from neuronx_distributed_tpu.pipeline import (
     partition_uniform,
     spans_from_cuts,
 )
+from neuronx_distributed_tpu.pipeline.scheduler import (
+    build_slot_tables,
+    build_sync_slot_tables,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -109,6 +113,55 @@ def test_train_schedule_1f1b_interleaving():
             # at least: recvs are interleaved, not all trailing
             assert rb and sf
             break
+
+
+@pytest.mark.parametrize("num_mb,num_stages", [(8, 4), (4, 4), (1, 4), (3, 2), (8, 8)])
+def test_slot_tables(num_mb, num_stages):
+    """Both slot-table realizations honor 1F1B dependencies and bounds."""
+    for build in (build_slot_tables, build_sync_slot_tables):
+        st = build(num_mb, num_stages)
+        M, P, T = st.num_microbatches, st.num_stages, st.num_slots
+        fwd_done = [[-1] * M for _ in range(P)]
+        bwd_done = [[-1] * M for _ in range(P)]
+        for s in range(P):
+            # every mb forwarded and backwarded exactly once, in order
+            assert [m for m in st.fwd_mb[s] if m >= 0] == list(range(M))
+            assert [m for m in st.bwd_mb[s] if m >= 0] == list(range(M))
+            for t in range(T):
+                if st.fwd_mb[s][t] >= 0:
+                    fwd_done[s][st.fwd_mb[s][t]] = t
+                if st.bwd_mb[s][t] >= 0:
+                    bwd_done[s][st.bwd_mb[s][t]] = t
+        for s in range(P):
+            for m in range(M):
+                # fwd needs the previous stage's fwd strictly earlier
+                if s > 0:
+                    assert fwd_done[s - 1][m] < fwd_done[s][m]
+                # bwd needs the next stage's bwd strictly earlier, and own
+                # fwd not later (same tick allowed: fwd runs first in-tick)
+                if s < P - 1:
+                    assert bwd_done[s + 1][m] < bwd_done[s][m]
+                assert fwd_done[s][m] <= bwd_done[s][m]
+        # in-flight (fwd done, bwd pending) bounded by the declared stash
+        for s in range(P):
+            live = peak = 0
+            for t in range(T):
+                if st.fwd_mb[s][t] >= 0:
+                    live += 1
+                    peak = max(peak, live)
+                if st.bwd_mb[s][t] >= 0:
+                    live -= 1
+            assert peak <= st.fwd_stash_size
+
+
+def test_sync_slot_tables_shape():
+    st = build_sync_slot_tables(8, 4)
+    assert st.num_slots == 8 + 2 * 3
+    assert st.fwd_stash_size == 7  # 2(P-1)+1
+    # steady-state ticks are bubble-free: every stage does one F and one B
+    mid = range(2 * 3, 8)  # ticks where stage 0 has both
+    for t in mid:
+        assert st.fwd_mb[0][t] >= 0 and st.bwd_mb[0][t] >= 0
 
 
 def test_inference_schedule():
@@ -194,7 +247,8 @@ def _setup(devices8, pp, tp, num_mb, sp=False, num_kv_heads=8):
         max_seq_len=16,
     )
     pmodel = build_pipelined_llama(cfg, num_microbatches=num_mb, seed=3)
-    B, S = 4, 16
+    dp = 8 // (pp * tp)  # manual-dp engines need mb size divisible by dp
+    B, S = num_mb * dp, 16
     ids = jax.random.randint(jax.random.PRNGKey(0), (B, S), 0, cfg.vocab_size)
     labels = jnp.roll(ids, -1, axis=1)
     return cfg, pmodel, ids, labels
@@ -264,6 +318,69 @@ def test_pipelined_grads_match_dense(devices8):
         )
         want = np.asarray(d_grads["model"][f"layer_{i}"]["attn"]["qkv"]["q_kernel"])
         np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4, err_msg=f"layer {i}")
+
+
+@pytest.mark.parametrize("pp,tp,num_mb,kv,sp,kvr", [
+    (2, 2, 2, 8, False, 1),
+    (4, 1, 4, 8, False, 1),
+    (2, 2, 4, 8, True, 1),
+    (2, 2, 4, 2, True, 2),
+])
+def test_1f1b_grads_match_gpipe_autodiff(devices8, pp, tp, num_mb, kv, sp, kvr):
+    """The manual-backward 1F1B engine reproduces autodiff gradients exactly
+    (the production schedule vs the differentiable fill-drain oracle)."""
+    nxd.initialize_model_parallel(
+        tensor_parallel_size=tp * kvr, pipeline_parallel_size=pp,
+        kv_size_multiplier=kvr, devices=devices8[: pp * tp * kvr],
+    )
+    cfg = LlamaConfig.tiny(
+        num_layers=4, num_heads=8, num_kv_heads=kv, sequence_parallel=sp,
+        remat="none", dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16,
+    )
+    pmodel = build_pipelined_llama(cfg, num_microbatches=num_mb, seed=3, schedule="1f1b")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2 * num_mb, 16), 0, cfg.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+
+    (ls, tok), grads = jax.jit(pmodel.loss_and_grad_fn)(pmodel.params, ids, labels)
+    (ls2, tok2), g2 = jax.jit(
+        lambda p, i, l: jax.value_and_grad(pmodel.loss_fn, has_aux=True)(p, i, l)
+    )(pmodel.params, ids, labels)
+
+    assert float(ls) == pytest.approx(float(ls2), rel=1e-5)
+    assert float(tok) == float(tok2)
+    for (k1, a), (k2, b) in zip(
+        jax.tree_util.tree_flatten_with_path(grads)[0],
+        jax.tree_util.tree_flatten_with_path(g2)[0],
+    ):
+        assert k1 == k2
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(k1),
+        )
+
+
+def test_1f1b_memory_below_fill_drain(devices8):
+    """VERDICT r1 #3 'done' criterion: measured peak activation (temp)
+    memory of the 1F1B engine < fill-drain autodiff at PP4/M8."""
+    nxd.initialize_model_parallel(
+        tensor_parallel_size=1, pipeline_parallel_size=4, devices=devices8[:4]
+    )
+    cfg = LlamaConfig.tiny(
+        num_layers=4, sequence_parallel=False, remat="none",
+        dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=128,
+    )
+    ids = jax.random.randint(jax.random.PRNGKey(0), (16, 128), 0, cfg.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+    temps = {}
+    for sched in ("1f1b", "gpipe"):
+        pm = build_pipelined_llama(cfg, num_microbatches=8, seed=3, schedule=sched)
+        compiled = jax.jit(pm.loss_and_grad_fn).lower(pm.params, ids, labels).compile()
+        stats = compiled.memory_analysis()
+        if stats is None or not hasattr(stats, "temp_size_in_bytes"):
+            pytest.skip("backend does not report memory stats")
+        temps[sched] = stats.temp_size_in_bytes
+    # bounded stash (O(P)) vs all-ticks residuals (O(M+P)): expect a big gap
+    assert temps["1f1b"] < 0.5 * temps["gpipe"], temps
 
 
 def test_pipelined_train_step(devices8):
