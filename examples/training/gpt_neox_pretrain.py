@@ -81,7 +81,13 @@ def main():
         it = iter(loader)
 
         def next_batch(step):
-            b = next(it)
+            # wrap into the next epoch on exhaustion (mirrors llama_pretrain)
+            nonlocal it
+            b = next(it, None)
+            if b is None:
+                loader.set_epoch(step // max(len(loader), 1))
+                it = iter(loader)
+                b = next(it)
             return {"ids": jnp.asarray(b["ids"]), "labels": jnp.asarray(b["labels"])}
     else:
         def next_batch(step):

@@ -154,11 +154,15 @@ void build_order(NxdLoader* L) {
     uint64_t j = splitmix64(state) % i;
     std::swap(all[i - 1], all[j]);
   }
-  // round-robin DP partition, then truncate to whole batches
+  // round-robin DP partition, truncated to a globally uniform batch count:
+  // every rank must yield the same number of batches or the longer ranks
+  // block forever in the first collective after a short rank's loader is
+  // exhausted (the reference's DistributedSampler pads/truncates likewise)
   L->order.clear();
   for (uint64_t i = L->dp_rank; i < total; i += L->dp_size)
     L->order.push_back(all[i]);
-  L->num_batches = L->order.size() / L->batch;
+  uint64_t per_rank = total / L->dp_size;  // min share across ranks
+  L->num_batches = per_rank / L->batch;
   L->order.resize(L->num_batches * L->batch);
 }
 

@@ -224,9 +224,10 @@ class TokenDataLoader:
                 raise ValueError("native loader creation failed")
             self.num_batches = int(lib.nxd_loader_num_batches(self._loader))
         else:
+            # globally uniform count (min share across ranks) so every dp
+            # rank yields the same number of batches — mirrors loader.cpp
             total = dataset.num_chunks(seq_len)
-            per_rank = len(range(dp_rank, total, dp_size))
-            self.num_batches = per_rank // batch_size
+            self.num_batches = (total // dp_size) // batch_size
 
     def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
         """Reshuffle for ``epoch`` and reset the cursor; call before each

@@ -60,6 +60,29 @@ def test_epoch_covers_every_chunk_once(token_file):
     ds.close()
 
 
+def test_uniform_batch_count_across_ranks(token_file):
+    """Every dp rank must see the same number of batches even when the chunk
+    count does not divide dp_size (63 chunks / dp=4 here) — otherwise the
+    longer ranks block in the first collective after a short rank's loader
+    is exhausted.  Both the native and numpy paths must agree."""
+    path, _ = token_file
+    ds = TokenDataset(path)
+    seq = 64
+    total = ds.num_chunks(seq)
+    assert total % 4 != 0  # the fixture must exercise the ragged case
+    counts, yielded = [], []
+    for rank in range(4):
+        dl = TokenDataLoader(ds, batch_size=2, seq_len=seq, dp_rank=rank,
+                             dp_size=4, seed=7)
+        counts.append(len(dl))
+        yielded.append(sum(1 for _ in dl))
+        dl.close()
+    assert counts == yielded
+    assert len(set(counts)) == 1, counts
+    assert counts[0] == (total // 4) // 2
+    ds.close()
+
+
 def test_determinism_and_epoch_variation(token_file):
     path, _ = token_file
     ds = TokenDataset(path)
