@@ -25,6 +25,7 @@ full training (fp32 master params + fp32 Adam states + bf16 compute), seq
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -40,13 +41,18 @@ PEAK_FLOPS = {
     "cpu": 1e12,  # nominal, for smoke runs
 }
 
-# (platform, attention_impl, batch) tried in order; first success wins.
+# (platform, attention_impl, batch, remat) tried in order; first success wins.
+# flash-without-remat leads: flash attention never materializes the [S,S]
+# score matrix, so the 438M bench model's activations fit HBM un-remated and
+# the recompute FLOPs remat would add (not counted by the MFU formula's
+# 6*params accounting) are simply not spent.
 LADDER = [
-    ("tpu", "flash", 8),
-    ("tpu", "flash", 4),
-    ("tpu", "dense", 4),
-    ("tpu", "dense", 2),
-    ("cpu", "dense", 2),
+    ("tpu", "flash", 8, "none"),
+    ("tpu", "flash", 8, "selective"),
+    ("tpu", "flash", 4, "selective"),
+    ("tpu", "dense", 4, "selective"),
+    ("tpu", "dense", 2, "selective"),
+    ("cpu", "dense", 2, "none"),
 ]
 ATTEMPT_TIMEOUT_S = 900
 PROBE_TIMEOUT_S = 420
@@ -61,7 +67,7 @@ def peak_flops_for(device) -> float:
     return 197e12
 
 
-def run_measurement(platform: str, attn: str, batch: int) -> dict:
+def run_measurement(platform: str, attn: str, batch: int, remat: str) -> dict:
     """Child-process body: build the model, time steps, return the result.
 
     Raises on any failure; the parent ladder decides what to try next."""
@@ -95,7 +101,7 @@ def run_measurement(platform: str, attn: str, batch: int) -> dict:
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_layers=12, num_heads=12, num_kv_heads=12, head_dim=128,
-            max_seq_len=2048, sequence_parallel=n > 1, remat="selective",
+            max_seq_len=2048, sequence_parallel=n > 1, remat=remat,
             attention_impl=attn,
         )
         seq, steps, warmup = 2048, 10, 3
@@ -120,15 +126,29 @@ def run_measurement(platform: str, attn: str, batch: int) -> dict:
     data = {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
     params, state = model.params, opt.state
 
+    # Synchronization discipline (round-2 post-mortem): round 2 published a
+    # 4,139%-MFU number — the ``block_until_ready(m["loss"])`` sync evidently
+    # returned ~40x before execution finished on that run.  A round-3
+    # side-by-side probe could NOT reproduce the early return (block waited
+    # correctly), so the cause was a transient runtime/tunnel flake rather
+    # than a systematic semantic — which is exactly why the sync here is
+    # ``device_get`` of the final step's loss: the bytes cannot exist before
+    # the step executed, and step i+1 consumes step i's params, so fetching
+    # the LAST loss transitively proves every timed step ran.  Anything that
+    # still slips through dies on the plausibility gate below.  The fetched
+    # value is also checked finite: a step that executed but produced NaN is
+    # a failed attempt, not a throughput number.
     for i in range(warmup):
         params, state, m = step(params, state, data, jax.random.PRNGKey(i))
-    jax.block_until_ready(m["loss"])
+    float(jax.device_get(m["loss"]))
 
     t0 = time.perf_counter()
     for i in range(steps):
         params, state, m = step(params, state, data, jax.random.PRNGKey(i))
-    jax.block_until_ready(m["loss"])
+    loss_val = float(jax.device_get(m["loss"]))
     dt = time.perf_counter() - t0
+    if not math.isfinite(loss_val):
+        raise RuntimeError(f"non-finite loss after {warmup + steps} steps: {loss_val}")
 
     tokens_per_sec = batch * seq * steps / dt
     tokens_per_sec_per_chip = tokens_per_sec / n
@@ -136,13 +156,28 @@ def run_measurement(platform: str, attn: str, batch: int) -> dict:
         cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
         seq, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_,
     )
-    achieved_mfu = mfu(tokens_per_sec_per_chip, fpt, peak_flops_for(devices[0]))
+    peak = peak_flops_for(devices[0])
+    achieved_mfu = mfu(tokens_per_sec_per_chip, fpt, peak)
+
+    # Physical-plausibility gate: mfu() returns a FRACTION of chip peak; a
+    # value >= 1 (tokens/s above peak_flops/flops_per_token) is impossible
+    # and means the timing harness did not measure the device.  Hard-fail
+    # the attempt so an unsynchronized runtime can never publish a number
+    # (ADVICE r2: no super-peak measurement may be recorded as a success).
+    ceiling = peak / fpt
+    if not (0.0 < achieved_mfu < 1.0):
+        raise RuntimeError(
+            f"implausible measurement: {tokens_per_sec_per_chip:,.0f} tokens/s/chip "
+            f"=> mfu={achieved_mfu:.3f} (ceiling {ceiling:,.0f} tokens/s/chip at "
+            f"mfu=1.0); the timed loop did not synchronize with device execution"
+        )
 
     return {
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_per_chip, 2),
         "unit": (
             f"tokens/s/chip (mfu={achieved_mfu:.3f}, attn={attn}, batch={batch},"
+            f" remat={remat},"
             f" model={model.num_parameters()/1e6:.0f}M, seq={seq},"
             f" device={devices[0].device_kind})"
         ),
@@ -167,7 +202,7 @@ def child_main(args) -> int:
         print(f"probe ok: {len(devs)}x {devs[0].device_kind}", file=sys.stderr)
         return 0
     try:
-        result = run_measurement(args.platform, args.attn, args.batch)
+        result = run_measurement(args.platform, args.attn, args.batch, args.remat)
     except Exception as e:  # noqa: BLE001 — report, parent decides
         print(f"bench attempt failed: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
@@ -209,14 +244,15 @@ def parent_main() -> int:
     # Step 2: measurement ladder, first success wins.  Two timed-out TPU
     # attempts disqualify the remaining TPU rungs (a hang, not an OOM).
     tpu_timeouts = 0
-    for platform, attn, batch in LADDER:
+    for platform, attn, batch, remat in LADDER:
         if platform == "tpu" and (not tpu_ok or tpu_timeouts >= 2):
             continue
         env = dict(os.environ)
         if platform == "cpu":
             env["JAX_PLATFORMS"] = "cpu"
         proc = _run_child(
-            [f"--platform={platform}", f"--attn={attn}", f"--batch={batch}"],
+            [f"--platform={platform}", f"--attn={attn}", f"--batch={batch}",
+             f"--remat={remat}"],
             ATTEMPT_TIMEOUT_S, env,
         )
         if proc is None:
@@ -255,6 +291,7 @@ def main():
     p.add_argument("--platform", default="tpu")
     p.add_argument("--attn", default="dense")
     p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--remat", default="selective")
     args = p.parse_args()
     sys.exit(child_main(args) if args.run else parent_main())
 

@@ -44,11 +44,13 @@ class PipelineConfig:
 class ActivationCheckpointConfig:
     """Reference: activation_checkpoint_config (``trainer/trainer.py:131-158``).
 
-    ``policy``: "none" | "full" | "selective" — selective remats attention+MLP
-    cores like the reference's CoreAttention/MLP checkpointing
-    (``modeling_llama_nxd.py:184-187``)."""
+    ``policy``: ``None`` (default) defers to the model config's own ``remat``
+    field; "none" | "full" | "selective" *overrides* it — the trainer rebuilds
+    the module with ``remat=policy`` (selective remats attention+MLP cores
+    like the reference's CoreAttention/MLP checkpointing,
+    ``modeling_llama_nxd.py:184-187``)."""
 
-    policy: str = "selective"
+    policy: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -43,6 +43,18 @@ def _auto_interpret(interpret: Optional[bool]) -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _compiler_params(dimension_semantics, interpret: bool):
+    """Mosaic grid-dimension semantics: batch/head/q-block dims are
+    embarrassingly parallel; only the kv (resp. q) accumulation dim is
+    sequential ("arbitrary").  Declaring this lets Mosaic pipeline and
+    parallelize grid steps instead of running the whole grid serially.
+    The interpreter ignores compiler params; pass None to keep interpret
+    mode permissive."""
+    if interpret or pltpu is None:
+        return None
+    return pltpu.CompilerParams(dimension_semantics=dimension_semantics)
+
+
 def _block_sizes(s: int, t: int, block_q: int, block_k: int) -> Tuple[int, int]:
     bq, bk = min(block_q, s), min(block_k, t)
     if s % bq != 0 or t % bk != 0:
@@ -94,12 +106,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
-        v = v_ref[0, 0].astype(jnp.float32)
+        # MXU dots consume the NATIVE (bf16) operands with fp32 accumulation
+        # (preferred_element_type) — casting inputs to fp32 first would push
+        # the matmuls onto the fp32 path at a fraction of bf16 throughput.
+        q = q_ref[0, 0]  # [bq, D]
+        k = k_ref[0, 0]  # [bk, D]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [bq, bk]
+        ) * sm_scale  # [bq, bk] fp32
         if causal:
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -110,10 +125,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        p = jnp.exp(s - m_new)  # fp32 probabilities
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -152,6 +168,8 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
+        compiler_params=_compiler_params(("parallel", "parallel", "parallel", "arbitrary"),
+                                         interpret),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
@@ -190,10 +208,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 operands into every MXU dot, fp32 accumulation (see _fwd_kernel)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
 
@@ -208,7 +227,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         acc_scr[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -234,10 +253,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 operands into every MXU dot, fp32 accumulation (see _fwd_kernel)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
 
@@ -248,14 +268,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        p = jnp.exp(s - lse)  # [bq, bk] fp32
+        pb = p.astype(do.dtype)
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            pb, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # p^T @ do -> [bk, D]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale  # [bq, bk]
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)  # [bq, bk]
         dk_scr[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # ds^T @ q -> [bk, D]
@@ -286,6 +307,8 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
             num_kv_blocks=nk, kv_offset=kv_offset,
         ),
         grid=(B, HQ, nq, nk),
+        compiler_params=_compiler_params(("parallel", "parallel", "parallel", "arbitrary"),
+                                         interpret),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
@@ -307,6 +330,8 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
             num_q_blocks=nq, kv_offset=kv_offset,
         ),
         grid=(B, HQ, nk, nq),
+        compiler_params=_compiler_params(("parallel", "parallel", "parallel", "arbitrary"),
+                                         interpret),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi, G=G: (b, h // G, ki, 0)),

@@ -34,6 +34,7 @@ Design notes
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -45,11 +46,47 @@ from neuronx_distributed_tpu.ops.flash_attention import (
     flash_attention_with_lse,
 )
 from neuronx_distributed_tpu.parallel.mesh import (
+    BATCH_AXES,
     CONTEXT_AXIS,
     KV_REPLICA_AXIS,
+    MESH_AXES,
     TENSOR_AXIS,
     get_mesh,
 )
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+_AXIS_ENV_WARNED = False
+
+
+def _ambient_manual_axes() -> frozenset:
+    """Mesh axes already manual in the enclosing trace context.
+
+    Inside a ``shard_map`` body the manual axes are bound in JAX's axis
+    environment (that's what makes ``lax.psum(x, 'dp')`` legal there), so the
+    environment tells us which axes an enclosing shard_map — e.g. the 1F1B
+    engine's manual ``(dp, ep, pp)`` — already owns.  The shard_map built
+    here must go manual over exactly the *rest*: Mosaic kernels refuse to be
+    auto-partitioned, so every mesh axis has to be manual by the time the
+    pallas call lowers, but re-declaring an already-manual axis is an error.
+    """
+    try:
+        from jax._src.core import get_axis_env
+
+        return frozenset(get_axis_env().axis_sizes) & frozenset(MESH_AXES)
+    except Exception as e:  # pragma: no cover - internals moved in a JAX bump
+        # Loud, not fatal: top-level calls still work with the empty set, but
+        # a nested call (inside the 1F1B engine) would re-declare the outer
+        # manual axes and fail — surface the real cause in the log.
+        global _AXIS_ENV_WARNED
+        if not _AXIS_ENV_WARNED:
+            _AXIS_ENV_WARNED = True
+            logger.warning(
+                "jax._src.core.get_axis_env unavailable (%s): cannot detect "
+                "enclosing shard_map manual axes; ring/flash attention inside "
+                "the pipeline engine may fail to trace on this JAX version", e,
+            )
+        return frozenset()
 
 
 def _dense_chunk_attn(q, k, v, causal: bool, sm_scale: float) -> Tuple[jax.Array, jax.Array]:
@@ -254,8 +291,28 @@ def ring_attention(
     B, S, NQ, D = q.shape
     scale = (D ** -0.5) if sm_scale is None else sm_scale
 
+    # Go manual over every mesh axis not already manual in the enclosing
+    # context (the 1F1B engine's shard_map owns dp/ep/pp; at top level the
+    # set is empty and ALL axes go manual here).  Mosaic kernels cannot be
+    # auto-partitioned — any Auto axis left when the pallas call lowers is a
+    # hard NotImplementedError on TPU (the round-2 bench failure) — so the
+    # batch dim is split explicitly over dp/ep instead of being left to
+    # GSPMD.  Axes this shard_map does not own must not appear in its specs.
+    ambient = _ambient_manual_axes()
+    new_manual = frozenset(a for a in MESH_AXES if a not in ambient)
+    batch_axes = tuple(a for a in BATCH_AXES if a in new_manual)
+    head_axes = tuple(a for a in (TENSOR_AXIS, KV_REPLICA_AXIS) if a in new_manual)
+    kv_head_axes = tuple(a for a in (TENSOR_AXIS,) if a in new_manual)
+    seq_axes = CONTEXT_AXIS if CONTEXT_AXIS in new_manual else None
+
     if S % cp != 0:
         raise ValueError(f"sequence length {S} not divisible by cp degree {cp}")
+    bdiv = math.prod(mesh.shape[a] for a in batch_axes)
+    if B % bdiv != 0:
+        # Batch not splittable over the dp/ep degree (e.g. a B=1 probe on a
+        # dp>1 mesh): replicate it instead — every dp rank redundantly
+        # computes the full batch, numerically identical, never wrong.
+        batch_axes = ()
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
     if layout == "zigzag":
@@ -271,11 +328,8 @@ def ring_attention(
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
 
-    # Manual only over the axes the ring needs; batch/pipeline axes stay
-    # under GSPMD so the op composes inside any jit regardless of how the
-    # caller shards the batch dim.
-    q_spec = P(None, (TENSOR_AXIS, KV_REPLICA_AXIS), CONTEXT_AXIS, None)
-    kv_spec = P(None, TENSOR_AXIS, CONTEXT_AXIS, None)
+    q_spec = P(batch_axes or None, head_axes or None, seq_axes, None)
+    kv_spec = P(batch_axes or None, kv_head_axes or None, seq_axes, None)
 
     if layout == "zigzag":
         def body(qs, ks, vs):
@@ -291,12 +345,15 @@ def ring_attention(
                 interpret=interpret,
             )
 
+    # Nested shard_map (inside the PP engine) must receive the current
+    # *abstract* mesh, whose axis_types record the outer manual axes.
+    mesh_arg = jax.sharding.get_abstract_mesh() if ambient else mesh
     o = jax.shard_map(
         body,
-        mesh=mesh,
+        mesh=mesh_arg,
         in_specs=(q_spec, kv_spec, kv_spec),
         out_specs=q_spec,
-        axis_names=frozenset({CONTEXT_AXIS, TENSOR_AXIS, KV_REPLICA_AXIS}),
+        axis_names=new_manual,
         check_vma=False,
     )(qt, kt, vt)
     return o.transpose(0, 2, 1, 3)

@@ -1,0 +1,117 @@
+"""Designated-rank scalar logging (TensorBoard + JSONL).
+
+Re-design of the reference's Lightning TensorBoard logger, which writes
+scalars only on the (dp_rank 0, tp_rank 0, last-pp-stage) rank
+(``lightning/logger.py:128-136``) so a 256-way job produces one event stream.
+
+Under SPMD-jit there is no per-device Python rank — one *process* drives many
+devices and every metric that leaves a jitted step is already a global (mesh-
+invariant) scalar: the loss is psum'd over dp/pp inside the step and grad-norm
+is computed over the full mesh.  The designated-rank condition therefore
+collapses to "exactly one host process writes", i.e. ``jax.process_index() ==
+0`` — the same stream-deduplication goal with none of the rank plumbing.
+
+Backend: ``torch.utils.tensorboard`` when importable (torch ships in the
+image; TensorBoard event files are what the reference's convergence
+comparator ``compare_gpu_trn1_metrics.py:19-60`` consumes), always paired
+with a plain JSONL mirror (one ``{"step", "tag", "value", "time"}`` object
+per line) that the in-repo comparator (:mod:`..testing.convergence`) reads
+without a TensorBoard dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def is_designated_writer() -> bool:
+    """True on the single process that should emit scalar streams
+    (reference gate: dp0/tp0/last-pp rank, ``lightning/logger.py:128-136``)."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+class ScalarWriter:
+    """Scalar stream writer, active only on the designated process.
+
+    On non-designated processes every method is a no-op, so call sites need
+    no rank guards (the reference wraps each ``log()`` in rank checks;
+    here the gate lives in one place).
+    """
+
+    def __init__(self, log_dir: str, use_tensorboard: bool = True):
+        self.log_dir = log_dir
+        self.active = is_designated_writer()
+        self._tb = None
+        self._jsonl = None
+        if not self.active:
+            return
+        os.makedirs(log_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(log_dir, "scalars.jsonl"), "a", buffering=1)
+        if use_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=log_dir)
+            except Exception as e:  # pragma: no cover - torch/tb not installed
+                logger.warning("tensorboard writer unavailable (%s); JSONL only", e)
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        if not self.active:
+            return
+        value = float(value)
+        self._jsonl.write(
+            json.dumps({"step": int(step), "tag": tag, "value": value, "time": time.time()})
+            + "\n"
+        )
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, global_step=int(step))
+
+    def scalars(self, step: int, **tags: float) -> None:
+        for tag, value in tags.items():
+            self.scalar(tag, value, step)
+
+    def flush(self) -> None:
+        if not self.active:
+            return
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        if not self.active:
+            return
+        self.flush()
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+
+    def __enter__(self) -> "ScalarWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_scalars(log_dir: str, tag: Optional[str] = None):
+    """Load the JSONL scalar stream back as a list of dicts (optionally
+    filtered by tag) — the input format of the convergence comparator."""
+    path = os.path.join(log_dir, "scalars.jsonl")
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if tag is None or rec["tag"] == tag:
+                out.append(rec)
+    return out
