@@ -55,7 +55,8 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     cfg = getattr(BertConfig, args.preset)(
         dtype=jnp.bfloat16 if on_tpu else jnp.float32, param_dtype=jnp.float32)
-    config = nxd.training_config(tensor_parallel_size=args.tp, learning_rate=args.lr)
+    config = nxd.training_config(tensor_parallel_size=args.tp, learning_rate=args.lr,
+                                 compute_dtype="bfloat16" if on_tpu else "float32")
     model = initialize_parallel_model(
         config, lambda: BertForPreTraining(cfg),
         (jnp.zeros((1, args.seq_len), jnp.int32),), seed=args.seed)

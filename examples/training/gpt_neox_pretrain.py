@@ -63,7 +63,8 @@ def main():
     )
     config = nxd.training_config(
         tensor_parallel_size=args.tp, learning_rate=args.lr,
-        zero_one_enabled=not args.no_zero1)
+        zero_one_enabled=not args.no_zero1,
+        compute_dtype="bfloat16" if on_tpu else "float32")
     model = initialize_parallel_model(
         config, lambda: GPTNeoXForCausalLM(cfg),
         (jnp.zeros((1, args.seq_len), jnp.int32),), seed=args.seed)

@@ -1,0 +1,11 @@
+"""HF-checkpoint interoperability (reference:
+``examples/training/llama2/convert_checkpoints.py`` HF↔NxD conversion)."""
+
+from neuronx_distributed_tpu.convert.hf import (  # noqa: F401
+    bert_params_from_hf,
+    bert_params_to_hf,
+    gpt_neox_params_from_hf,
+    gpt_neox_params_to_hf,
+    llama_params_from_hf,
+    llama_params_to_hf,
+)

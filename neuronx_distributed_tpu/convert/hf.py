@@ -1,0 +1,345 @@
+"""HuggingFace ↔ framework weight converters for the three model families.
+
+The reference ships a script-level HF↔NxD checkpoint converter
+(``examples/training/llama2/convert_checkpoints.py``); here conversion is a
+library function over plain numpy state dicts, because the interesting work
+is *layout algebra*, not IO:
+
+- torch ``nn.Linear`` stores ``weight [out, in]``; flax kernels are
+  ``[in, out]`` → transpose everywhere;
+- fused projections: the framework's ``n_fused`` kernels carry an explicit
+  fused axis ``[in, F, out/F]`` (``parallel/layers.py``), Llama's GQA module
+  stores per-head kernels ``[in, n_heads, head_dim]`` (``parallel/qkv.py``);
+- **GPT-NeoX's QKV is interleaved per head** (HF rows ordered
+  ``[head0-q, head0-k, head0-v, head1-q, ...]``) while the framework uses a
+  clean fused axis — the converter de-interleaves with a reshape/transpose;
+- GQA q-head ordering: both HF Llama and the framework index q-head ``h``'s
+  kv head as ``h // (NQ/NKV)``, so no head permutation is needed — the
+  framework's "kv-major" property lives in the *sharding spec*
+  (``Q_HEAD_AXES``), not the data layout;
+- head/vocab padding for indivisible TP degrees is applied AFTER conversion
+  via :func:`..parallel.pad.pad_llama_params` (zero-padded heads are
+  function-preserving by construction).
+
+All functions take/return flat ``{hf_key: np.ndarray}`` dicts on the HF side
+(what ``model.state_dict()`` or a safetensors file yields) and nested flax
+param trees (the ``{"params": ...}`` dict) on the framework side.  Arrays
+are numpy on output — shard placement happens downstream via
+``jax.device_put`` with the model's param shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+
+def _np(x) -> np.ndarray:
+    if hasattr(x, "detach"):  # torch tensor
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# Llama
+# ---------------------------------------------------------------------------
+
+
+def llama_params_from_hf(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
+    """HF ``LlamaForCausalLM.state_dict()`` → framework param tree for
+    :class:`~..models.llama.LlamaForCausalLM` with config ``cfg``."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    H, D = cfg.hidden_size, cfg.head_dim_
+    NQ, NKV, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+
+    model: Dict[str, Any] = {
+        "embed": {"embedding": sd["model.embed_tokens.weight"]},
+        "final_norm": {"weight": sd["model.norm.weight"]},
+    }
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        model[f"layer_{i}"] = {
+            "attn": {
+                "qkv": {
+                    "q_kernel": sd[p + "self_attn.q_proj.weight"].T.reshape(H, NQ, D),
+                    "k_kernel": sd[p + "self_attn.k_proj.weight"].T.reshape(H, NKV, D),
+                    "v_kernel": sd[p + "self_attn.v_proj.weight"].T.reshape(H, NKV, D),
+                },
+                "o_proj": {"kernel": sd[p + "self_attn.o_proj.weight"].T},
+            },
+            "mlp": {
+                "gate_up": {
+                    "kernel": np.stack(
+                        [sd[p + "mlp.gate_proj.weight"].T, sd[p + "mlp.up_proj.weight"].T],
+                        axis=1,
+                    )  # [H, 2, I]
+                },
+                "down": {"kernel": sd[p + "mlp.down_proj.weight"].T},
+            },
+            "input_norm": {"weight": sd[p + "input_layernorm.weight"]},
+            "post_attn_norm": {"weight": sd[p + "post_attention_layernorm.weight"]},
+        }
+    lm_head = sd.get("lm_head.weight")
+    if lm_head is None:  # tied-embedding HF checkpoints omit it
+        lm_head = sd["model.embed_tokens.weight"]
+    return {"params": {"model": model, "lm_head": {"kernel": lm_head.T}}}
+
+
+def llama_params_to_hf(params: Mapping[str, Any], cfg) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`llama_params_from_hf` (framework → HF state dict)."""
+    tree = params.get("params", params)
+    model, head = tree["model"], tree["lm_head"]
+    H = cfg.hidden_size
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(model["embed"]["embedding"]),
+        "model.norm.weight": _np(model["final_norm"]["weight"]),
+        "lm_head.weight": _np(head["kernel"]).T,
+    }
+    for i in range(cfg.num_layers):
+        lyr = model[f"layer_{i}"]
+        p = f"model.layers.{i}."
+        qkv = lyr["attn"]["qkv"]
+        gu = _np(lyr["mlp"]["gate_up"]["kernel"])  # [H, 2, I]
+        out.update({
+            p + "self_attn.q_proj.weight": _np(qkv["q_kernel"]).reshape(H, -1).T,
+            p + "self_attn.k_proj.weight": _np(qkv["k_kernel"]).reshape(H, -1).T,
+            p + "self_attn.v_proj.weight": _np(qkv["v_kernel"]).reshape(H, -1).T,
+            p + "self_attn.o_proj.weight": _np(lyr["attn"]["o_proj"]["kernel"]).T,
+            p + "mlp.gate_proj.weight": gu[:, 0, :].T,
+            p + "mlp.up_proj.weight": gu[:, 1, :].T,
+            p + "mlp.down_proj.weight": _np(lyr["mlp"]["down"]["kernel"]).T,
+            p + "input_layernorm.weight": _np(lyr["input_norm"]["weight"]),
+            p + "post_attention_layernorm.weight": _np(lyr["post_attn_norm"]["weight"]),
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GPT-NeoX
+# ---------------------------------------------------------------------------
+
+
+def _neox_deinterleave(w_qkv: np.ndarray, b_qkv: np.ndarray, num_heads: int, head_dim: int):
+    """HF NeoX fused QKV rows are per-head interleaved ``[n,(q|k|v),d]``;
+    the framework's fused axis wants ``[in, 3, n*d]``."""
+    H_in = w_qkv.shape[1]
+    w = w_qkv.T.reshape(H_in, num_heads, 3, head_dim)
+    w = w.transpose(0, 2, 1, 3).reshape(H_in, 3, num_heads * head_dim)
+    b = b_qkv.reshape(num_heads, 3, head_dim).transpose(1, 0, 2).reshape(3, -1)
+    return w, b
+
+
+def _neox_interleave(w: np.ndarray, b: np.ndarray, num_heads: int, head_dim: int):
+    H_in = w.shape[0]
+    wq = w.reshape(H_in, 3, num_heads, head_dim).transpose(0, 2, 1, 3)
+    wq = wq.reshape(H_in, 3 * num_heads * head_dim).T
+    bq = b.reshape(3, num_heads, head_dim).transpose(1, 0, 2).reshape(-1)
+    return wq, bq
+
+
+def gpt_neox_params_from_hf(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
+    """HF ``GPTNeoXForCausalLM.state_dict()`` → framework param tree."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    N, D = cfg.num_heads, cfg.head_dim
+
+    tree: Dict[str, Any] = {
+        "embed_in": {"embedding": sd["gpt_neox.embed_in.weight"]},
+        "final_norm": {
+            "weight": sd["gpt_neox.final_layer_norm.weight"],
+            "bias": sd["gpt_neox.final_layer_norm.bias"],
+        },
+        "embed_out": {"kernel": sd["embed_out.weight"].T},
+    }
+    for i in range(cfg.num_layers):
+        p = f"gpt_neox.layers.{i}."
+        wq, bq = _neox_deinterleave(
+            sd[p + "attention.query_key_value.weight"],
+            sd[p + "attention.query_key_value.bias"], N, D,
+        )
+        tree[f"layer_{i}"] = {
+            "ln_1": {
+                "weight": sd[p + "input_layernorm.weight"],
+                "bias": sd[p + "input_layernorm.bias"],
+            },
+            "ln_2": {
+                "weight": sd[p + "post_attention_layernorm.weight"],
+                "bias": sd[p + "post_attention_layernorm.bias"],
+            },
+            "attn": {
+                "qkv": {"kernel": wq, "bias": bq},
+                "dense": {
+                    "kernel": sd[p + "attention.dense.weight"].T,
+                    "bias": sd[p + "attention.dense.bias"],
+                },
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "kernel": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                    "bias": sd[p + "mlp.dense_h_to_4h.bias"],
+                },
+                "dense_4h_to_h": {
+                    "kernel": sd[p + "mlp.dense_4h_to_h.weight"].T,
+                    "bias": sd[p + "mlp.dense_4h_to_h.bias"],
+                },
+            },
+        }
+    return {"params": tree}
+
+
+def gpt_neox_params_to_hf(params: Mapping[str, Any], cfg) -> Dict[str, np.ndarray]:
+    tree = params.get("params", params)
+    N, D = cfg.num_heads, cfg.head_dim
+    out: Dict[str, np.ndarray] = {
+        "gpt_neox.embed_in.weight": _np(tree["embed_in"]["embedding"]),
+        "gpt_neox.final_layer_norm.weight": _np(tree["final_norm"]["weight"]),
+        "gpt_neox.final_layer_norm.bias": _np(tree["final_norm"]["bias"]),
+        "embed_out.weight": _np(tree["embed_out"]["kernel"]).T,
+    }
+    for i in range(cfg.num_layers):
+        lyr = tree[f"layer_{i}"]
+        p = f"gpt_neox.layers.{i}."
+        wq, bq = _neox_interleave(
+            _np(lyr["attn"]["qkv"]["kernel"]), _np(lyr["attn"]["qkv"]["bias"]), N, D
+        )
+        out.update({
+            p + "input_layernorm.weight": _np(lyr["ln_1"]["weight"]),
+            p + "input_layernorm.bias": _np(lyr["ln_1"]["bias"]),
+            p + "post_attention_layernorm.weight": _np(lyr["ln_2"]["weight"]),
+            p + "post_attention_layernorm.bias": _np(lyr["ln_2"]["bias"]),
+            p + "attention.query_key_value.weight": wq,
+            p + "attention.query_key_value.bias": bq,
+            p + "attention.dense.weight": _np(lyr["attn"]["dense"]["kernel"]).T,
+            p + "attention.dense.bias": _np(lyr["attn"]["dense"]["bias"]),
+            p + "mlp.dense_h_to_4h.weight": _np(lyr["mlp"]["dense_h_to_4h"]["kernel"]).T,
+            p + "mlp.dense_h_to_4h.bias": _np(lyr["mlp"]["dense_h_to_4h"]["bias"]),
+            p + "mlp.dense_4h_to_h.weight": _np(lyr["mlp"]["dense_4h_to_h"]["kernel"]).T,
+            p + "mlp.dense_4h_to_h.bias": _np(lyr["mlp"]["dense_4h_to_h"]["bias"]),
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# BERT
+# ---------------------------------------------------------------------------
+
+
+def bert_params_from_hf(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
+    """HF ``BertForPreTraining.state_dict()`` → framework param tree for
+    :class:`~..models.bert.BertForPreTraining` (separate HF q/k/v linears
+    fuse onto the framework's ``n_fused=3`` kernel; the MLM decoder is tied
+    to the word embedding on both sides, so only its bias transfers)."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    bert: Dict[str, Any] = {
+        "word_embeddings": {"embedding": sd["bert.embeddings.word_embeddings.weight"]},
+        "position_embeddings": sd["bert.embeddings.position_embeddings.weight"],
+        "token_type_embeddings": sd["bert.embeddings.token_type_embeddings.weight"],
+        "embed_norm": {
+            "weight": sd["bert.embeddings.LayerNorm.weight"],
+            "bias": sd["bert.embeddings.LayerNorm.bias"],
+        },
+        "pooler": {
+            "kernel": sd["bert.pooler.dense.weight"].T,
+            "bias": sd["bert.pooler.dense.bias"],
+        },
+    }
+    for i in range(cfg.num_layers):
+        p = f"bert.encoder.layer.{i}."
+        wq = np.stack(
+            [sd[p + f"attention.self.{n}.weight"].T for n in ("query", "key", "value")],
+            axis=1,
+        )  # [H, 3, H]
+        bq = np.stack(
+            [sd[p + f"attention.self.{n}.bias"] for n in ("query", "key", "value")], axis=0
+        )
+        bert[f"layer_{i}"] = {
+            "attention": {
+                "qkv": {"kernel": wq, "bias": bq},
+                "dense": {
+                    "kernel": sd[p + "attention.output.dense.weight"].T,
+                    "bias": sd[p + "attention.output.dense.bias"],
+                },
+            },
+            "attention_norm": {
+                "weight": sd[p + "attention.output.LayerNorm.weight"],
+                "bias": sd[p + "attention.output.LayerNorm.bias"],
+            },
+            "intermediate": {
+                "kernel": sd[p + "intermediate.dense.weight"].T,
+                "bias": sd[p + "intermediate.dense.bias"],
+            },
+            "output": {
+                "kernel": sd[p + "output.dense.weight"].T,
+                "bias": sd[p + "output.dense.bias"],
+            },
+            "output_norm": {
+                "weight": sd[p + "output.LayerNorm.weight"],
+                "bias": sd[p + "output.LayerNorm.bias"],
+            },
+        }
+
+    tree: Dict[str, Any] = {"bert": bert}
+    if "cls.predictions.transform.dense.weight" in sd:
+        tree["mlm_transform"] = {
+            "kernel": sd["cls.predictions.transform.dense.weight"].T,
+            "bias": sd["cls.predictions.transform.dense.bias"],
+        }
+        tree["mlm_norm"] = {
+            "weight": sd["cls.predictions.transform.LayerNorm.weight"],
+            "bias": sd["cls.predictions.transform.LayerNorm.bias"],
+        }
+        tree["mlm_bias"] = sd["cls.predictions.bias"]
+        tree["nsp_classifier"] = {
+            "kernel": sd["cls.seq_relationship.weight"].T,
+            "bias": sd["cls.seq_relationship.bias"],
+        }
+    return {"params": tree}
+
+
+def bert_params_to_hf(params: Mapping[str, Any], cfg) -> Dict[str, np.ndarray]:
+    tree = params.get("params", params)
+    bert = tree["bert"]
+    out: Dict[str, np.ndarray] = {
+        "bert.embeddings.word_embeddings.weight": _np(bert["word_embeddings"]["embedding"]),
+        "bert.embeddings.position_embeddings.weight": _np(bert["position_embeddings"]),
+        "bert.embeddings.token_type_embeddings.weight": _np(bert["token_type_embeddings"]),
+        "bert.embeddings.LayerNorm.weight": _np(bert["embed_norm"]["weight"]),
+        "bert.embeddings.LayerNorm.bias": _np(bert["embed_norm"]["bias"]),
+        "bert.pooler.dense.weight": _np(bert["pooler"]["kernel"]).T,
+        "bert.pooler.dense.bias": _np(bert["pooler"]["bias"]),
+    }
+    for i in range(cfg.num_layers):
+        lyr = bert[f"layer_{i}"]
+        p = f"bert.encoder.layer.{i}."
+        wq = _np(lyr["attention"]["qkv"]["kernel"])  # [H, 3, H]
+        bq = _np(lyr["attention"]["qkv"]["bias"])
+        for j, n in enumerate(("query", "key", "value")):
+            out[p + f"attention.self.{n}.weight"] = wq[:, j, :].T
+            out[p + f"attention.self.{n}.bias"] = bq[j]
+        out.update({
+            p + "attention.output.dense.weight": _np(lyr["attention"]["dense"]["kernel"]).T,
+            p + "attention.output.dense.bias": _np(lyr["attention"]["dense"]["bias"]),
+            p + "attention.output.LayerNorm.weight": _np(lyr["attention_norm"]["weight"]),
+            p + "attention.output.LayerNorm.bias": _np(lyr["attention_norm"]["bias"]),
+            p + "intermediate.dense.weight": _np(lyr["intermediate"]["kernel"]).T,
+            p + "intermediate.dense.bias": _np(lyr["intermediate"]["bias"]),
+            p + "output.dense.weight": _np(lyr["output"]["kernel"]).T,
+            p + "output.dense.bias": _np(lyr["output"]["bias"]),
+            p + "output.LayerNorm.weight": _np(lyr["output_norm"]["weight"]),
+            p + "output.LayerNorm.bias": _np(lyr["output_norm"]["bias"]),
+        })
+    if "mlm_transform" in tree:
+        out.update({
+            "cls.predictions.transform.dense.weight": _np(tree["mlm_transform"]["kernel"]).T,
+            "cls.predictions.transform.dense.bias": _np(tree["mlm_transform"]["bias"]),
+            "cls.predictions.transform.LayerNorm.weight": _np(tree["mlm_norm"]["weight"]),
+            "cls.predictions.transform.LayerNorm.bias": _np(tree["mlm_norm"]["bias"]),
+            "cls.predictions.bias": _np(tree["mlm_bias"]),
+            # HF materializes the tied decoder as its own (shared) tensors
+            "cls.predictions.decoder.weight": _np(bert["word_embeddings"]["embedding"]),
+            "cls.predictions.decoder.bias": _np(tree["mlm_bias"]),
+            "cls.seq_relationship.weight": _np(tree["nsp_classifier"]["kernel"]).T,
+            "cls.seq_relationship.bias": _np(tree["nsp_classifier"]["bias"]),
+        })
+    return out

@@ -117,7 +117,7 @@ class BertLayer(nn.Module):
             param_dtype=cfg.param_dtype,
             name="intermediate",
         )(x)
-        h = jax.nn.gelu(h)
+        h = jax.nn.gelu(h, approximate=False)  # HF-exact erf gelu (checkpoint parity)
         h = RowParallelLinear(
             features=cfg.hidden_size,
             use_bias=True,
@@ -214,7 +214,7 @@ class BertForPreTraining(nn.Module):
                  deterministic=True):
         cfg = self.config
         h, pooled = self.bert(ids, token_type_ids, attention_mask, deterministic)
-        t = self.mlm_norm(jax.nn.gelu(self.mlm_transform(h)))
+        t = self.mlm_norm(jax.nn.gelu(self.mlm_transform(h), approximate=False))
         # decoder tied to the word-embedding table, vocab-sharded output
         mlm_logits = self.bert.word_embeddings.attend(t)
         mlm_logits = mlm_logits + jnp.asarray(self.mlm_bias, mlm_logits.dtype)

@@ -133,7 +133,7 @@ class GPTNeoXMLP(nn.Module):
             param_dtype=cfg.param_dtype,
             name="dense_h_to_4h",
         )(x)
-        h = jax.nn.gelu(h)
+        h = jax.nn.gelu(h, approximate=False)  # HF-exact erf gelu (checkpoint parity)
         return RowParallelLinear(
             features=cfg.hidden_size,
             use_bias=True,

@@ -311,7 +311,14 @@ def ring_attention(
     if B % bdiv != 0:
         # Batch not splittable over the dp/ep degree (e.g. a B=1 probe on a
         # dp>1 mesh): replicate it instead — every dp rank redundantly
-        # computes the full batch, numerically identical, never wrong.
+        # computes the full batch, numerically identical, never wrong — but
+        # a dp-fold compute cliff on the hottest op, so say so.
+        logger.warning(
+            "ring_attention batch %d not divisible by dp degree %d: "
+            "replicating the batch on every dp rank (%dx redundant attention "
+            "compute); pad the batch to a multiple of %d to shard it",
+            B, bdiv, bdiv, bdiv,
+        )
         batch_axes = ()
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")

@@ -56,13 +56,59 @@ from neuronx_distributed_tpu.parallel.mesh import (
     PIPELINE_AXIS,
     get_mesh,
 )
-from neuronx_distributed_tpu.pipeline.partition import layers_per_stage
+from neuronx_distributed_tpu.pipeline.partition import (
+    layers_per_stage,
+    padded_layer_layout,
+)
 from neuronx_distributed_tpu.pipeline.scheduler import build_sync_slot_tables
 
 # Param-tree keys understood by the engine.
 EMBED = "embed"
 LAYERS = "layers"
 HEAD = "head"
+
+
+def _make_stage_fn(blk, layer_mask):
+    """Stage executor: scan the stage's layer rows.
+
+    ``layer_mask`` (``[L']`` of 0/1, or None) marks padded rows added for a
+    non-divisible layer count (:func:`..partition.padded_layer_layout`):
+    masked rows compute the block uniformly (SPMD — no divergent control
+    flow) but select the identity, and the ``where`` transpose zeroes their
+    zero-initialized parameters' gradients.  The mask is a compile-time
+    constant, NOT a parameter — it must never reach the optimizer (weight
+    decay would erode it) or checkpoints.  Returns a ``stage_fn(stage_rows,
+    x)`` operating on this stage's slice of the stack; under the pp
+    shard_map the mask constant is sliced with ``axis_index``."""
+    if layer_mask is None:
+        def stage_fn(stage_params, x):
+            def body(h, layer_params):
+                return blk(layer_params, h), None
+
+            x, _ = lax.scan(body, x, stage_params)
+            return x
+
+        return stage_fn
+
+    mask_const = jnp.asarray(layer_mask, jnp.float32)
+
+    def stage_fn(stage_params, x):
+        L_local = jax.tree.leaves(stage_params)[0].shape[0]
+        if mask_const.shape[0] == L_local:
+            local = mask_const  # pp == 1: the whole stack is local
+        else:
+            rank = lax.axis_index(PIPELINE_AXIS)
+            local = lax.dynamic_slice_in_dim(mask_const, rank * L_local, L_local)
+
+        def body(h, xs):
+            layer_params, a = xs
+            y = blk(layer_params, h)
+            return jnp.where(a > 0, y, h), None
+
+        x, _ = lax.scan(body, x, (stage_params, local))
+        return x
+
+    return stage_fn
 
 BlockFn = Callable[[Any, jax.Array], jax.Array]
 EmbedFn = Callable[[Any, jax.Array], jax.Array]
@@ -111,6 +157,7 @@ def make_pipelined_loss_fn(
     mesh: Optional[Mesh] = None,
     remat_block: bool = True,
     remat_policy: Optional[Callable] = None,
+    layer_mask=None,
 ):
     """Build ``loss_fn(params, ids, labels) -> (loss_sum, token_count)``.
 
@@ -125,12 +172,7 @@ def make_pipelined_loss_fn(
     if remat_block:
         blk = jax.checkpoint(block_fn, policy=remat_policy, prevent_cse=False)
 
-    def stage_fn(stage_params, x):
-        def body(h, layer_params):
-            return blk(layer_params, h), None
-
-        x, _ = lax.scan(body, x, stage_params)
-        return x
+    stage_fn = _make_stage_fn(blk, layer_mask)
 
     def loss_fn(params, ids: jax.Array, labels: jax.Array):
         """ids/labels: [B, S] global batch."""
@@ -231,6 +273,7 @@ def make_1f1b_loss_and_grad_fn(
     remat_block: bool = True,
     remat_policy: Optional[Callable] = None,
     act_spec: Optional[P] = None,
+    layer_mask=None,
 ):
     """Build ``fn(params, ids, labels) -> ((loss_sum, token_count), grads)``
     running the true 1F1B schedule in one jit — the production PP train path
@@ -256,9 +299,16 @@ def make_1f1b_loss_and_grad_fn(
       of TPU executables;
     - uniformity means embedding and head+loss run every tick on every rank
       (their results masked by ``where``).  The embedding is a cheap gather;
-      the head costs ``(V/6H)/layers_per_stage`` extra compute (≈12% for a
-      7B/PP4 shape, <4% for 70B/PP4) — the price of deadlock-freedom, paid
-      only on the PP path.  The backward is one uniform ``jax.vjp`` of a
+      the head costs ``2hV / (layers_per_stage * (8h² + 6hi))`` extra compute
+      (≈8% for a 7B/PP4 shape, ≈1% for 70B/PP4 —
+      ``scheduler.sync_1f1b_head_overhead``) — the price of deadlock-freedom,
+      paid only on the PP path.  The schedule itself runs ``T = M + 2(P-1)``
+      full fwd+bwd ticks for ``M`` useful pairs — ~2x the eager-1F1B bubble
+      at equal M (``scheduler.bubble_fraction(..., "sync_1f1b")``), amortizing
+      identically with large M; measured against fill-drain autodiff it is
+      nonetheless equal-or-faster wall-clock at M >= 8 because its O(P)
+      circular stash replaces residuals that grow with M
+      (``docs/PP_SCHEDULE_NOTES.md``).  The backward is one uniform ``jax.vjp`` of a
       scalar-``where`` objective: the real loss on the last rank, an
       inner product ``sum(y * g_in)`` injecting the incoming cotangent on
       the others — the select's transpose zeroes head grads off the last
@@ -283,18 +333,14 @@ def make_1f1b_loss_and_grad_fn(
     if remat_block:
         blk = jax.checkpoint(block_fn, policy=remat_policy, prevent_cse=False)
 
-    def stage_fn(stage_params, x):
-        def body(h, layer_params):
-            return blk(layer_params, h), None
-
-        x, _ = lax.scan(body, x, stage_params)
-        return x
+    stage_fn = _make_stage_fn(blk, layer_mask)
 
     if pp == 1:
         # no pipeline: autodiff the plain microbatched loss
         plain = make_pipelined_loss_fn(
             embed_fn, block_fn, head_loss_fn, M, mesh=mesh,
             remat_block=remat_block, remat_policy=remat_policy,
+            layer_mask=layer_mask,
         )
 
         def loss_and_grad_pp1(params, ids, labels):
@@ -496,6 +542,10 @@ class PipelinedModel:
     forward_fn: Callable
     loss_and_grad_fn: Optional[Callable] = None
     schedule: str = "1f1b"
+    # stack row of each real layer (identity when the layer count divides pp;
+    # padded layout from partition.padded_layer_layout otherwise) — consumers
+    # like checkpoint converters index the [L', ...] stack through this
+    layer_rows: Optional[Tuple[int, ...]] = None
 
     @property
     def param_shardings(self):
@@ -538,7 +588,12 @@ def build_pipelined_model(
 
     mesh = mesh if mesh is not None else get_mesh()
     pp = mesh.shape[PIPELINE_AXIS]
-    layers_per_stage(num_layers, pp)
+    if num_layers % pp == 0:
+        padded_layers, row_of_layer, layer_mask = num_layers, list(range(num_layers)), None
+    else:
+        # non-divisible: pad the stack with identity rows (the reference's
+        # pipeline_cuts flexibility, reference pipeline/partition.py:17-42)
+        padded_layers, row_of_layer, layer_mask = padded_layer_layout(num_layers, pp)
 
     rng = jax.random.PRNGKey(seed)
     r_embed, r_head, r_layers = jax.random.split(rng, 3)
@@ -567,10 +622,20 @@ def build_pipelined_model(
         lambda r: _params_of(nn.unbox(head_init(r))), out_shardings=_shardings(head_specs)
     )(r_head)
     layer_keys = jax.random.split(r_layers, num_layers)
-    layer_params = jax.jit(
-        lambda ks: jax.vmap(lambda k: _params_of(nn.unbox(block_init(k))))(ks),
-        out_shardings=_shardings(layer_specs),
-    )(layer_keys)
+    rows = jnp.asarray(row_of_layer, jnp.int32)
+
+    def _init_stack(ks):
+        real = jax.vmap(lambda k: _params_of(nn.unbox(block_init(k))))(ks)
+        if layer_mask is None:
+            return real
+        # scatter real layers into their padded rows; padded rows stay zero
+        return jax.tree.map(
+            lambda leaf: jnp.zeros((padded_layers, *leaf.shape[1:]), leaf.dtype)
+            .at[rows].set(leaf),
+            real,
+        )
+
+    layer_params = jax.jit(_init_stack, out_shardings=_shardings(layer_specs))(layer_keys)
 
     params = {EMBED: embed_params, LAYERS: layer_params, HEAD: head_params}
     specs = {EMBED: embed_specs, LAYERS: layer_specs, HEAD: head_specs}
@@ -583,9 +648,11 @@ def build_pipelined_model(
         mesh=mesh,
         remat_block=remat_block,
         remat_policy=remat_policy,
+        layer_mask=layer_mask,
     )
     forward_fn = make_pipelined_forward_fn(
-        embed_fn, block_fn, head_fn, num_microbatches, mesh=mesh
+        embed_fn, block_fn, head_fn, num_microbatches, mesh=mesh,
+        layer_mask=layer_mask,
     )
     if schedule == "1f1b":
         loss_and_grad_fn = make_1f1b_loss_and_grad_fn(
@@ -597,6 +664,7 @@ def build_pipelined_model(
             remat_block=remat_block,
             remat_policy=remat_policy,
             act_spec=act_spec,
+            layer_mask=layer_mask,
         )
     elif schedule == "gpipe":
         def loss_and_grad_fn(params, ids, labels):
@@ -615,6 +683,7 @@ def build_pipelined_model(
         forward_fn=forward_fn,
         loss_and_grad_fn=loss_and_grad_fn,
         schedule=schedule,
+        layer_rows=tuple(row_of_layer),
     )
 
 
@@ -624,6 +693,7 @@ def make_pipelined_forward_fn(
     head_fn: Callable[[Any, jax.Array], jax.Array],
     num_microbatches: int,
     mesh: Optional[Mesh] = None,
+    layer_mask=None,
 ):
     """Forward-only pipeline (the reference's ``InferenceSchedule`` path,
     ``pipeline/model.py:run_eval``): returns ``fn(params, ids) -> outputs``
@@ -636,12 +706,7 @@ def make_pipelined_forward_fn(
     mesh = mesh if mesh is not None else get_mesh()
     pp = mesh.shape[PIPELINE_AXIS]
 
-    def stage_fn(stage_params, x):
-        def body(h, layer_params):
-            return block_fn(layer_params, h), None
-
-        x, _ = lax.scan(body, x, stage_params)
-        return x
+    stage_fn = _make_stage_fn(block_fn, layer_mask)
 
     def forward_fn(params, ids: jax.Array):
         ids_mb = microbatch(ids, num_microbatches, mesh if pp > 1 else None)

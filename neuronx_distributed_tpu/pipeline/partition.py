@@ -54,10 +54,41 @@ def spans_from_cuts(cuts: Sequence[int], num_layers: int) -> List[Tuple[int, int
 
 def layers_per_stage(num_layers: int, num_stages: int) -> int:
     """Uniform layer count per stage; raises unless evenly divisible (the
-    stacked-parameter engine requires homogeneous stages)."""
+    stacked-parameter engine requires homogeneous stages).  Non-divisible
+    models are padded first — see :func:`padded_layer_layout`."""
     if num_layers % num_stages != 0:
         raise ValueError(
             f"num_layers={num_layers} must be divisible by num_stages={num_stages} "
             "for the stacked pipeline engine; pad the model or choose another pp size"
         )
     return num_layers // num_stages
+
+
+def padded_layer_layout(num_layers: int, num_stages: int) -> Tuple[int, List[int], List[int]]:
+    """Layout for a non-divisible layer count on the stacked engine.
+
+    The engine's "partition" is a sharding of a homogeneous ``[L', ...]``
+    layer stack over ``pp``; when ``num_layers % num_stages != 0`` the stack
+    is padded to ``L' = ceil(L/P)*P`` rows.  Padded rows hold zero parameters
+    and an ``active=0`` flag: the engine computes them uniformly (SPMD) but
+    selects the identity, so numerics equal the unpadded model exactly and
+    the ``where`` transpose zeroes their gradients.  Real layers fill each
+    stage's leading rows following :func:`partition_uniform` (earlier stages
+    take the extra layers — the reference's ``pipeline_cuts`` convention,
+    reference ``pipeline/partition.py:17-42``).
+
+    Returns ``(padded_len, row_of_layer, mask)``: ``row_of_layer[i]`` is the
+    stack row of real layer ``i`` (execution order preserved), ``mask[r]`` is
+    1 for real rows, 0 for padding.
+    """
+    spans = partition_uniform(num_layers, num_stages)
+    per = -(-num_layers // num_stages)  # ceil
+    padded = per * num_stages
+    row_of_layer: List[int] = []
+    mask = [0] * padded
+    for s, (lo, hi) in enumerate(spans):
+        for j in range(hi - lo):
+            row = s * per + j
+            row_of_layer.append(row)
+            mask[row] = 1
+    return padded, row_of_layer, mask

@@ -23,7 +23,7 @@ backwards; earlier stages hold at most ``P-s`` in-flight microbatches.
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterator, List, Sequence, Tuple
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,10 +171,61 @@ class TrainSchedule(PipeSchedule):
         return min(self.num_microbatches, self.num_stages - self.stage_id)
 
 
-def bubble_fraction(num_microbatches: int, num_stages: int) -> float:
-    """Pipeline bubble fraction (P-1)/(M+P-1) — identical for GPipe-style
-    fill-drain and 1F1B; 1F1B only lowers peak activation memory."""
-    return (num_stages - 1) / (num_microbatches + num_stages - 1)
+def bubble_fraction(
+    num_microbatches: int, num_stages: int, schedule: str = "eager"
+) -> float:
+    """Fraction of pipeline compute capacity wasted on bubbles.
+
+    ``schedule="eager"`` — the classic fill-drain / 1F1B figure
+    ``(P-1)/(M+P-1)``: what a per-task executor (the reference's
+    ``NxDPPModel``) achieves; identical for GPipe and 1F1B, which differ
+    only in peak activation memory.
+
+    ``schedule="sync_1f1b"`` — the production single-jit engine's timetable
+    (:func:`build_sync_slot_tables`): ``T = M + 2(P-1)`` ticks, each costing
+    one full fwd+bwd on every rank, of which ``M`` carry useful pairs —
+    overhead ``2(P-1)/(M+2(P-1))``, roughly TWICE the eager bubble at equal
+    ``M`` (43% vs 27% at P=4/M=8; 4.3% vs 2.2% at P=4/M=128).  This is the
+    price of SPMD uniformity (no rank-divergent control flow around
+    collective-bearing compute), and it amortizes with large ``M`` exactly
+    like the eager bubble.  Note the asymmetric timetable
+    (:func:`build_slot_tables`) is NOT an improvement under the uniformity
+    constraint: realized as masked uniform ticks its ``~2M + 2(P-1)`` slots
+    would each still pay a full fwd+bwd, costing strictly more than the
+    sync form — a true eager 1F1B needs per-rank divergent dispatch, which
+    this engine rules out by design (see ``engine.py``).  On top of the
+    bubble, the sync engine pays the embedding+head on every tick
+    (:func:`sync_1f1b_head_overhead`).
+    """
+    M, P = num_microbatches, num_stages
+    if schedule == "eager":
+        return (P - 1) / (M + P - 1)
+    if schedule == "sync_1f1b":
+        return 2 * (P - 1) / (M + 2 * (P - 1))
+    raise ValueError(f"unknown schedule {schedule!r} (eager | sync_1f1b)")
+
+
+def sync_1f1b_head_overhead(
+    num_layers: int,
+    num_stages: int,
+    hidden: int,
+    vocab: int,
+    intermediate: Optional[int] = None,
+) -> float:
+    """Extra compute fraction from the sync engine running the (masked)
+    embedding + LM-head + loss every tick on every rank (engine uniformity).
+
+    Per-tick useful stage compute ≈ ``layers_per_stage`` transformer blocks;
+    the head adds one ``hidden x vocab`` matmul (fwd+bwd).  Per-token fwd
+    matmul FLOPs (MHA): qkv ``6h²`` + o-proj ``2h²`` + mlp ``6hi`` → block =
+    ``8h² + 6hi``; head = ``2hV`` (same ratio holds fwd+bwd; attention-core
+    FLOPs are excluded, so this slightly over-states).  ≈8% for 7B/PP4
+    (L=32, h=4096, i=11008, V=32000), ≈1% for 70B/PP4."""
+    i = intermediate if intermediate is not None else 4 * hidden
+    lps = num_layers / num_stages
+    block = 8 * hidden * hidden + 6 * hidden * i
+    head = 2 * hidden * vocab
+    return head / (lps * block)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,8 @@
+"""Testing utilities: the convergence-parity comparator (reference
+``test/integration/combinatorial_tests/common/compare_gpu_trn1_metrics.py``)."""
+
+from neuronx_distributed_tpu.testing.convergence import (  # noqa: F401
+    compare_curves,
+    compare_scalar_logs,
+    smoothed,
+)

@@ -1,0 +1,94 @@
+"""Convergence-parity oracle.
+
+Re-design of the reference's cross-platform golden comparison
+(``test/integration/combinatorial_tests/common/compare_gpu_trn1_metrics.py:19-60``):
+a candidate run's metric curve is EMA-smoothed (TensorBoard semantics) and
+compared point-wise against a smoothed golden curve after a warmup step; the
+run passes iff every post-warmup deviation is within ``tolerance_pct``.
+
+Differences from the reference: curves come from plain lists or the
+framework's JSONL scalar streams (:mod:`..trainer.scalar_log`) instead of
+TensorBoard event files — the same ``ScalarWriter`` also emits TB events, so
+hardware runs remain comparable with the reference's own TB tooling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+
+def smoothed(values: Sequence[float], weight: float = 0.6) -> List[float]:
+    """TensorBoard-style EMA smoothing (reference smoothing step,
+    ``compare_gpu_trn1_metrics.py:19-27``)."""
+    if not 0.0 <= weight < 1.0:
+        raise ValueError(f"smoothing weight must be in [0, 1), got {weight}")
+    out: List[float] = []
+    last: Optional[float] = None
+    for v in values:
+        last = v if last is None else last * weight + (1.0 - weight) * v
+        out.append(last)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class CurveComparison:
+    ok: bool
+    max_deviation_pct: float
+    worst_step: int
+    compared_points: int
+
+    def __bool__(self) -> bool:  # truthy = passed
+        return self.ok
+
+
+def compare_curves(
+    candidate: Sequence[float],
+    golden: Sequence[float],
+    warmup_steps: int = 0,
+    tolerance_pct: float = 1.0,
+    smoothing: float = 0.6,
+) -> CurveComparison:
+    """Smoothed point-wise comparison (reference ``:28-60``: default 1%
+    tolerated percentage after a warmup step).  Curves must be step-aligned;
+    the shorter length bounds the comparison."""
+    n = min(len(candidate), len(golden))
+    if n <= warmup_steps:
+        raise ValueError(
+            f"curves have {n} aligned points but warmup is {warmup_steps}"
+        )
+    cs = smoothed(candidate[:n], smoothing)
+    gs = smoothed(golden[:n], smoothing)
+    worst, worst_step = 0.0, warmup_steps
+    for i in range(warmup_steps, n):
+        denom = max(abs(gs[i]), 1e-12)
+        dev = 100.0 * abs(cs[i] - gs[i]) / denom
+        if dev > worst:
+            worst, worst_step = dev, i
+    return CurveComparison(
+        ok=worst <= tolerance_pct,
+        max_deviation_pct=worst,
+        worst_step=worst_step,
+        compared_points=n - warmup_steps,
+    )
+
+
+def compare_scalar_logs(
+    candidate_dir: str,
+    golden_dir: str,
+    tag: str = "loss",
+    warmup_steps: int = 0,
+    tolerance_pct: float = 1.0,
+    smoothing: float = 0.6,
+) -> CurveComparison:
+    """Compare two :class:`~..trainer.scalar_log.ScalarWriter` JSONL streams
+    by tag — the form used against real hardware runs."""
+    from neuronx_distributed_tpu.trainer.scalar_log import read_scalars
+
+    def curve(d):
+        recs = sorted(read_scalars(d, tag), key=lambda r: r["step"])
+        return [r["value"] for r in recs]
+
+    return compare_curves(
+        curve(candidate_dir), curve(golden_dir), warmup_steps, tolerance_pct, smoothing
+    )

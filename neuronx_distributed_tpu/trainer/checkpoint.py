@@ -1,4 +1,5 @@
-"""Sharded checkpoint save/load with tag rotation and resume.
+"""Sharded checkpoint save/load with tag rotation, resume, async save —
+multi-host safe.
 
 TPU-native replacement for the reference's three checkpoint generations
 (SURVEY §5.4): the per-rank ``dp_rank_xx_tp_rank_xx_pp_rank_xx.pt`` file
@@ -8,21 +9,43 @@ collapse into one TensorStore-backed (orbax) sharded format: every host
 writes exactly its owned shards, restore re-shards to the live mesh, and no
 host ever materializes the full state.
 
+Multi-host discipline (reference: rank-0-guarded rotation + ``xm.rendezvous``
+around IO, ``trainer/checkpoint.py:39-82,146-162``):
+
+- every *destructive* filesystem op — clearing a stale tag dir, writing
+  ``newest``/``meta.json``/``.done``, rotation — runs on **process 0 only**;
+- a ``sync_global_devices`` barrier separates process-0 directory prep from
+  the all-host shard writes, and the all-host writes from process-0
+  finalization, so no host can read a half-written tag and no two hosts race
+  a ``rmtree`` (the round-1/2 flaw: every process rotated and wrote
+  ``newest``);
+- the tensor payloads themselves go through ``ocp.AsyncCheckpointer``
+  (StandardCheckpointHandler — the supported API; the deprecated
+  ``PyTreeCheckpointer`` emitted restore warnings), which coordinates its own
+  per-host shard commit.
+
+Async save: ``save_checkpoint(..., async_save=True)`` returns immediately
+after dispatching device→host copies; finalization (``.done`` marker,
+``newest`` pointer, rotation) happens in ``wait_for_checkpoint()`` — called
+automatically at the start of the next save, mirroring orbax's own
+wait-before-next-save contract.
+
 Kept reference semantics: tagged checkpoint directories, a ``newest`` pointer
-file, ``num_kept_ckpts`` rotation (``trainer/checkpoint.py:146-162``), and
-separate model / optimizer / scheduler / user_content payloads
-(``:175-199``)."""
+file, ``num_kept_ckpts`` rotation, and separate model / optimizer /
+scheduler / user_content payloads (``:175-199``).
+"""
 
 from __future__ import annotations
 
 import json
 import os
 import shutil
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding
 
 from neuronx_distributed_tpu.utils.logger import get_logger
 
@@ -30,6 +53,48 @@ logger = get_logger(__name__)
 
 _NEWEST = "newest"
 _DONE = ".done"
+
+
+def _is_primary() -> bool:
+    return jax.process_index() == 0
+
+
+def _barrier(name: str) -> None:
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(name)
+
+
+class _PendingSave:
+    """Finalization state of an in-flight async save."""
+
+    def __init__(self, checkpointers: List[ocp.AsyncCheckpointer], finalize: Callable[[], None]):
+        self._checkpointers = checkpointers
+        self._finalize = finalize
+        self.done = False
+
+    def wait(self) -> None:
+        if self.done:
+            return
+        try:
+            for c in self._checkpointers:
+                c.wait_until_finished()
+            self._finalize()
+        finally:
+            for c in self._checkpointers:
+                c.close()  # reap the per-save background threads
+            self.done = True
+
+
+_PENDING: Optional[_PendingSave] = None
+
+
+def wait_for_checkpoint() -> None:
+    """Block until the last async ``save_checkpoint`` fully committed
+    (shards durable, ``.done``/``newest`` written, rotation performed)."""
+    global _PENDING
+    if _PENDING is not None:
+        _PENDING.wait()
+        _PENDING = None
 
 
 def _tag_dir(ckpt_dir: str, tag: str) -> str:
@@ -57,36 +122,67 @@ def save_checkpoint(
     scheduler_state: Any = None,
     user_content: Any = None,
     num_kept_ckpts: Optional[int] = None,
+    async_save: bool = False,
 ) -> str:
     """Save a tagged checkpoint (reference ``save_checkpoint``,
-    ``trainer/checkpoint.py:85-199``)."""
+    ``trainer/checkpoint.py:85-199``).  With ``async_save`` the call returns
+    after device arrays are snapshotted; durability is guaranteed only after
+    :func:`wait_for_checkpoint` (implicitly invoked by the next save)."""
+    wait_for_checkpoint()  # at most one in-flight async save
+
     path = _tag_dir(ckpt_dir, tag)
-    if os.path.exists(path):
-        shutil.rmtree(path)
-    os.makedirs(path, exist_ok=True)
+    if _is_primary():
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        os.makedirs(path, exist_ok=True)
+    _barrier(f"ckpt_prep:{tag}")
 
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(os.path.join(path, "model"), model_state)
+    checkpointers: List[ocp.AsyncCheckpointer] = []
+    payloads = [("model", model_state)]
     if optimizer_state is not None:
-        ckptr.save(os.path.join(path, "optimizer"), optimizer_state)
-    meta = {"tag": tag}
-    if scheduler_state is not None:
-        meta["scheduler"] = scheduler_state
-    if user_content is not None:
-        meta["user_content"] = user_content
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f)
-    with open(os.path.join(path, _DONE), "w") as f:
-        f.write("ok")
-    with open(os.path.join(ckpt_dir, _NEWEST), "w") as f:
-        f.write(tag)
+        payloads.append(("optimizer", optimizer_state))
+    try:
+        for name, state in payloads:
+            c = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+            checkpointers.append(c)
+            c.save(os.path.join(path, name), args=ocp.args.StandardSave(state))
+    except Exception:
+        # never orphan an in-flight background write: a later save of the
+        # same tag would rmtree the directory under its TensorStore streams
+        for c in checkpointers:
+            try:
+                c.wait_until_finished()
+            finally:
+                c.close()
+        raise
 
-    if num_kept_ckpts is not None and num_kept_ckpts > 0:
-        tags = _list_tags(ckpt_dir)
-        for old in tags[:-num_kept_ckpts]:
-            logger.info("rotating out checkpoint %s", old)
-            shutil.rmtree(_tag_dir(ckpt_dir, old), ignore_errors=True)
-    logger.info("saved checkpoint %s", path)
+    def finalize() -> None:
+        # all hosts reach here with their shards durable (wait_until_finished
+        # ran); only process 0 commits the visibility markers and rotates
+        _barrier(f"ckpt_written:{tag}")
+        if _is_primary():
+            meta = {"tag": tag}
+            if scheduler_state is not None:
+                meta["scheduler"] = scheduler_state
+            if user_content is not None:
+                meta["user_content"] = user_content
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(meta, f)
+            with open(os.path.join(path, _DONE), "w") as f:
+                f.write("ok")
+            with open(os.path.join(ckpt_dir, _NEWEST), "w") as f:
+                f.write(tag)
+            if num_kept_ckpts is not None and num_kept_ckpts > 0:
+                for old in _list_tags(ckpt_dir)[:-num_kept_ckpts]:
+                    logger.info("rotating out checkpoint %s", old)
+                    shutil.rmtree(_tag_dir(ckpt_dir, old), ignore_errors=True)
+        _barrier(f"ckpt_done:{tag}")
+        logger.info("saved checkpoint %s", path)
+
+    global _PENDING
+    _PENDING = _PendingSave(checkpointers, finalize)
+    if not async_save:
+        wait_for_checkpoint()
     return path
 
 
@@ -102,12 +198,17 @@ def newest_tag(ckpt_dir: str) -> Optional[str]:
     return tags[-1] if tags else None
 
 
-def _restore_args_like(template: Any):
+def _abstract_like(template: Any):
+    """Template tree → abstract arrays carrying the live-mesh shardings, the
+    StandardRestore form that re-shards on read without a donated template."""
+
     def one(x):
         sharding = getattr(x, "sharding", None)
         if isinstance(sharding, NamedSharding):
-            return ocp.ArrayRestoreArgs(sharding=sharding)
-        return ocp.RestoreArgs()
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
 
     return jax.tree.map(one, template)
 
@@ -121,27 +222,24 @@ def load_checkpoint(
     """Restore ``(model_state, optimizer_state, scheduler_state,
     user_content)`` re-sharded to the live mesh via the templates' shardings
     (reference ``load_checkpoint`` + auto tag, ``trainer/checkpoint.py:203-284``)."""
+    wait_for_checkpoint()
     tag = tag or newest_tag(ckpt_dir)
     if tag is None:
         raise FileNotFoundError(f"no completed checkpoints under {ckpt_dir}")
     path = _tag_dir(ckpt_dir, tag)
-    ckptr = ocp.PyTreeCheckpointer()
+    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
 
     model_state = None
     if model_template is not None:
         model_state = ckptr.restore(
             os.path.join(path, "model"),
-            args=ocp.args.PyTreeRestore(
-                item=model_template, restore_args=_restore_args_like(model_template)
-            ),
+            args=ocp.args.StandardRestore(_abstract_like(model_template)),
         )
     optimizer_state = None
     if optimizer_template is not None and os.path.isdir(os.path.join(path, "optimizer")):
         optimizer_state = ckptr.restore(
             os.path.join(path, "optimizer"),
-            args=ocp.args.PyTreeRestore(
-                item=optimizer_template, restore_args=_restore_args_like(optimizer_template)
-            ),
+            args=ocp.args.StandardRestore(_abstract_like(optimizer_template)),
         )
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)

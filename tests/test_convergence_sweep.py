@@ -1,0 +1,156 @@
+"""Combinatorial convergence sweep + parity oracle (round-2 verdict #4).
+
+Mirrors the reference's model-scale correctness story: a config grid over
+TP x SP x remat x PP x ZeRO x dtype (reference
+``test/integration/combinatorial_tests/run.sh`` +
+``configs/test_TP8_SP1_SC0_PP4_Zero1Opt1_FP32.txt``) where every
+combination trains the same tiny Llama on identical data and its loss curve
+must track a single-device fp32 GOLDEN run within the comparator's
+tolerance (reference ``compare_gpu_trn1_metrics.py:19-60``: smoothed curves,
+1% after warmup).  fp32 configs are pure re-shardings of the same
+computation, so their tolerance is tight; the bf16 row checks the dtype
+policy converges alongside, at a looser bound."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    causal_lm_loss,
+)
+from neuronx_distributed_tpu.testing import compare_curves
+from neuronx_distributed_tpu.trainer import (
+    default_batch_spec,
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    make_train_step,
+)
+
+STEPS = 12
+B, S, VOCAB = 8, 16, 256
+LR = 3e-3
+
+
+def _data():
+    ids = jax.random.randint(jax.random.PRNGKey(7), (B, S), 0, VOCAB)
+    return {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+
+
+def _run(devices, *, tp=1, pp=1, cp=1, kvr=1, sp=False, remat="none",
+         zero1=True, dtype="float32", attn="dense", num_mb=1, kv_heads=8,
+         num_layers=2, pipelined=None):
+    """One grid cell.  ``pipelined`` forces the pipelined-model code path
+    even at pp=1 (the PP rows' golden: same stacked init, single device)."""
+    nxd.destroy_model_parallel()
+    n = tp * pp * cp
+    use = devices[: n * (len(devices) // n)] if n > 1 else devices[:1]
+    nxd.initialize_model_parallel(
+        tensor_parallel_size=tp, pipeline_parallel_size=pp,
+        context_parallel_size=cp, kv_size_multiplier=kvr, devices=use,
+    )
+    cfg = LlamaConfig.tiny(
+        vocab_size=VOCAB, num_heads=8, num_kv_heads=kv_heads, num_layers=num_layers,
+        sequence_parallel=sp, remat=remat, attention_impl=attn,
+        dtype=jnp.dtype(dtype), param_dtype=jnp.float32, max_seq_len=S,
+    )
+    config = nxd.training_config(
+        tensor_parallel_size=tp, pipeline_parallel_size=pp,
+        context_parallel_size=cp, kv_size_multiplier=kvr,
+        num_microbatches=num_mb, schedule="1f1b",
+        learning_rate=LR, zero_one_enabled=zero1,
+        compute_dtype=dtype, param_dtype="float32",
+    )
+    use_pipelined = pipelined if pipelined is not None else pp > 1
+    if use_pipelined:
+        model = LlamaForCausalLM(cfg).build_pipelined(
+            num_microbatches=num_mb, schedule="1f1b", seed=config.seed
+        )
+        opt = initialize_parallel_optimizer(config, model)
+        from neuronx_distributed_tpu.trainer.trainer import make_pipelined_train_step
+
+        step = make_pipelined_train_step(config, model, opt)
+    else:
+        model = initialize_parallel_model(
+            config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, S), jnp.int32),)
+        )
+        opt = initialize_parallel_optimizer(config, model)
+        step = make_train_step(
+            config, model, opt, causal_lm_loss,
+            batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()},
+        )
+    batch = _data()
+    params, state = model.params, opt.state
+    losses = []
+    for i in range(STEPS):
+        params, state, m = step(params, state, batch, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    nxd.destroy_model_parallel()
+    assert np.isfinite(losses).all(), losses
+    return losses
+
+
+_GOLDEN_CACHE = {}
+
+
+def _golden(family: str):
+    """Single-device fp32 golden per init family: the architecture and its
+    parameter initialization must match the candidate exactly — the sweep
+    isolates *sharding/schedule/dtype* effects, nothing else."""
+    if family not in _GOLDEN_CACHE:
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs 8 virtual devices")
+        kwargs = {
+            "mha": dict(),
+            "gqa4": dict(kv_heads=4),
+            "pipelined": dict(pipelined=True),
+            "pipelined4": dict(pipelined=True, num_layers=4),
+        }[family]
+        _GOLDEN_CACHE[family] = _run(devs[:8], **kwargs)
+    return _GOLDEN_CACHE[family]
+
+
+# the reference's grid dimensions, at representative points; each row names
+# the init family whose golden it must track
+GRID = {
+    "TP2_SP0_SCnone_PP1_Zero0_FP32": ("mha", dict(tp=2, sp=False, remat="none", zero1=False)),
+    "TP2_SP1_SCsel_PP1_Zero1_FP32": ("mha", dict(tp=2, sp=True, remat="selective", zero1=True)),
+    "TP4_SP1_SCnone_PP1_Zero1_FP32": ("mha", dict(tp=4, sp=True, remat="none", zero1=True)),
+    "TP4_KVR2_GQA_PP1_Zero1_FP32": ("gqa4", dict(tp=4, kvr=2, kv_heads=4, zero1=True)),
+    "TP2_SP0_SCnone_PP2_Zero1_FP32": ("pipelined", dict(tp=2, pp=2, num_mb=2, zero1=True)),
+    "TP1_SP0_SCfull_PP4_Zero1_FP32": ("pipelined4", dict(pp=4, num_mb=4, num_layers=4, remat="full", zero1=True)),
+    "TP2_CP2_FLASH_PP1_Zero1_FP32": ("mha", dict(tp=2, cp=2, attn="flash", zero1=True)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(GRID))
+def test_combinatorial_fp32_parity(devices8, name):
+    family, kwargs = GRID[name]
+    golden = _golden(family)
+    losses = _run(devices8, **kwargs)
+    cmp = compare_curves(losses, golden, warmup_steps=1, tolerance_pct=1.0)
+    assert cmp.ok, (
+        f"{name}: max smoothed deviation {cmp.max_deviation_pct:.3f}% at step "
+        f"{cmp.worst_step} exceeds 1% (losses {losses} vs golden {golden})"
+    )
+
+
+def test_bf16_tracks_golden(devices8):
+    """bf16 compute follows the fp32 golden within a loose band — the
+    explicit-dtype policy's convergence check (SURVEY §7 hard-part 5)."""
+    losses = _run(devices8, tp=2, sp=True, zero1=True, dtype="bfloat16")
+    cmp = compare_curves(losses, _golden("mha"), warmup_steps=1, tolerance_pct=7.5)
+    assert cmp.ok, f"bf16 deviation {cmp.max_deviation_pct:.2f}% > 7.5%"
+
+
+def test_comparator_rejects_divergence():
+    """The oracle itself must fail a diverged curve (sanity of the sanity)."""
+    golden = [3.0 - 0.1 * i for i in range(10)]
+    diverged = [3.0 + 0.05 * i for i in range(10)]
+    assert not compare_curves(diverged, golden, warmup_steps=2, tolerance_pct=1.0)
+    identical = compare_curves(golden, golden, tolerance_pct=1.0)
+    assert identical.ok and identical.max_deviation_pct == 0.0

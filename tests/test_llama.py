@@ -98,7 +98,8 @@ def test_gqa_llama_with_kv_multiplier(devices8):
 def test_train_loop_tp_sp_zero1(devices8):
     """BASELINE config 3: TP+SP+ZeRO-1 — loss must go down."""
     cfg = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
-    config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3)
+    config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3,
+                                 compute_dtype="float32")
     model = initialize_parallel_model(
         config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, 16), jnp.int32),)
     )

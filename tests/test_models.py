@@ -101,7 +101,8 @@ def test_bert_attention_mask_isolates_padding(devices8):
 
 def test_neox_train_loss_decreases(devices8):
     cfg = GPTNeoXConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
-    config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3)
+    config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3,
+                                 compute_dtype="float32")
     model = initialize_parallel_model(
         config, lambda: GPTNeoXForCausalLM(cfg), (jnp.zeros((1, 16), jnp.int32),))
     opt = initialize_parallel_optimizer(config, model)
@@ -120,7 +121,8 @@ def test_neox_train_loss_decreases(devices8):
 
 def test_bert_train_loss_decreases(devices8):
     cfg = BertConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
-    config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3)
+    config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3,
+                                 compute_dtype="float32")
     model = initialize_parallel_model(
         config, lambda: BertForPreTraining(cfg), (jnp.zeros((1, 16), jnp.int32),))
     opt = initialize_parallel_optimizer(config, model)

@@ -22,12 +22,11 @@ def test_scalar_writer_roundtrip(tmp_path):
 
 
 def test_scalar_writer_tensorboard_backend(tmp_path):
-    """torch ships in the image; the TB event file should appear."""
+    """torch ships in the image; the TB event file MUST appear (the event
+    stream is what the reference's convergence comparator consumes), and the
+    JSONL mirror alongside it."""
     with ScalarWriter(str(tmp_path), use_tensorboard=True) as w:
         w.scalar("loss", 1.0, 0)
     files = list(tmp_path.iterdir())
-    assert any(f.name.startswith("events.out.tfevents") for f in files) or any(
-        f.name == "scalars.jsonl" for f in files
-    )
-    # the JSONL mirror is unconditional
+    assert any(f.name.startswith("events.out.tfevents") for f in files), files
     assert read_scalars(str(tmp_path), tag="loss")[0]["value"] == 1.0

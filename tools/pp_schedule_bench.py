@@ -1,0 +1,48 @@
+"""Measure GPipe fill-drain vs sync-1F1B step time at PP4 (verdict r2 #3).
+
+Runs on the 8-device virtual CPU mesh (tp=2 x pp=4); CPU timings are a rough
+proxy but expose the schedules' M-dependence.  Results are recorded in
+docs/PP_SCHEDULE_NOTES.md.
+"""
+import os
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+import time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models.llama import LlamaConfig
+from neuronx_distributed_tpu.pipeline.scheduler import bubble_fraction
+
+
+def measure(schedule: str, M: int, steps: int = 4) -> float:
+    nxd.destroy_model_parallel()
+    nxd.initialize_model_parallel(tensor_parallel_size=2, pipeline_parallel_size=4)
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256, num_layers=8,
+        num_heads=8, num_kv_heads=8, max_seq_len=64, sequence_parallel=False,
+        remat="none", dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+    model = LlamaForCausalLM(cfg).build_pipelined(num_microbatches=M, schedule=schedule)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2 * M, 64), 0, cfg.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+    fn = jax.jit(model.loss_and_grad_fn)
+    out = fn(model.params, ids, labels)
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(model.params, ids, labels)
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    return (time.perf_counter() - t0) / steps
+
+
+print(f"{'M':>4} {'gpipe ms':>9} {'sync1f1b ms':>12} {'ratio':>6} {'eager bubble':>13} {'sync bubble':>12}")
+for M in (4, 8, 16, 32):
+    tg = measure("gpipe", M)
+    ts = measure("1f1b", M)
+    print(f"{M:>4} {tg*1000:>9.1f} {ts*1000:>12.1f} {ts/tg:>6.2f} "
+          f"{bubble_fraction(M, 4):>13.3f} {bubble_fraction(M, 4, 'sync_1f1b'):>12.3f}")
