@@ -1,0 +1,155 @@
+"""HF ↔ framework checkpoint conversion CLI.
+
+Script-level counterpart of the reference's
+``examples/training/llama2/convert_checkpoints.py`` (HF↔NxD state-dict
+conversion), built on :mod:`neuronx_distributed_tpu.convert`:
+
+    # HF -> framework (orbax dir consumable by trainer.load_checkpoint)
+    python examples/convert_checkpoints.py to-framework \
+        --family llama --hf /path/to/hf_model_dir --out /tmp/fw_ckpt \
+        --config llama2_7b
+
+    # framework -> HF (safetensors)
+    python examples/convert_checkpoints.py to-hf \
+        --family llama --ckpt /tmp/fw_ckpt --out /tmp/hf_out --config llama2_7b
+
+HF side accepts a directory containing ``*.safetensors`` (preferred) or
+``pytorch_model*.bin`` shards.  The framework side is the same orbax layout
+``trainer.checkpoint`` reads ("model" payload of a tag dir).
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_hf_state_dict(path):
+    sd = {}
+    st_files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    if st_files:
+        from safetensors import safe_open
+
+        for f in st_files:
+            with safe_open(f, framework="np") as fh:
+                for k in fh.keys():
+                    sd[k] = fh.get_tensor(k)
+        return sd
+    bin_files = sorted(glob.glob(os.path.join(path, "pytorch_model*.bin"))) or sorted(
+        glob.glob(os.path.join(path, "*.pt"))
+    )
+    if not bin_files:
+        raise FileNotFoundError(f"no *.safetensors or pytorch_model*.bin under {path}")
+    import torch
+
+    for f in bin_files:
+        blob = torch.load(f, map_location="cpu", weights_only=True)
+        for k, v in blob.items():
+            sd[k] = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+    return sd
+
+
+def _save_hf_state_dict(sd, path):
+    os.makedirs(path, exist_ok=True)
+    try:
+        from safetensors.numpy import save_file
+
+        save_file({k: np.ascontiguousarray(v) for k, v in sd.items()},
+                  os.path.join(path, "model.safetensors"))
+    except ImportError:  # pragma: no cover - safetensors ships with transformers
+        import torch
+
+        torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
+                   os.path.join(path, "pytorch_model.bin"))
+
+
+def _family(args):
+    # conversion is pure host-side layout algebra: never touch an accelerator
+    # backend (the env may pin JAX_PLATFORMS to a hardware plugin; the config
+    # update wins over the latched env value)
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+    from neuronx_distributed_tpu import convert as C
+
+    if args.family == "llama":
+        from neuronx_distributed_tpu.models.llama import LlamaConfig
+
+        cfg = getattr(LlamaConfig, args.config)() if args.config else LlamaConfig()
+        return cfg, C.llama_params_from_hf, C.llama_params_to_hf
+    if args.family == "gpt_neox":
+        from neuronx_distributed_tpu.models.gpt_neox import GPTNeoXConfig
+
+        cfg = getattr(GPTNeoXConfig, args.config)() if args.config else GPTNeoXConfig()
+        return cfg, C.gpt_neox_params_from_hf, C.gpt_neox_params_to_hf
+    if args.family == "bert":
+        from neuronx_distributed_tpu.models.bert import BertConfig
+
+        cfg = getattr(BertConfig, args.config)() if args.config else BertConfig()
+        return cfg, C.bert_params_from_hf, C.bert_params_to_hf
+    raise ValueError(f"unknown family {args.family}")
+
+
+def cmd_to_framework(args):
+    import orbax.checkpoint as ocp
+
+    cfg, from_hf, _ = _family(args)
+    sd = _load_hf_state_dict(args.hf)
+    params = from_hf(sd, cfg)
+    ocp.Checkpointer(ocp.StandardCheckpointHandler()).save(
+        os.path.join(os.path.abspath(args.out), "model"),
+        args=ocp.args.StandardSave(params), force=True,
+    )
+    n = sum(int(np.asarray(x).size) for x in _leaves(params))
+    with open(os.path.join(args.out, "meta.json"), "w") as f:
+        json.dump({"tag": "hf_import", "family": args.family, "config": args.config}, f)
+    print(json.dumps({"params": n, "out": args.out}))
+
+
+def cmd_to_hf(args):
+    import orbax.checkpoint as ocp
+
+    cfg, _, to_hf = _family(args)
+    params = ocp.Checkpointer(ocp.StandardCheckpointHandler()).restore(
+        os.path.join(os.path.abspath(args.ckpt), "model")
+    )
+    sd = to_hf(params, cfg)
+    _save_hf_state_dict(sd, args.out)
+    print(json.dumps({"tensors": len(sd), "out": args.out}))
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _leaves(v)
+    else:
+        yield tree
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("to-framework", cmd_to_framework), ("to-hf", cmd_to_hf)):
+        sp = sub.add_parser(name)
+        sp.add_argument("--family", required=True, choices=["llama", "gpt_neox", "bert"])
+        sp.add_argument("--config", default=None,
+                        help="preset name on the family config (e.g. llama2_7b, tiny)")
+        sp.add_argument("--platform", default="cpu",
+                        help="jax platform for the conversion (default cpu)")
+        sp.add_argument("--out", required=True)
+        if name == "to-framework":
+            sp.add_argument("--hf", required=True, help="HF model directory")
+        else:
+            sp.add_argument("--ckpt", required=True, help="framework checkpoint tag dir")
+        sp.set_defaults(fn=fn)
+    args = p.parse_args()
+    sys.exit(args.fn(args))
+
+
+if __name__ == "__main__":
+    main()

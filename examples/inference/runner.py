@@ -88,9 +88,15 @@ def cmd_infer(args):
     model = parallel_model_load(args.model)
     cfg = model.config
     prompt = _prompt_ids(args.seed, cfg.batch_size, cfg.context_len, 256)
+    lens = None
+    if args.prompt_lens:
+        lens = [int(x) for x in args.prompt_lens.split(",")]
+        if len(lens) != cfg.batch_size:
+            raise SystemExit(f"--prompt-lens needs {cfg.batch_size} comma-separated ints")
     out = model.generate(prompt, args.max_new_tokens,
                          temperature=args.temperature,
-                         rng=jax.random.PRNGKey(args.seed) if args.temperature else None)
+                         rng=jax.random.PRNGKey(args.seed) if args.temperature else None,
+                         prompt_lens=lens)
     print(json.dumps({"generated": out[:, cfg.context_len:].tolist()}))
 
 
@@ -145,6 +151,9 @@ def main():
     sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("infer", help="generate from a saved artifact")
+    sp.add_argument("--prompt-lens", default=None,
+                    help="comma-separated per-example prompt lengths "
+                         "(ragged batch, left-padded)")
     common(sp, traced=True)
     sp.add_argument("--temperature", type=float, default=0.0)
     sp.set_defaults(fn=cmd_infer)

@@ -27,6 +27,7 @@ def main():
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--data", default=None, help="NXDT token file (synthetic if unset)")
     p.add_argument("--virtual-devices", type=int, default=None)
+    p.add_argument("--metrics-file", default=None, help="JSON results file")
     args = p.parse_args()
 
     from neuronx_distributed_tpu.utils.common import ensure_virtual_devices
@@ -105,6 +106,13 @@ def main():
         if step % 10 == 0 or step == args.steps - 1:
             print(json.dumps({"step": step, "loss": round(float(m["loss"]), 4),
                               "seq_per_sec": round(seqs, 2)}), flush=True)
+    if args.metrics_file:
+        from neuronx_distributed_tpu.trainer.metrics import TrainingMetrics
+
+        rec = TrainingMetrics(args.metrics_file)
+        rec.update(final_loss=float(m["loss"]), completed_steps=args.steps,
+                   peak_seq_per_sec=thr.peak)
+        rec.write()
     print(f"done: final loss {float(m['loss']):.4f}")
 
 

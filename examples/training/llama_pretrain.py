@@ -61,6 +61,8 @@ def parse_args():
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics-file", default=None, help="JSON results file")
     p.add_argument("--timeline", default=None, help="Chrome-trace output path")
+    p.add_argument("--scalar-dir", default=None,
+                   help="TensorBoard/JSONL scalar stream dir (designated-process only)")
     p.add_argument("--bf16", action="store_true", help="bf16 compute (default fp32 off-TPU)")
     p.add_argument("--virtual-devices", type=int, default=None,
                    help="force an N-device virtual CPU mesh (dev/test runs)")
@@ -184,6 +186,9 @@ def main():
     tl = Timeline(args.timeline)
     thr = Throughput(args.batch_size)
     metrics = TrainingMetrics(args.metrics_file) if args.metrics_file else None
+    from neuronx_distributed_tpu.trainer.scalar_log import ScalarWriter
+
+    scalars = ScalarWriter(args.scalar_dir) if args.scalar_dir else None
 
     for step in range(start_step, args.steps):
         with tl.event("train_step"):
@@ -193,6 +198,9 @@ def main():
             loss = float(m["loss"])
         seqs = thr.step()
         toks = seqs * args.seq_len
+        if scalars:
+            scalars.scalars(step, loss=loss, grad_norm=float(m["grad_norm"]),
+                            seq_per_sec=seqs)
         if step % 10 == 0 or step == args.steps - 1:
             line = {
                 "step": step, "loss": round(loss, 4),
@@ -203,17 +211,25 @@ def main():
             print(json.dumps(line), flush=True)
         tl.mark_step_end(step)
         if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
+            # async: the save overlaps the next training steps; the next
+            # save (or the final wait) finalizes it
             save_checkpoint(args.ckpt_dir, f"step_{step + 1}", params, opt_state,
                             user_content={"step": step + 1},
-                            num_kept_ckpts=args.keep_ckpts)
+                            num_kept_ckpts=args.keep_ckpts, async_save=True)
 
     if args.ckpt_dir:
         save_checkpoint(args.ckpt_dir, f"step_{args.steps}", params, opt_state,
                         user_content={"step": args.steps}, num_kept_ckpts=args.keep_ckpts)
+        from neuronx_distributed_tpu.trainer.checkpoint import wait_for_checkpoint
+
+        wait_for_checkpoint()
+    if scalars:
+        scalars.close()
     if metrics:
         peak = 197e12 if on_tpu else 1e12
         metrics.update(final_loss=loss, peak_seq_per_sec=thr.peak,
-                       mfu=mfu(toks, flops_tok, peak), steps=args.steps)
+                       mfu=mfu(toks, flops_tok, peak), steps=args.steps,
+                       completed_steps=args.steps, resumed_from_step=start_step)
         metrics.write()
     print(f"done: final loss {loss:.4f}")
 

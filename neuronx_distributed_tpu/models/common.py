@@ -35,11 +35,18 @@ def maybe_remat(block_cls, remat: str, static_argnums: Tuple[int, ...] = ()):
                     static_argnums=static_argnums)
 
 
+MOE_AUX_COEF = 0.01  # Switch-Transformer load-balancing coefficient
+
+
 def causal_lm_loss(module, params, batch, rng=None) -> jax.Array:
     """Next-token loss over vocab-sharded logits; ``batch = {ids, labels[,
     mask]}``, labels < 0 (ignore convention) drop out of the mean.  Works for
-    any causal-LM module whose ``apply(params, ids)`` returns logits."""
-    logits = module.apply(params, batch["ids"])
+    any causal-LM module whose ``apply(params, ids)`` returns logits.
+
+    MoE models (``num_experts > 1``) sow per-layer load-balancing terms into
+    the ``losses`` collection; they are averaged and added here with
+    ``MOE_AUX_COEF`` (dense models sow nothing — zero overhead)."""
+    logits, variables = module.apply(params, batch["ids"], mutable=["losses"])
     labels = batch["labels"]
     per_tok = parallel_cross_entropy(logits, labels)
     mask = batch.get("mask")
@@ -47,7 +54,11 @@ def causal_lm_loss(module, params, batch, rng=None) -> jax.Array:
         mask = (labels >= 0).astype(jnp.float32)
     else:
         mask = mask.astype(jnp.float32) * (labels >= 0)
-    return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    loss = jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    aux_terms = jax.tree.leaves(variables.get("losses", {}))
+    if aux_terms:
+        loss = loss + MOE_AUX_COEF * jnp.mean(jnp.stack(aux_terms))
+    return loss
 
 
 def dense_mha(

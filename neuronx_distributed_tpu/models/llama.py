@@ -74,6 +74,12 @@ class LlamaConfig:
     # causal-load-balanced cp layout: ids/positions must be fed in
     # ops.zigzag_permute order (labels/loss are permutation-invariant)
     cp_zigzag: bool = False
+    # Mixture-of-Experts (Mixtral-style; capability beyond the reference,
+    # which has no EP at all — SURVEY §2.10): num_experts > 1 replaces every
+    # block's MLP with an expert-parallel routed FFN over the ep mesh axis.
+    num_experts: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -148,14 +154,15 @@ class CoreAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, q, k, v, q_offset=0, allow_flash=True):
+    def __call__(self, q, k, v, q_offset=0, allow_flash=True, kv_valid=None):
         cfg = self.config
         if cfg.attention_impl == "flash" and allow_flash:
             from neuronx_distributed_tpu.ops.ring_attention import ring_attention
 
-            # ring_attention has no query-offset notion; only the q-aligned
-            # training case may take this path
+            # ring_attention has no query-offset or padding-mask notion; only
+            # the q-aligned unmasked training case may take this path
             assert q_offset == 0, "flash path requires q_offset == 0"
+            assert kv_valid is None, "flash path has no padding-mask support"
             return ring_attention(
                 q, k, v, causal=True,
                 layout="zigzag" if cfg.cp_zigzag else "contiguous",
@@ -170,8 +177,12 @@ class CoreAttention(nn.Module):
         # double-means-fp32 trick, modeling_llama_nxd.py:211)
         scores = jnp.einsum("bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(D).astype(jnp.float32)
-        mask = _causal_mask(S, T, q_offset)
-        scores = jnp.where(mask[None, None, None], scores, jnp.finfo(jnp.float32).min)
+        mask = _causal_mask(S, T, q_offset)[None, None, None]
+        if kv_valid is not None:
+            # per-example key validity [B, T] (left-padded serving batches,
+            # the reference's padded HF batches, neuron_modeling_llama.py:437-465)
+            mask = jnp.logical_and(mask, kv_valid[:, None, None, None, :].astype(bool))
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bkgst,btkd->bskgd", probs, v, preferred_element_type=q.dtype)
         return out.reshape(B, S, NQ, D)
@@ -181,7 +192,7 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None, cache_offset=0):
+    def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None):
         cfg = self.config
         D = cfg.head_dim_
         q, k, v = GQAQKVColumnParallelLinear(
@@ -211,7 +222,8 @@ class LlamaAttention(nn.Module):
         out = CoreAttention(cfg, name="core")(
             q, k, v,
             cache_offset if kv_cache is not None else 0,
-            allow_flash=kv_cache is None,
+            allow_flash=kv_cache is None and kv_valid is None,
+            kv_valid=kv_valid,
         )
 
         B, S = x.shape[0], q.shape[1]
@@ -259,18 +271,33 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None, cache_offset=0):
+    def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None):
         cfg = self.config
         h, new_cache = LlamaAttention(cfg, name="attn")(
             RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                     name="input_norm")(x),
-            positions, kv_cache, cache_offset,
+            positions, kv_cache, cache_offset, kv_valid,
         )
         x = x + h
-        h = LlamaMLP(cfg, name="mlp")(
-            RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                    name="post_attn_norm")(x)
-        )
+        normed = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="post_attn_norm")(x)
+        if cfg.num_experts > 1:
+            from neuronx_distributed_tpu.parallel.moe import ExpertParallelMLP
+
+            h, aux = ExpertParallelMLP(
+                num_experts=cfg.num_experts,
+                intermediate_size=cfg.intermediate_size,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="moe_mlp",
+            )(normed)
+            # collected by losses-mutable apply (causal_lm_loss adds the
+            # load-balancing term); silently dropped when not collected
+            self.sow("losses", "moe_aux", aux)
+        else:
+            h = LlamaMLP(cfg, name="mlp")(normed)
         x = x + h
         if cfg.sequence_parallel:
             # residual stream lives sequence-sharded between blocks
@@ -284,7 +311,8 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0):
+    def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
+                 kv_valid=None):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
@@ -303,9 +331,10 @@ class LlamaModel(nn.Module):
         for i in range(cfg.num_layers):
             cache = kv_caches[i] if kv_caches is not None else None
             if kv_caches is not None:
-                h, c = LlamaBlock(cfg, name=f"layer_{i}")(h, positions, cache, cache_offset)
+                h, c = LlamaBlock(cfg, name=f"layer_{i}")(
+                    h, positions, cache, cache_offset, kv_valid)
             else:
-                h, c = block_cls(cfg, name=f"layer_{i}")(h, positions, None, 0)
+                h, c = block_cls(cfg, name=f"layer_{i}")(h, positions, None, 0, kv_valid)
             new_caches.append(c)
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="final_norm")(h)
         return (h, new_caches) if kv_caches is not None else (h, None)
@@ -326,9 +355,11 @@ class LlamaForCausalLM(nn.Module):
         )
 
     @nn.compact
-    def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0):
+    def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
+                 kv_valid=None):
         cfg = self.config
-        h, new_caches = LlamaModel(cfg, name="model")(ids, positions, kv_caches, cache_offset)
+        h, new_caches = LlamaModel(cfg, name="model")(
+            ids, positions, kv_caches, cache_offset, kv_valid)
         if cfg.sequence_parallel and kv_caches is None:
             # gather the sequence back before the (batched) head matmul
             h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))
@@ -379,6 +410,17 @@ def build_pipelined_llama(
     ``pipeline/partition.py:17-42``)."""
     import neuronx_distributed_tpu.pipeline.engine as engine
     from neuronx_distributed_tpu.parallel.mesh import get_mesh
+
+    if cfg.num_experts > 1:
+        # The engine's block_fn has no channel for the sown load-balancing
+        # aux loss; silently training a router without balancing pressure is
+        # worse than refusing (flax sow into a non-mutable collection is a
+        # no-op, so the loss would just vanish).
+        raise NotImplementedError(
+            "MoE (num_experts > 1) under pipeline parallelism is not yet "
+            "supported: the 1F1B engine does not collect the router's "
+            "load-balancing aux loss; use pp=1 (dp/ep/tp/cp compose freely)"
+        )
 
     mesh = get_mesh()
     embed_mod = ParallelEmbedding(

