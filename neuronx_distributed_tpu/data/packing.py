@@ -1,0 +1,105 @@
+"""Sequence packing: fill fixed-length training rows from ragged documents.
+
+The reference's data prep concatenates tokenized documents and chunks them to
+``seq_len`` (the ``get_examples`` preprocessing its example trainers assume);
+this module provides that as a library function plus the loss/attention
+metadata the trainer consumes:
+
+- ``pack_documents`` — greedy first-fit packing of ragged docs into
+  ``[N, seq_len]`` rows with an EOS separator, emitting ``labels`` (ignore
+  index over padding and separators if requested) and ``segment_ids`` so an
+  attention implementation can optionally block cross-document attention;
+- ``concat_and_chunk`` — the reference's simpler concatenate-everything
+  layout (documents flow across row boundaries, maximum token utilization).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+import numpy as np
+
+IGNORE = -100
+
+
+def concat_and_chunk(
+    docs: Iterable[np.ndarray], seq_len: int, eos_id: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Concatenate ``docs`` (1-D int arrays) with EOS separators and chunk
+    into ``[N, seq_len]`` rows of ``ids`` and next-token ``labels``; the tail
+    that does not fill a row is dropped (the reference's preprocessing
+    convention)."""
+    stream: List[np.ndarray] = []
+    for d in docs:
+        stream.append(np.asarray(d, np.int32).ravel())
+        stream.append(np.asarray([eos_id], np.int32))
+    if not stream:
+        return np.zeros((0, seq_len), np.int32), np.zeros((0, seq_len), np.int32)
+    flat = np.concatenate(stream)
+    # need one extra token so every position has a next-token label
+    n = (len(flat) - 1) // seq_len
+    ids = flat[: n * seq_len].reshape(n, seq_len).astype(np.int32)
+    labels = flat[1 : n * seq_len + 1].reshape(n, seq_len).astype(np.int32)
+    return ids, labels
+
+
+def pack_documents(
+    docs: Iterable[np.ndarray],
+    seq_len: int,
+    eos_id: int,
+    pad_id: int = 0,
+    mask_separators: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Greedy first-fit packing: each document (+1 EOS) is placed whole into
+    the first row with room; rows never split a document.  Returns
+    ``(ids, labels, segment_ids)`` each ``[N, seq_len]``:
+
+    - ``labels`` are next-token within each document, ``IGNORE`` (-100) on
+      padding, on the EOS position itself (nothing follows it), and
+      (optionally, ``mask_separators``) on the position that predicts EOS;
+    - ``segment_ids`` number pieces within a row from 1 (0 = padding), the
+      mask an attention kernel needs to block cross-document attention.
+
+    Documents longer than ``seq_len`` are split into ``seq_len``-sized pieces
+    first.  Crucially the split inserts NO fake EOS: labels are computed over
+    the whole document before splitting, so a piece's last position predicts
+    the document's true next token — the model is never taught that documents
+    end at arbitrary ``seq_len`` boundaries."""
+    pieces: List[Tuple[np.ndarray, np.ndarray]] = []
+    for d in docs:
+        d = np.asarray(d, np.int32).ravel()
+        toks = np.concatenate([d, np.asarray([eos_id], np.int32)])
+        labs = np.concatenate([toks[1:], np.asarray([IGNORE], np.int32)])
+        if mask_separators and len(toks) >= 2:
+            labs[len(toks) - 2] = IGNORE  # the position predicting EOS
+        for i in range(0, len(toks), seq_len):
+            pieces.append((toks[i : i + seq_len], labs[i : i + seq_len]))
+
+    rows: List[List[Tuple[np.ndarray, np.ndarray]]] = []
+    space: List[int] = []
+    for piece in pieces:
+        need = len(piece[0])
+        placed = False
+        for r, s in enumerate(space):
+            if s >= need:
+                rows[r].append(piece)
+                space[r] -= need
+                placed = True
+                break
+        if not placed:
+            rows.append([piece])
+            space.append(seq_len - need)
+
+    N = len(rows)
+    ids = np.full((N, seq_len), pad_id, np.int32)
+    labels = np.full((N, seq_len), IGNORE, np.int32)
+    segs = np.zeros((N, seq_len), np.int32)
+    for r, row_pieces in enumerate(rows):
+        pos = 0
+        for si, (ptoks, plabs) in enumerate(row_pieces, start=1):
+            L = len(ptoks)
+            ids[r, pos : pos + L] = ptoks
+            labels[r, pos : pos + L] = plabs
+            segs[r, pos : pos + L] = si
+            pos += L
+    return ids, labels, segs

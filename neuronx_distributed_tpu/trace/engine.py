@@ -57,6 +57,43 @@ from neuronx_distributed_tpu.utils.logger import get_logger
 logger = get_logger(__name__)
 
 
+def _sample_logits(logits, rng, temperature, top_k=0, top_p=1.0):
+    """Greedy / temperature / top-k / nucleus sampling.
+
+    ``top_k > 0`` keeps only the k most likely tokens; ``top_p < 1`` keeps
+    the smallest prefix of the sorted distribution whose mass reaches p
+    (applied after top-k).  All three knobs may be TRACED scalars — one
+    compiled program serves every sampler setting (per-request settings must
+    not each pay an XLA compile) — with the pure-greedy Python-float
+    ``temperature == 0.0`` short-circuit kept so greedy callers need no rng.
+    Serving parity with HF ``generate``'s standard sampler knobs (the
+    reference drives its compiled pair through HF generate,
+    ``neuron_modeling_llama.py:437-465``)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if isinstance(temperature, (int, float)) and float(temperature) == 0.0:
+        return greedy
+    logits = logits.astype(jnp.float32) / jnp.maximum(
+        jnp.asarray(temperature, jnp.float32), 1e-6
+    )
+    neg = jnp.finfo(jnp.float32).min
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    # rank of each logit (0 = largest), traced-k-compatible via double argsort
+    order = jnp.argsort(-logits, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    logits = jnp.where((top_k > 0) & (ranks >= top_k), neg, logits)
+    # nucleus: drop tokens whose PRECEDING sorted mass reaches top_p
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p  # always keeps >= 1 token
+    cutoff = jnp.max(jnp.where(keep_sorted, sorted_logits, neg), axis=-1,
+                     keepdims=True)
+    logits = jnp.where((top_p < 1.0) & (logits < cutoff), neg, logits)
+    sampled = jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return jnp.where(jnp.asarray(temperature, jnp.float32) > 0.0, sampled, greedy)
+
+
 def parallel_model_trace(
     fn: Callable,
     *example_args,
@@ -153,12 +190,10 @@ class _ServingBase:
     context: Callable
     decode: Callable
 
-    def _sample(self, logits, rng, temperature):
+    def _sample(self, logits, rng, temperature, top_k=0, top_p=1.0):
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature sampling requires an rng key")
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(rng, logits / temperature, axis=-1).astype(jnp.int32)
+        return _sample_logits(logits, rng, temperature, top_k, top_p)
 
     def _valid_ctx(self, prompt_lens) -> jax.Array:
         """Left-padded key-validity mask [B, C] from per-example lengths."""
@@ -176,29 +211,25 @@ class _ServingBase:
         classes bind it to the pure phase fn or the exported program."""
         raise NotImplementedError
 
-    def _decode_loop(self, n: int, temperature: float):
+    def _decode_loop(self, n: int):
         """Compiled n-step decode: sample → append → attend as one
-        ``lax.scan`` under one jit (no per-token host sync).  Cached per
-        (n, temperature)."""
+        ``lax.scan`` under one jit (no per-token host sync).  Sampler knobs
+        (temperature / top_k / top_p) are RUNTIME scalars, so one compiled
+        loop per ``n`` serves every per-request sampler setting."""
         if not hasattr(self, "_loop_cache"):
             self._loop_cache = {}
-        key = (n, float(temperature))
-        fn = self._loop_cache.get(key)
+        fn = self._loop_cache.get(n)
         if fn is not None:
             return fn
 
-        def loop(params, first_tok, start, caches, valid, rngs):
+        def loop(params, first_tok, start, caches, valid, rngs,
+                 temperature, top_k, top_p):
             def step(carry, rng_i):
                 tok, offset, caches, valid = carry
                 logits, caches, valid = self._decode_step_traceable(
                     params, tok, offset, caches, valid
                 )
-                if temperature == 0.0:
-                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
-                else:
-                    nxt = jax.random.categorical(
-                        rng_i, logits / temperature, axis=-1
-                    ).astype(jnp.int32)[:, None]
+                nxt = _sample_logits(logits, rng_i, temperature, top_k, top_p)[:, None]
                 return (nxt, offset + 1, caches, valid), nxt[:, 0]
 
             (_, _, _, _), toks = jax.lax.scan(
@@ -207,7 +238,7 @@ class _ServingBase:
             return toks.T  # [B, n]
 
         fn = jax.jit(loop, donate_argnums=(3,))
-        self._loop_cache[key] = fn
+        self._loop_cache[n] = fn
         return fn
 
     def generate(
@@ -218,6 +249,8 @@ class _ServingBase:
         rng: Optional[jax.Array] = None,
         prompt_lens: Optional[jax.Array] = None,
         fused: bool = True,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> jax.Array:
         """Prefill + fixed-length decode; returns ``[B, C + max_new_tokens]``.
 
@@ -244,7 +277,7 @@ class _ServingBase:
             [valid, jnp.zeros((B, T - C), jnp.int32)], axis=1
         )
         first_rng = jax.random.fold_in(rng, 0) if rng is not None else None
-        first = self._sample(logits, first_rng, temperature)[:, None]
+        first = self._sample(logits, first_rng, temperature, top_k, top_p)[:, None]
         if max_new_tokens == 1:
             return jnp.concatenate([prompt_ids, first], axis=1)
 
@@ -255,8 +288,9 @@ class _ServingBase:
                 if rng is not None
                 else jnp.zeros((n_more, 2), jnp.uint32)
             )
-            more = self._decode_loop(n_more, temperature)(
-                self.params, first, jnp.int32(C), caches, valid_full, rngs
+            more = self._decode_loop(n_more)(
+                self.params, first, jnp.int32(C), caches, valid_full, rngs,
+                jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p),
             )
             return jnp.concatenate([prompt_ids, first, more], axis=1)
 
@@ -267,7 +301,7 @@ class _ServingBase:
             logits, caches, valid_full = self.decode(
                 self.params, nxt, jnp.int32(C + step), caches, valid_full
             )
-            nxt = self._sample(logits, step_rng, temperature)[:, None]
+            nxt = self._sample(logits, step_rng, temperature, top_k, top_p)[:, None]
             toks.append(nxt)
         return jnp.concatenate(toks, axis=1)
 

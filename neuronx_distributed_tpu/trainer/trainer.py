@@ -238,6 +238,7 @@ def make_train_step(
     optimizer: ParallelOptimizer,
     loss_fn: Optional[Callable[..., Any]] = None,
     batch_spec: Optional[Any] = None,
+    grad_accum_steps: int = 1,
 ):
     """Build the one jitted SPMD train step (replaces the reference's
     per-iteration lazy-tensor graph + ``bucket_allreduce`` +
@@ -246,6 +247,16 @@ def make_train_step(
     ``loss_fn(module, params, batch, rng) -> loss`` must return a scalar mean
     loss over the *global* batch; the DP gradient mean is then implicit in
     autodiff over the dp-sharded batch.
+
+    ``grad_accum_steps > 1`` splits the leading batch dim into that many
+    microbatches inside the jit (a ``lax.scan``), averaging gradients before
+    one optimizer update — the reference's accumulated global batch
+    (GBS = microbatch x accum x dp, ``tp_zero1_llama2_7b_hf_pretrain.py``
+    gradient_accumulation loop) with activation memory bounded by one
+    microbatch.  The accumulated loss/grad is the mean of per-microbatch
+    means — exactly the global mean when every microbatch carries the same
+    number of unmasked tokens (the usual packed-pretraining case, and the
+    reference's semantics too).
 
     A :class:`~..pipeline.engine.PipelinedModel` (from
     ``initialize_parallel_model`` with pp>1) is dispatched to
@@ -256,17 +267,66 @@ def make_train_step(
     from neuronx_distributed_tpu.pipeline.engine import PipelinedModel
 
     if isinstance(model, PipelinedModel):
+        if grad_accum_steps != 1:
+            raise ValueError(
+                "grad_accum_steps does not apply to pipelined models — the "
+                "schedule already accumulates over pipeline.num_microbatches; "
+                "raise that instead"
+            )
         return make_pipelined_train_step(config, model, optimizer)
     if loss_fn is None:
         raise ValueError("loss_fn is required for non-pipelined models")
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
     oc = config.optimizer
     mesh = model.mesh
 
     param_shardings = model.param_shardings
     state_shardings = optimizer.state_shardings
 
+    def _loss_and_grad(params, batch, rng):
+        if grad_accum_steps == 1:
+            return jax.value_and_grad(loss_fn, argnums=1)(
+                model.module, params, batch, rng
+            )
+
+        def split(x):
+            if x.shape[0] % grad_accum_steps != 0:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by "
+                    f"grad_accum_steps {grad_accum_steps}"
+                )
+            return x.reshape(grad_accum_steps, x.shape[0] // grad_accum_steps,
+                             *x.shape[1:])
+
+        micro = jax.tree.map(split, batch)
+
+        def body(acc, xs):
+            # rng=None must stay None for every microbatch (the single-shot
+            # path's semantics: loss_fn decides dropout by rng presence)
+            if rng is None:
+                mb, r = xs, None
+            else:
+                mb, r = xs
+            l, g = jax.value_and_grad(loss_fn, argnums=1)(model.module, params, mb, r)
+            loss_acc, grad_acc = acc
+            # fp32 accumulator: summing many bf16 gradients in bf16 rounds
+            # away low-order contributions; one downcast after scaling
+            return (
+                loss_acc + l.astype(jnp.float32),
+                jax.tree.map(lambda a, gg: a + gg.astype(jnp.float32), grad_acc, g),
+            ), None
+
+        xs = micro if rng is None else (micro, jax.random.split(rng, grad_accum_steps))
+        zero = (jnp.zeros((), jnp.float32),
+                jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
+        (loss_sum, grads), _ = jax.lax.scan(body, zero, xs)
+        scale = 1.0 / grad_accum_steps
+        return loss_sum * scale, jax.tree.map(
+            lambda g, p: (g * scale).astype(p.dtype), grads, params)
+
     def _step(params, opt_state, batch, rng):
-        loss, grads = jax.value_and_grad(loss_fn, argnums=1)(model.module, params, batch, rng)
+        loss, grads = _loss_and_grad(params, batch, rng)
         if oc.grad_clipping:
             grads, grad_norm = clip_grad_norm(grads, oc.max_grad_norm)
         else:

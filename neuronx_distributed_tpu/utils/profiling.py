@@ -1,0 +1,82 @@
+"""Compiled-program cost reporting (profile → iterate support, SURVEY §5.1).
+
+The reference leans on external Neuron tools for device-level profiling;
+on TPU the XLA compiler itself reports per-executable FLOPs, HBM traffic and
+memory footprints.  ``cost_report`` turns that into one dict, and
+``roofline`` into a lower-bound step time — the quick sanity check that
+caught the round-2 super-peak bench number would have been one call."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# v5e-class default; callers pass their chip's numbers for other parts
+DEFAULT_PEAK_FLOPS = 197e12
+DEFAULT_HBM_BYTES_PER_S = 819e9
+
+
+def cost_report(compiled: Any) -> Dict[str, float]:
+    """Summarize an executable from ``jax.jit(f).lower(...).compile()``:
+    FLOPs, bytes accessed, and (when the backend reports it) the memory
+    breakdown in bytes."""
+    out: Dict[str, float] = {}
+    ca = compiled.cost_analysis() or {}
+    for key in ("flops", "bytes accessed", "transcendentals"):
+        if key in ca:
+            out[key.replace(" ", "_")] = float(ca[key])
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # pragma: no cover - backend-dependent
+        ma = None
+    if ma is not None:
+        for attr in (
+            "argument_size_in_bytes",
+            "output_size_in_bytes",
+            "temp_size_in_bytes",
+            "generated_code_size_in_bytes",
+        ):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                out[attr] = float(v)
+    return out
+
+
+def roofline(
+    report: Dict[str, float],
+    peak_flops: float = DEFAULT_PEAK_FLOPS,
+    hbm_bytes_per_s: float = DEFAULT_HBM_BYTES_PER_S,
+) -> Dict[str, float]:
+    """Roofline lower bound for one execution of the reported program:
+    ``max(flops/peak, bytes/bandwidth)`` — measured step times below this are
+    physically impossible (the round-2 bench failure mode), far above it
+    indicate overhead or serialization to chase."""
+    flops = report.get("flops", 0.0)
+    bytes_ = report.get("bytes_accessed", 0.0)
+    t_compute = flops / peak_flops if peak_flops else 0.0
+    t_memory = bytes_ / hbm_bytes_per_s if hbm_bytes_per_s else 0.0
+    bound = max(t_compute, t_memory)
+    return {
+        "compute_s": t_compute,
+        "memory_s": t_memory,
+        "lower_bound_s": bound,
+        "bound": "compute" if t_compute >= t_memory else "memory",
+        "arithmetic_intensity": (flops / bytes_) if bytes_ else float("inf"),
+    }
+
+
+def jit_cost_report(fn, *example_args, peak_flops: Optional[float] = None,
+                    hbm_bytes_per_s: Optional[float] = None) -> Dict[str, Any]:
+    """One-call convenience: lower+compile ``fn`` on the example args and
+    return ``{"cost": ..., "roofline": ...}``."""
+    import jax
+
+    compiled = jax.jit(fn).lower(*example_args).compile()
+    rep = cost_report(compiled)
+    return {
+        "cost": rep,
+        "roofline": roofline(
+            rep,
+            peak_flops or DEFAULT_PEAK_FLOPS,
+            hbm_bytes_per_s or DEFAULT_HBM_BYTES_PER_S,
+        ),
+    }

@@ -172,3 +172,54 @@ def test_exhausted_until_set_epoch(token_file):
 def test_negative_tokens_rejected(tmp_path):
     with pytest.raises(ValueError, match="non-negative"):
         write_token_file(str(tmp_path / "bad.nxdt"), np.array([5, -1, 7]))
+
+
+def test_concat_and_chunk():
+    from neuronx_distributed_tpu.data.packing import concat_and_chunk
+
+    docs = [np.arange(1, 6), np.arange(10, 13)]  # 5 + eos + 3 + eos = 10 tokens
+    ids, labels = concat_and_chunk(docs, seq_len=4, eos_id=99)
+    assert ids.shape == labels.shape == (2, 4)
+    np.testing.assert_array_equal(ids[0], [1, 2, 3, 4])
+    np.testing.assert_array_equal(labels[0], [2, 3, 4, 5])  # next-token shift
+    np.testing.assert_array_equal(ids[1], [5, 99, 10, 11])
+    np.testing.assert_array_equal(labels[1], [99, 10, 11, 12])
+
+
+def test_pack_documents_first_fit():
+    from neuronx_distributed_tpu.data.packing import IGNORE, pack_documents
+
+    docs = [np.array([1, 2, 3]), np.array([4, 5]), np.array([6])]
+    ids, labels, segs = pack_documents(docs, seq_len=8, eos_id=99, pad_id=0)
+    # needs (3+1)+(2+1)+(1+1) = 9 slots > 8: docs 1+2 share row 0, doc 3
+    # spills whole into row 1 (rows never split a short document)
+    assert ids.shape == (2, 8)
+    np.testing.assert_array_equal(ids[0], [1, 2, 3, 99, 4, 5, 99, 0])
+    np.testing.assert_array_equal(ids[1][:2], [6, 99])
+    # next-token labels; the EOS position itself predicts nothing
+    np.testing.assert_array_equal(labels[0][:4], [2, 3, 99, IGNORE])
+    np.testing.assert_array_equal(segs[0], [1, 1, 1, 1, 2, 2, 2, 0])
+    np.testing.assert_array_equal(segs[1][:2], [1, 1])  # per-row numbering
+
+
+def test_pack_documents_long_doc_split_and_pad():
+    from neuronx_distributed_tpu.data.packing import IGNORE, pack_documents
+
+    ids, labels, segs = pack_documents([np.arange(1, 12)], seq_len=6, eos_id=99)
+    # 11 tokens + final EOS = 12 -> exactly two seq_len pieces, NO fake EOS
+    # at the split: the boundary position's label is the doc's true next token
+    assert ids.shape[0] == 2
+    np.testing.assert_array_equal(ids[0], [1, 2, 3, 4, 5, 6])
+    np.testing.assert_array_equal(labels[0], [2, 3, 4, 5, 6, 7])  # crosses split
+    np.testing.assert_array_equal(ids[1], [7, 8, 9, 10, 11, 99])
+    np.testing.assert_array_equal(labels[1], [8, 9, 10, 11, 99, IGNORE])
+    assert (labels[segs == 0] == IGNORE).all()  # padding never contributes loss
+
+
+def test_pack_documents_mask_separators():
+    from neuronx_distributed_tpu.data.packing import IGNORE, pack_documents
+
+    ids, labels, segs = pack_documents(
+        [np.array([1, 2, 3])], seq_len=8, eos_id=99, mask_separators=True)
+    # position predicting EOS is masked; the EOS position always is
+    np.testing.assert_array_equal(labels[0][:4], [2, 3, IGNORE, IGNORE])

@@ -138,3 +138,22 @@ def test_fused_and_stepped_decode_agree(served):
     fused = model.generate(prompt, max_new_tokens=6, fused=True)
     stepped = model.generate(prompt, max_new_tokens=6, fused=False)
     np.testing.assert_array_equal(np.asarray(fused), np.asarray(stepped))
+
+
+def test_top_k_top_p_sampling(served):
+    """top-k=1 at any temperature must equal greedy; top-p cutoffs keep at
+    least one token and produce valid ids."""
+    cfg, module, params, model = served
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0, cfg.vocab_size)
+    greedy = model.generate(prompt, max_new_tokens=5)
+    k1 = model.generate(prompt, max_new_tokens=5, temperature=1.0,
+                        rng=jax.random.PRNGKey(0), top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+    nucleus = model.generate(prompt, max_new_tokens=5, temperature=0.8,
+                             rng=jax.random.PRNGKey(0), top_p=0.9)
+    arr = np.asarray(nucleus[:, 8:])
+    assert ((arr >= 0) & (arr < cfg.vocab_size)).all()
+    # tiny top_p degenerates to greedy (only the argmax survives the cutoff)
+    p_tiny = model.generate(prompt, max_new_tokens=5, temperature=1.0,
+                            rng=jax.random.PRNGKey(1), top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p_tiny))

@@ -132,3 +132,26 @@ def test_resume_training_continues(config, tmp_path):
     _, _, ma = step(p2, s2, _data(jax.random.PRNGKey(99)), jax.random.PRNGKey(99))
     _, _, mb = step(p3, s3, _data(jax.random.PRNGKey(99)), jax.random.PRNGKey(99))
     assert float(ma["loss"]) == pytest.approx(float(mb["loss"]), rel=1e-6)
+
+
+def test_grad_accumulation_matches_full_batch(config):
+    """grad_accum_steps=2 must reproduce the single-shot step exactly
+    (uniform token counts -> mean-of-means == global mean)."""
+    model = initialize_parallel_model(config, TinyLM, (jnp.zeros((1, 8), jnp.int32),))
+    opt = initialize_parallel_optimizer(config, model)
+    bs = {"ids": default_batch_spec(), "labels": default_batch_spec()}
+    step1 = make_train_step(config, model, opt, lm_loss, batch_spec=bs)
+    step2 = make_train_step(config, model, opt, lm_loss, batch_spec=bs,
+                            grad_accum_steps=2)
+    batch = _data(jax.random.PRNGKey(0))
+    # real copies: the steps donate their params/state buffers
+    p1, s1, m1 = step1(jax.tree.map(jnp.copy, model.params),
+                       jax.tree.map(jnp.copy, opt.state), batch, None)
+    p2, s2, m2 = step2(model.params, opt.state, batch, None)
+    assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), rel=1e-5)
+    for (k1_, a), (k2_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(p1)[0],
+        jax.tree_util.tree_flatten_with_path(p2)[0],
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-6, err_msg=jax.tree_util.keystr(k1_))

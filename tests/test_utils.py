@@ -182,3 +182,17 @@ def test_padded_gqa_llama_matches_unpadded(devices8):
 
     with pytest.raises(ValueError, match="group size"):
         pad_llama_params(p, 6, 8, 8, old_kv_heads=3, new_kv_heads=8)
+
+
+def test_cost_report_and_roofline():
+    from neuronx_distributed_tpu.utils.profiling import jit_cost_report
+
+    import jax.numpy as jnp
+
+    a = jnp.ones((256, 256), jnp.float32)
+    rep = jit_cost_report(lambda x: x @ x, a, peak_flops=1e12, hbm_bytes_per_s=1e11)
+    # 2*256^3 = 33.5 MFLOP; CPU backend reports cost analysis too
+    assert rep["cost"].get("flops", 0) >= 2 * 256**3 * 0.9
+    rl = rep["roofline"]
+    assert rl["lower_bound_s"] == max(rl["compute_s"], rl["memory_s"]) > 0
+    assert rl["bound"] in ("compute", "memory")
