@@ -77,11 +77,16 @@ def pack_documents(
 
     rows: List[List[Tuple[np.ndarray, np.ndarray]]] = []
     space: List[int] = []
+    # first-fit over a bounded lookback of recently-opened rows: full
+    # first-fit is O(pieces x rows) (quadratic at corpus scale); a window
+    # keeps packing near-identical at O(pieces x window)
+    window = 64
     for piece in pieces:
         need = len(piece[0])
         placed = False
-        for r, s in enumerate(space):
-            if s >= need:
+        lo = max(0, len(rows) - window)
+        for r in range(lo, len(rows)):
+            if space[r] >= need:
                 rows[r].append(piece)
                 space[r] -= need
                 placed = True

@@ -45,8 +45,24 @@ def causal_lm_loss(module, params, batch, rng=None) -> jax.Array:
 
     MoE models (``num_experts > 1``) sow per-layer load-balancing terms into
     the ``losses`` collection; they are averaged and added here with
-    ``MOE_AUX_COEF`` (dense models sow nothing — zero overhead)."""
-    logits, variables = module.apply(params, batch["ids"], mutable=["losses"])
+    ``MOE_AUX_COEF`` (dense models sow nothing — zero overhead).
+
+    Packed batches (``data.packing``) may carry ``positions`` (per-document
+    RoPE phases) and ``segment_ids`` (cross-document attention blocking);
+    both are forwarded when the module accepts them (the Llama family does)."""
+    import inspect
+
+    accepted = inspect.signature(type(module).__call__).parameters
+    kwargs = {}
+    for key in ("positions", "segment_ids"):
+        if batch.get(key) is not None:
+            if key not in accepted:
+                raise TypeError(
+                    f"batch carries {key!r} but {type(module).__name__} does "
+                    "not accept it; drop the key or use a packing-aware model"
+                )
+            kwargs[key] = batch[key]
+    logits, variables = module.apply(params, batch["ids"], mutable=["losses"], **kwargs)
     labels = batch["labels"]
     per_tok = parallel_cross_entropy(logits, labels)
     mask = batch.get("mask")

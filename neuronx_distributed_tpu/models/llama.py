@@ -154,9 +154,10 @@ class CoreAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, q, k, v, q_offset=0, allow_flash=True, kv_valid=None):
+    def __call__(self, q, k, v, q_offset=0, allow_flash=True, kv_valid=None,
+                 segment_ids=None):
         cfg = self.config
-        if cfg.attention_impl == "flash" and allow_flash:
+        if cfg.attention_impl == "flash" and allow_flash and segment_ids is None:
             from neuronx_distributed_tpu.ops.ring_attention import ring_attention
 
             # ring_attention has no query-offset or padding-mask notion; only
@@ -182,6 +183,13 @@ class CoreAttention(nn.Module):
             # per-example key validity [B, T] (left-padded serving batches,
             # the reference's padded HF batches, neuron_modeling_llama.py:437-465)
             mask = jnp.logical_and(mask, kv_valid[:, None, None, None, :].astype(bool))
+        if segment_ids is not None:
+            # packed pretraining (data.packing segment ids): queries attend
+            # only within their own document; 0 marks padding (blocked both
+            # ways, and its loss is already IGNOREd by the packer)
+            same = segment_ids[:, None, :] == segment_ids[:, :, None]  # [B,S,T]
+            live = (segment_ids > 0)[:, :, None]
+            mask = jnp.logical_and(mask, (same & live)[:, None, None])
         scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bkgst,btkd->bskgd", probs, v, preferred_element_type=q.dtype)
@@ -192,7 +200,8 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None):
+    def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None,
+                 segment_ids=None):
         cfg = self.config
         D = cfg.head_dim_
         q, k, v = GQAQKVColumnParallelLinear(
@@ -224,6 +233,7 @@ class LlamaAttention(nn.Module):
             cache_offset if kv_cache is not None else 0,
             allow_flash=kv_cache is None and kv_valid is None,
             kv_valid=kv_valid,
+            segment_ids=segment_ids,
         )
 
         B, S = x.shape[0], q.shape[1]
@@ -271,12 +281,13 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None):
+    def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None,
+                 segment_ids=None):
         cfg = self.config
         h, new_cache = LlamaAttention(cfg, name="attn")(
             RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                     name="input_norm")(x),
-            positions, kv_cache, cache_offset, kv_valid,
+            positions, kv_cache, cache_offset, kv_valid, segment_ids,
         )
         x = x + h
         normed = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -312,7 +323,7 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
-                 kv_valid=None):
+                 kv_valid=None, segment_ids=None):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
@@ -332,9 +343,10 @@ class LlamaModel(nn.Module):
             cache = kv_caches[i] if kv_caches is not None else None
             if kv_caches is not None:
                 h, c = LlamaBlock(cfg, name=f"layer_{i}")(
-                    h, positions, cache, cache_offset, kv_valid)
+                    h, positions, cache, cache_offset, kv_valid, segment_ids)
             else:
-                h, c = block_cls(cfg, name=f"layer_{i}")(h, positions, None, 0, kv_valid)
+                h, c = block_cls(cfg, name=f"layer_{i}")(
+                    h, positions, None, 0, kv_valid, segment_ids)
             new_caches.append(c)
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="final_norm")(h)
         return (h, new_caches) if kv_caches is not None else (h, None)
@@ -356,10 +368,10 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
-                 kv_valid=None):
+                 kv_valid=None, segment_ids=None):
         cfg = self.config
         h, new_caches = LlamaModel(cfg, name="model")(
-            ids, positions, kv_caches, cache_offset, kv_valid)
+            ids, positions, kv_caches, cache_offset, kv_valid, segment_ids)
         if cfg.sequence_parallel and kv_caches is None:
             # gather the sequence back before the (batched) head matmul
             h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))

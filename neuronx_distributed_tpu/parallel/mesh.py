@@ -122,21 +122,44 @@ _STATE = _MeshState()
 def _build_device_array(devices: Sequence[jax.Device], shape: Sequence[int]) -> np.ndarray:
     """Arrange devices into the mesh shape.
 
-    On real TPU slices, delegate to ``mesh_utils.create_device_mesh`` so the
-    mesh respects physical ICI topology; for CPU/virtual devices a plain
-    reshape preserves rank-contiguity (TP innermost), matching the reference's
-    contiguous-TP / strided-DP group construction.
+    On real TPU slices, delegate to ``mesh_utils`` so the mesh respects
+    physical topology: a single slice uses ``create_device_mesh`` (ICI-aware
+    axis assignment), and a MULTI-slice job uses ``create_hybrid_device_mesh``
+    with the data-parallel axis split across slices — so only dp traffic
+    (gradient psum, once per step) rides the slow DCN links while tp/cp/pp
+    collectives stay on intra-slice ICI.  This is the mesh-layout form of the
+    reference's "EFA across nodes, NeuronLink within" topology
+    (``run_llama_70b_tp_pp.sh:7-15``); here the transport choice falls out of
+    device order instead of env flags.  For CPU/virtual devices a plain
+    reshape preserves rank-contiguity (TP innermost), matching the
+    reference's contiguous-TP / strided-DP group construction.
     """
     devices = list(devices)
     if math.prod(shape) != len(devices):
         raise ValueError(f"mesh shape {tuple(shape)} does not match device count {len(devices)}")
     if devices and devices[0].platform == "tpu" and len(devices) > 1:
+        n_slices = len({getattr(d, "slice_index", 0) for d in devices})
         try:
             from jax.experimental import mesh_utils
 
+            if n_slices > 1 and shape[0] % n_slices == 0:
+                dcn_shape = (n_slices,) + (1,) * (len(shape) - 1)
+                local_shape = (shape[0] // n_slices, *shape[1:])
+                return mesh_utils.create_hybrid_device_mesh(
+                    local_shape, dcn_shape, devices=devices
+                )
+            if n_slices > 1:
+                # dp cannot absorb the slice boundary (e.g. dp=1, pp across
+                # slices — the reference's 70B topology): a legitimate
+                # layout, just with model-parallel traffic on DCN
+                logger.warning(
+                    "dp=%d not divisible by %d slices; letting "
+                    "create_device_mesh choose the layout (some model-"
+                    "parallel collectives will cross DCN)", shape[0], n_slices,
+                )
             return mesh_utils.create_device_mesh(tuple(shape), devices=devices)
         except Exception as e:  # pragma: no cover - topology helpers can be picky
-            logger.warning("mesh_utils.create_device_mesh failed (%s); falling back to reshape", e)
+            logger.warning("mesh_utils device-mesh construction failed (%s); falling back to reshape", e)
     return np.asarray(devices).reshape(tuple(shape))
 
 

@@ -87,7 +87,9 @@ def _sample_logits(logits, rng, temperature, top_k=0, top_p=1.0):
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep_sorted = (cum - probs) < top_p  # always keeps >= 1 token
-    cutoff = jnp.max(jnp.where(keep_sorted, sorted_logits, neg), axis=-1,
+    # the cutoff is the SMALLEST kept logit: everything >= it is in the
+    # nucleus (a max here would keep only the argmax — greedy in disguise)
+    cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
                      keepdims=True)
     logits = jnp.where((top_p < 1.0) & (logits < cutoff), neg, logits)
     sampled = jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -157,3 +157,19 @@ def test_top_k_top_p_sampling(served):
     p_tiny = model.generate(prompt, max_new_tokens=5, temperature=1.0,
                             rng=jax.random.PRNGKey(1), top_p=1e-6)
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p_tiny))
+
+
+def test_top_p_keeps_the_nucleus():
+    """top_p must sample from the WHOLE nucleus, not degenerate to greedy
+    (regression: a max-cutoff bug made every top_p request greedy)."""
+    from neuronx_distributed_tpu.trace.engine import _sample_logits
+
+    probs = np.array([0.5, 0.3, 0.15, 0.05], np.float32)
+    logits = jnp.asarray(np.log(probs))[None, :]
+    seen = set()
+    for s in range(64):
+        tok = _sample_logits(logits, jax.random.PRNGKey(s), 1.0, 0, 0.9)
+        seen.add(int(tok[0]))
+    # nucleus at p=0.9 = {0, 1, 2}; token 3 excluded; more than one sampled
+    assert seen <= {0, 1, 2}, seen
+    assert len(seen) >= 2, f"top_p degenerated to deterministic output: {seen}"
