@@ -48,6 +48,8 @@ def parse_args():
     p.add_argument("--no-zero1", action="store_true", help="disable ZeRO-1 state sharding")
     p.add_argument("--attention", default="dense", choices=["dense", "flash"])
     p.add_argument("--remat", default="selective", choices=["none", "selective", "full"])
+    p.add_argument("--scan-layers", action="store_true",
+                   help="lax.scan over the layer stack (constant compile time in depth)")
     p.add_argument("--batch-size", type=int, default=8, help="global batch size")
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--steps", type=int, default=100)
@@ -123,6 +125,7 @@ def main():
         sequence_parallel=not args.no_sp,
         attention_impl=args.attention,
         remat=args.remat,
+        scan_layers=args.scan_layers,
         dtype=config.jnp_compute_dtype,
         param_dtype=config.jnp_param_dtype,
     )

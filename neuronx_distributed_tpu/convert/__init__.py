@@ -8,4 +8,6 @@ from neuronx_distributed_tpu.convert.hf import (  # noqa: F401
     gpt_neox_params_to_hf,
     llama_params_from_hf,
     llama_params_to_hf,
+    llama_stack_layers,
+    llama_unstack_layers,
 )

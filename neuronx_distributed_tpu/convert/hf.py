@@ -32,6 +32,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Mapping
 
+import jax
 import numpy as np
 
 
@@ -46,9 +47,35 @@ def _np(x) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def llama_stack_layers(params: Mapping[str, Any], num_layers: int) -> Dict[str, Any]:
+    """Per-layer tree (``model.layer_i...``) → scanned layout
+    (``model.layers...`` with leading ``[L]`` axes) for
+    ``LlamaConfig(scan_layers=True)`` models."""
+    tree = params.get("params", params)
+    model = dict(tree["model"])
+    layers = [model.pop(f"layer_{i}") for i in range(num_layers)]
+    model["layers"] = jax.tree.map(lambda *xs: np.stack([_np(x) for x in xs]), *layers)
+    out = dict(tree)
+    out["model"] = model
+    return {"params": out} if "params" in params else out
+
+
+def llama_unstack_layers(params: Mapping[str, Any], num_layers: int) -> Dict[str, Any]:
+    """Inverse of :func:`llama_stack_layers`."""
+    tree = params.get("params", params)
+    model = dict(tree["model"])
+    stacked = model.pop("layers")
+    for i in range(num_layers):
+        model[f"layer_{i}"] = jax.tree.map(lambda x, i=i: _np(x)[i], stacked)
+    out = dict(tree)
+    out["model"] = model
+    return {"params": out} if "params" in params else out
+
+
 def llama_params_from_hf(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
     """HF ``LlamaForCausalLM.state_dict()`` → framework param tree for
-    :class:`~..models.llama.LlamaForCausalLM` with config ``cfg``."""
+    :class:`~..models.llama.LlamaForCausalLM` with config ``cfg`` (scanned
+    layout when ``cfg.scan_layers``)."""
     sd = {k: _np(v) for k, v in state_dict.items()}
     H, D = cfg.hidden_size, cfg.head_dim_
     NQ, NKV, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
@@ -83,11 +110,16 @@ def llama_params_from_hf(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
     lm_head = sd.get("lm_head.weight")
     if lm_head is None:  # tied-embedding HF checkpoints omit it
         lm_head = sd["model.embed_tokens.weight"]
-    return {"params": {"model": model, "lm_head": {"kernel": lm_head.T}}}
+    out = {"params": {"model": model, "lm_head": {"kernel": lm_head.T}}}
+    if getattr(cfg, "scan_layers", False):
+        out = llama_stack_layers(out, cfg.num_layers)
+    return out
 
 
 def llama_params_to_hf(params: Mapping[str, Any], cfg) -> Dict[str, np.ndarray]:
     """Inverse of :func:`llama_params_from_hf` (framework → HF state dict)."""
+    if getattr(cfg, "scan_layers", False):
+        params = llama_unstack_layers(params, cfg.num_layers)
     tree = params.get("params", params)
     model, head = tree["model"], tree["lm_head"]
     H = cfg.hidden_size

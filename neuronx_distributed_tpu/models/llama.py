@@ -74,6 +74,11 @@ class LlamaConfig:
     # causal-load-balanced cp layout: ids/positions must be fed in
     # ops.zigzag_permute order (labels/loss are permutation-invariant)
     cp_zigzag: bool = False
+    # lax.scan over the layer stack (the standard JAX deep-LLM pattern):
+    # params carry a leading [L] axis and the whole decoder traces ONE block,
+    # so compile time and jaxpr size stop growing with depth.  Training path
+    # only (cached decode keeps per-layer cache plumbing).
+    scan_layers: bool = False
     # Mixture-of-Experts (Mixtral-style; capability beyond the reference,
     # which has no EP at all — SURVEY §2.10): num_experts > 1 replaces every
     # block's MLP with an expert-parallel routed FFN over the ep mesh axis.
@@ -338,16 +343,39 @@ class LlamaModel(nn.Module):
 
         block_cls = maybe_remat(LlamaBlock, cfg.remat)
 
-        new_caches = []
-        for i in range(cfg.num_layers):
-            cache = kv_caches[i] if kv_caches is not None else None
-            if kv_caches is not None:
-                h, c = LlamaBlock(cfg, name=f"layer_{i}")(
-                    h, positions, cache, cache_offset, kv_valid, segment_ids)
-            else:
-                h, c = block_cls(cfg, name=f"layer_{i}")(
-                    h, positions, None, 0, kv_valid, segment_ids)
-            new_caches.append(c)
+        if cfg.scan_layers and kv_caches is not None:
+            raise ValueError(
+                "scan_layers models have a stacked param tree and no cached-"
+                "decode path; for serving, convert the checkpoint with "
+                "convert.llama_unstack_layers and rebuild with "
+                "scan_layers=False"
+            )
+        if cfg.scan_layers:
+            # one traced block, scanned over a stacked [L, ...] param tree —
+            # compile time/jaxpr size independent of depth; the stacked axis
+            # is unsharded (the PP engine has its own stacked/pp-sharded form)
+            scan_cls = nn.scan(
+                block_cls,
+                variable_axes={"params": 0, "losses": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                in_axes=(nn.broadcast,) * 5,
+                metadata_params={nn.meta.PARTITION_NAME: None},
+            )
+            h, _ = scan_cls(cfg, name="layers")(
+                h, positions, None, 0, kv_valid, segment_ids
+            )
+        else:
+            new_caches = []
+            for i in range(cfg.num_layers):
+                cache = kv_caches[i] if kv_caches is not None else None
+                if kv_caches is not None:
+                    h, c = LlamaBlock(cfg, name=f"layer_{i}")(
+                        h, positions, cache, cache_offset, kv_valid, segment_ids)
+                else:
+                    h, c = block_cls(cfg, name=f"layer_{i}")(
+                        h, positions, None, 0, kv_valid, segment_ids)
+                new_caches.append(c)
         h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="final_norm")(h)
         return (h, new_caches) if kv_caches is not None else (h, None)
 

@@ -18,6 +18,7 @@ from neuronx_distributed_tpu.trainer.trainer import (
     default_batch_spec,
     initialize_parallel_model,
     initialize_parallel_optimizer,
+    make_eval_step,
     make_pipelined_train_step,
     make_train_step,
 )
@@ -29,6 +30,7 @@ __all__ = [
     "initialize_parallel_optimizer",
     "make_train_step",
     "make_pipelined_train_step",
+    "make_eval_step",
     "default_batch_spec",
     "save_checkpoint",
     "load_checkpoint",

@@ -409,6 +409,46 @@ def make_pipelined_train_step(
     )
 
 
+def make_eval_step(
+    config: TrainingConfig,
+    model: "ParallelModel | Any",
+    loss_fn: Optional[Callable[..., Any]] = None,
+    batch_spec: Optional[Any] = None,
+):
+    """Jitted loss-only step (no grads, no optimizer) for validation loops —
+    the reference's ``run_eval`` counterpart (``trainer/model.py:30-39``).
+    Pipelined models use their built-in schedule loss."""
+    from neuronx_distributed_tpu.pipeline.engine import PipelinedModel
+
+    mesh = model.mesh
+    if isinstance(model, PipelinedModel):
+        def _eval(params, batch):
+            loss_sum, tok = model.loss_fn(params, batch["ids"], batch["labels"])
+            return {"loss": loss_sum / jnp.maximum(tok, 1.0)}
+
+        batch_shardings = {
+            "ids": NamedSharding(mesh, P(BATCH_AXES)),
+            "labels": NamedSharding(mesh, P(BATCH_AXES)),
+        }
+        return jax.jit(_eval, in_shardings=(model.param_shardings, batch_shardings),
+                       out_shardings=None)
+
+    if loss_fn is None:
+        raise ValueError("loss_fn is required for non-pipelined models")
+
+    def _eval(params, batch):
+        return {"loss": loss_fn(model.module, params, batch, None)}
+
+    batch_shardings = (
+        jax.tree.map(lambda s: NamedSharding(mesh, s), batch_spec,
+                     is_leaf=lambda x: isinstance(x, P))
+        if batch_spec is not None
+        else None
+    )
+    return jax.jit(_eval, in_shardings=(model.param_shardings, batch_shardings),
+                   out_shardings=None)
+
+
 def default_batch_spec() -> P:
     """Batch arrays sharded over the data-parallel axes on dim 0."""
     return P(BATCH_AXES)

@@ -227,3 +227,60 @@ def test_packed_training_via_loss_batch_keys(devices8):
         params, state, m = step(params, state, batch, jax.random.PRNGKey(i))
         losses.append(float(m["loss"]))
     assert np.isfinite(losses).all() and losses[-1] < losses[0] - 0.3, losses
+
+
+def test_scan_layers_matches_unrolled(devices8):
+    """lax.scan-over-layers (scan_layers=True) is the same function as the
+    unrolled stack — logits parity on shared weights, and HF conversion
+    handles the stacked layout."""
+    import transformers
+    import torch
+    from neuronx_distributed_tpu.convert import llama_params_from_hf
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=3,
+        num_attention_heads=8, num_key_value_heads=2, max_position_embeddings=64,
+        rms_norm_eps=1e-5, tie_word_embeddings=False)
+    torch.manual_seed(7)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval().float()
+    ids = jnp.asarray(torch.randint(0, 128, (2, 16)).numpy())
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2, devices=devices8)
+    base = dict(vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=3,
+                num_heads=8, num_kv_heads=2, max_seq_len=64, rms_eps=1e-5,
+                sequence_parallel=False, remat="none",
+                dtype=jnp.float32, param_dtype=jnp.float32)
+    cfg_u = LlamaConfig(**base)
+    cfg_s = LlamaConfig(**base, scan_layers=True)
+    p_u = jax.tree.map(jnp.asarray, llama_params_from_hf(hf.state_dict(), cfg_u))
+    p_s = jax.tree.map(jnp.asarray, llama_params_from_hf(hf.state_dict(), cfg_s))
+    # scanned tree carries one stacked [L, ...] subtree
+    assert p_s["params"]["model"]["layers"]["attn"]["qkv"]["q_kernel"].shape[0] == 3
+
+    out_u = jax.jit(lambda p, i: LlamaForCausalLM(cfg_u).apply(p, i))(p_u, ids)
+    out_s = jax.jit(lambda p, i: LlamaForCausalLM(cfg_s).apply(p, i))(p_s, ids)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_u),
+                               rtol=2e-5, atol=2e-5)
+
+    # and it trains: init native scanned params, loss decreases
+    from neuronx_distributed_tpu.trainer import (
+        default_batch_spec, initialize_parallel_model,
+        initialize_parallel_optimizer, make_train_step)
+    from neuronx_distributed_tpu.models.llama import causal_lm_loss
+
+    config = nxd.training_config(tensor_parallel_size=2, learning_rate=3e-3,
+                                 compute_dtype="float32")
+    model = initialize_parallel_model(
+        config, lambda: LlamaForCausalLM(cfg_s), (jnp.zeros((1, 16), jnp.int32),))
+    opt = initialize_parallel_optimizer(config, model)
+    step = make_train_step(config, model, opt, causal_lm_loss,
+                           batch_spec={"ids": default_batch_spec(),
+                                       "labels": default_batch_spec()})
+    data = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 128)
+    batch = {"ids": data, "labels": jnp.roll(data, -1, 1)}
+    params, state = model.params, opt.state
+    losses = []
+    for i in range(6):
+        params, state, m = step(params, state, batch, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.2, losses

@@ -155,3 +155,20 @@ def test_grad_accumulation_matches_full_batch(config):
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                    atol=1e-6, err_msg=jax.tree_util.keystr(k1_))
+
+
+def test_eval_step_matches_loss(config):
+    """make_eval_step computes the same loss the train step reports, without
+    touching params (the reference's run_eval counterpart)."""
+    from neuronx_distributed_tpu.trainer import make_eval_step
+
+    model = initialize_parallel_model(config, TinyLM, (jnp.zeros((1, 8), jnp.int32),))
+    opt = initialize_parallel_optimizer(config, model)
+    bs = {"ids": default_batch_spec(), "labels": default_batch_spec()}
+    step = make_train_step(config, model, opt, lm_loss, batch_spec=bs)
+    ev = make_eval_step(config, model, lm_loss, batch_spec=bs)
+    batch = _data(jax.random.PRNGKey(0))
+    m_eval = ev(model.params, batch)
+    _, _, m_train = step(jax.tree.map(jnp.copy, model.params),
+                         jax.tree.map(jnp.copy, opt.state), batch, None)
+    assert float(m_eval["loss"]) == pytest.approx(float(m_train["loss"]), rel=1e-6)
