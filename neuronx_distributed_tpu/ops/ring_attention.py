@@ -302,7 +302,7 @@ def ring_attention(
     new_manual = frozenset(a for a in MESH_AXES if a not in ambient)
     batch_axes = tuple(a for a in BATCH_AXES if a in new_manual)
     head_axes = tuple(a for a in (TENSOR_AXIS, KV_REPLICA_AXIS) if a in new_manual)
-    kv_head_axes = tuple(a for a in (TENSOR_AXIS,) if a in new_manual)
+    kv_head_axes = (TENSOR_AXIS,) if TENSOR_AXIS in new_manual else ()
     seq_axes = CONTEXT_AXIS if CONTEXT_AXIS in new_manual else None
 
     if S % cp != 0:

@@ -234,7 +234,7 @@ class _ServingBase:
                 nxt = _sample_logits(logits, rng_i, temperature, top_k, top_p)[:, None]
                 return (nxt, offset + 1, caches, valid), nxt[:, 0]
 
-            (_, _, _, _), toks = jax.lax.scan(
+            _, toks = jax.lax.scan(
                 step, (first_tok, start, caches, valid), rngs, length=n
             )
             return toks.T  # [B, n]
@@ -285,8 +285,11 @@ class _ServingBase:
 
         n_more = max_new_tokens - 1
         if fused:
+            # one vmapped fold_in (not n host dispatches); indices 1..n match
+            # the stepped path's per-step fold_in exactly (parity-tested)
             rngs = (
-                jnp.stack([jax.random.fold_in(rng, 1 + i) for i in range(n_more)])
+                jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+                    jnp.arange(1, n_more + 1))
                 if rng is not None
                 else jnp.zeros((n_more, 2), jnp.uint32)
             )

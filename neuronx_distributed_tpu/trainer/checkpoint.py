@@ -47,16 +47,13 @@ import orbax.checkpoint as ocp
 from jax.experimental import multihost_utils
 from jax.sharding import NamedSharding
 
+from neuronx_distributed_tpu.utils.distributed import is_primary as _is_primary
 from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
 _NEWEST = "newest"
 _DONE = ".done"
-
-
-def _is_primary() -> bool:
-    return jax.process_index() == 0
 
 
 def _barrier(name: str) -> None:

@@ -34,9 +34,9 @@ logger = get_logger(__name__)
 def is_designated_writer() -> bool:
     """True on the single process that should emit scalar streams
     (reference gate: dp0/tp0/last-pp rank, ``lightning/logger.py:128-136``)."""
-    import jax
+    from neuronx_distributed_tpu.utils.distributed import is_primary
 
-    return jax.process_index() == 0
+    return is_primary()
 
 
 class ScalarWriter:

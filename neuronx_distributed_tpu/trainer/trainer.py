@@ -232,6 +232,13 @@ def initialize_parallel_optimizer(
     return ParallelOptimizer(tx=tx, state=state, state_specs=state_specs, mesh=model.mesh)
 
 
+def _batch_shardings(mesh: Mesh, batch_spec: Any):
+    if batch_spec is None:
+        return None
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), batch_spec,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
 def make_train_step(
     config: TrainingConfig,
     model: "ParallelModel | Any",
@@ -338,12 +345,7 @@ def make_train_step(
         metrics = {"loss": loss, "grad_norm": grad_norm}
         return params, opt_state, metrics
 
-    batch_shardings = (
-        jax.tree.map(lambda s: NamedSharding(mesh, s), batch_spec,
-                     is_leaf=lambda x: isinstance(x, P))
-        if batch_spec is not None
-        else None
-    )
+    batch_shardings = _batch_shardings(mesh, batch_spec)
     in_shardings = (param_shardings, state_shardings, batch_shardings, None)
     out_shardings = (param_shardings, state_shardings, None)
     return jax.jit(
@@ -439,13 +441,8 @@ def make_eval_step(
     def _eval(params, batch):
         return {"loss": loss_fn(model.module, params, batch, None)}
 
-    batch_shardings = (
-        jax.tree.map(lambda s: NamedSharding(mesh, s), batch_spec,
-                     is_leaf=lambda x: isinstance(x, P))
-        if batch_spec is not None
-        else None
-    )
-    return jax.jit(_eval, in_shardings=(model.param_shardings, batch_shardings),
+    return jax.jit(_eval, in_shardings=(model.param_shardings,
+                                        _batch_shardings(mesh, batch_spec)),
                    out_shardings=None)
 
 
