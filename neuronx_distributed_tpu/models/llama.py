@@ -74,6 +74,11 @@ class LlamaConfig:
     # causal-load-balanced cp layout: ids/positions must be fed in
     # ops.zigzag_permute order (labels/loss are permutation-invariant)
     cp_zigzag: bool = False
+    # context-parallel decomposition under the flash path: "ring" rotates KV
+    # around the cp axis (arbitrary cp); "ulysses" all-to-alls seq<->heads so
+    # each device runs full-sequence attention on a head subset (cp bounded
+    # by per-shard q-head count, communication independent of cp degree)
+    cp_impl: str = "ring"
     # lax.scan over the layer stack (the standard JAX deep-LLM pattern):
     # params carry a leading [L] axis and the whole decoder traces ONE block,
     # so compile time and jaxpr size stop growing with depth.  Training path
@@ -172,6 +177,7 @@ class CoreAttention(nn.Module):
             return ring_attention(
                 q, k, v, causal=True,
                 layout="zigzag" if cfg.cp_zigzag else "contiguous",
+                cp_impl=cfg.cp_impl,
             )
         B, S, NQ, D = q.shape
         T = k.shape[1]
@@ -451,17 +457,6 @@ def build_pipelined_llama(
     import neuronx_distributed_tpu.pipeline.engine as engine
     from neuronx_distributed_tpu.parallel.mesh import get_mesh
 
-    if cfg.num_experts > 1:
-        # The engine's block_fn has no channel for the sown load-balancing
-        # aux loss; silently training a router without balancing pressure is
-        # worse than refusing (flax sow into a non-mutable collection is a
-        # no-op, so the loss would just vanish).
-        raise NotImplementedError(
-            "MoE (num_experts > 1) under pipeline parallelism is not yet "
-            "supported: the 1F1B engine does not collect the router's "
-            "load-balancing aux loss; use pp=1 (dp/ep/tp/cp compose freely)"
-        )
-
     mesh = get_mesh()
     embed_mod = ParallelEmbedding(
         num_embeddings=cfg.vocab_size,
@@ -472,14 +467,34 @@ def build_pipelined_llama(
     )
     block_mod = LlamaBlock(cfg)
     head_mod = LlamaHead(cfg)
+    moe = cfg.num_experts > 1
 
     def embed_fn(ep, ids):
         return embed_mod.apply({"params": ep}, ids)
 
-    def block_fn(lp, x):
-        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
-        y, _ = block_mod.apply({"params": lp}, x, positions)
-        return y
+    if moe:
+        # MoE block: hand the sown load-balancing term to the engine's aux
+        # channel (coefficient folded here so the engine's layer-mean
+        # normalization reproduces causal_lm_loss's
+        # ``MOE_AUX_COEF * mean(aux)``).  Note that inside the engine's
+        # manual (dp, ep, pp) shard_map the ep axis degenerates to data
+        # parallelism: expert weights are replicated per stage and routing
+        # is per-rank-local (parallel/moe._auto_spec).
+        from neuronx_distributed_tpu.models.common import MOE_AUX_COEF
+
+        def block_fn(lp, x):
+            positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+            (y, _), variables = block_mod.apply(
+                {"params": lp}, x, positions, mutable=["losses"]
+            )
+            terms = jax.tree.leaves(variables.get("losses", {}))
+            aux = MOE_AUX_COEF * jnp.sum(jnp.stack(terms)) if terms else jnp.zeros(())
+            return y, aux
+    else:
+        def block_fn(lp, x):
+            positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+            y, _ = block_mod.apply({"params": lp}, x, positions)
+            return y
 
     def head_fn(hp, h):
         return head_mod.apply({"params": hp}, h)
@@ -523,6 +538,7 @@ def build_pipelined_llama(
             if cfg.sequence_parallel
             else None
         ),
+        block_aux=moe,
     )
 
 

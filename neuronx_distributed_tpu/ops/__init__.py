@@ -9,6 +9,7 @@ from neuronx_distributed_tpu.ops.flash_attention import (
 )
 from neuronx_distributed_tpu.ops.ring_attention import (
     ring_attention,
+    ulysses_attention,
     zigzag_permute,
     zigzag_unpermute,
 )
@@ -18,6 +19,7 @@ __all__ = [
     "flash_attention_with_lse",
     "mha_reference",
     "ring_attention",
+    "ulysses_attention",
     "zigzag_permute",
     "zigzag_unpermute",
 ]

@@ -51,42 +51,12 @@ from neuronx_distributed_tpu.parallel.mesh import (
     KV_REPLICA_AXIS,
     MESH_AXES,
     TENSOR_AXIS,
+    ambient_manual_axes as _ambient_manual_axes,
     get_mesh,
 )
 from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
-_AXIS_ENV_WARNED = False
-
-
-def _ambient_manual_axes() -> frozenset:
-    """Mesh axes already manual in the enclosing trace context.
-
-    Inside a ``shard_map`` body the manual axes are bound in JAX's axis
-    environment (that's what makes ``lax.psum(x, 'dp')`` legal there), so the
-    environment tells us which axes an enclosing shard_map — e.g. the 1F1B
-    engine's manual ``(dp, ep, pp)`` — already owns.  The shard_map built
-    here must go manual over exactly the *rest*: Mosaic kernels refuse to be
-    auto-partitioned, so every mesh axis has to be manual by the time the
-    pallas call lowers, but re-declaring an already-manual axis is an error.
-    """
-    try:
-        from jax._src.core import get_axis_env
-
-        return frozenset(get_axis_env().axis_sizes) & frozenset(MESH_AXES)
-    except Exception as e:  # pragma: no cover - internals moved in a JAX bump
-        # Loud, not fatal: top-level calls still work with the empty set, but
-        # a nested call (inside the 1F1B engine) would re-declare the outer
-        # manual axes and fail — surface the real cause in the log.
-        global _AXIS_ENV_WARNED
-        if not _AXIS_ENV_WARNED:
-            _AXIS_ENV_WARNED = True
-            logger.warning(
-                "jax._src.core.get_axis_env unavailable (%s): cannot detect "
-                "enclosing shard_map manual axes; ring/flash attention inside "
-                "the pipeline engine may fail to trace on this JAX version", e,
-            )
-        return frozenset()
 
 
 def _dense_chunk_attn(q, k, v, causal: bool, sm_scale: float) -> Tuple[jax.Array, jax.Array]:
@@ -257,6 +227,65 @@ def _ring_shard_zigzag(
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Ulysses-style all-to-all context parallelism
+# ---------------------------------------------------------------------------
+#
+# The other classic long-context decomposition (DeepSpeed-Ulysses): instead of
+# rotating KV around a ring, one all-to-all re-shards activations from
+# sequence-sharded to head-sharded over ``cp`` — each device then holds a
+# subset of heads with the FULL sequence, runs plain causal flash attention
+# (no chunk-granular masking, no lse combine), and a second all-to-all
+# restores sequence sharding.  Trade-offs vs the ring:
+#
+# - communication is 2 all-to-alls of q/k/v/o activations (volume independent
+#   of cp) vs (cp-1) ppermutes of the KV pair — cheaper at high cp when heads
+#   are plentiful, and the attention itself is the unmodified kernel;
+# - cp is bounded by the per-shard head count (heads-per-tp-shard % cp == 0),
+#   while the ring scales to arbitrary cp;
+# - causal balance is perfect for free (every device sees the full sequence)
+#   where the contiguous ring wastes masked work unless zigzag is used.
+
+
+def _ulysses_shard(
+    q, k, v, *, cp: int, causal: bool, sm_scale: float, use_flash: bool,
+    block_q: int, block_k: int, interpret: Optional[bool],
+):
+    """Per-shard body; local kernel layout q ``[B, HQ_l, S/cp, D]``,
+    k/v ``[B, HKV_l, S/cp, D]``."""
+
+    def chunk(qc, kc, vc):
+        if use_flash:
+            o, _ = flash_attention_with_lse(
+                qc, kc, vc, causal, sm_scale, block_q, block_k, interpret
+            )
+            return o
+        o, _ = _dense_chunk_attn(qc, kc, vc, causal, sm_scale)
+        return o
+
+    if cp == 1:
+        return chunk(q, k, v)
+
+    HQ, HKV = q.shape[1], k.shape[1]
+    # head-scatter / seq-gather: [B, H, S/cp, D] -> [B, H/cp, S, D]
+    qg = jax.lax.all_to_all(q, CONTEXT_AXIS, split_axis=1, concat_axis=2, tiled=True)
+    if HKV % cp == 0:
+        kg = jax.lax.all_to_all(k, CONTEXT_AXIS, split_axis=1, concat_axis=2, tiled=True)
+        vg = jax.lax.all_to_all(v, CONTEXT_AXIS, split_axis=1, concat_axis=2, tiled=True)
+    else:
+        # Too few local kv heads to split over cp: expand to q-head count
+        # first (G-fold repeat keeps the kernel's h//G indexing aligned with
+        # the q-head chunks; costs G x kv a2a volume, never wrong).
+        G = HQ // HKV
+        kg = jax.lax.all_to_all(
+            jnp.repeat(k, G, axis=1), CONTEXT_AXIS, split_axis=1, concat_axis=2, tiled=True)
+        vg = jax.lax.all_to_all(
+            jnp.repeat(v, G, axis=1), CONTEXT_AXIS, split_axis=1, concat_axis=2, tiled=True)
+    o = chunk(qg, kg, vg)
+    # inverse: seq-scatter / head-gather back to [B, HQ_l, S/cp, D]
+    return jax.lax.all_to_all(o, CONTEXT_AXIS, split_axis=2, concat_axis=1, tiled=True)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -268,6 +297,7 @@ def ring_attention(
     block_k: int = 512,
     interpret: Optional[bool] = None,
     layout: str = "contiguous",
+    cp_impl: str = "ring",
 ) -> jax.Array:
     """Context-parallel attention in model layout: ``q [B, S, NQ, D]``,
     ``k/v [B, S, NKV, D]`` (``NQ`` a multiple of ``NKV``), sequence dim
@@ -285,6 +315,11 @@ def ring_attention(
     already in :func:`zigzag_permute` order (pair (i, 2cp-1-i) per shard),
     causal only, perfectly load-balanced with zero masked-out compute.  The
     output stays in the input's layout.
+
+    ``cp_impl``: ``"ring"`` — KV rotates around the cp ring (arbitrary cp);
+    ``"ulysses"`` — all-to-all re-shards seq→heads so each device runs plain
+    full-sequence attention on a head subset (cp bounded by per-shard q-head
+    count; contiguous layout only).
     """
     mesh = get_mesh()
     cp = mesh.shape[CONTEXT_AXIS]
@@ -322,6 +357,21 @@ def ring_attention(
         batch_axes = ()
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
+    if cp_impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown cp_impl {cp_impl!r}")
+    if cp_impl == "ulysses":
+        if layout == "zigzag" and cp > 1:
+            raise ValueError(
+                "zigzag layout is a ring-schedule optimization; ulysses sees "
+                "the full sequence per device and needs no load balancing"
+            )
+        hq_local = NQ // math.prod(mesh.shape[a] for a in (TENSOR_AXIS, KV_REPLICA_AXIS))
+        if cp > 1 and hq_local % cp != 0:
+            raise ValueError(
+                f"ulysses cp={cp} needs the per-shard q-head count "
+                f"({hq_local}) divisible by cp; use cp_impl='ring' for "
+                f"head-starved configs"
+            )
     if layout == "zigzag":
         if not causal:
             raise ValueError("zigzag layout is a causal-only optimization")
@@ -338,7 +388,14 @@ def ring_attention(
     q_spec = P(batch_axes or None, head_axes or None, seq_axes, None)
     kv_spec = P(batch_axes or None, kv_head_axes or None, seq_axes, None)
 
-    if layout == "zigzag":
+    if cp_impl == "ulysses":
+        def body(qs, ks, vs):
+            return _ulysses_shard(
+                qs, ks, vs, cp=cp, causal=causal, sm_scale=scale,
+                use_flash=use_flash, block_q=block_q, block_k=block_k,
+                interpret=interpret,
+            )
+    elif layout == "zigzag":
         def body(qs, ks, vs):
             return _ring_shard_zigzag(
                 qs, ks, vs, cp=cp, sm_scale=scale, use_flash=use_flash,
@@ -364,3 +421,9 @@ def ring_attention(
         check_vma=False,
     )(qt, kt, vt)
     return o.transpose(0, 2, 1, 3)
+
+
+def ulysses_attention(q, k, v, causal: bool = True, **kwargs) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) context-parallel attention —
+    :func:`ring_attention` with ``cp_impl="ulysses"``; same model layout."""
+    return ring_attention(q, k, v, causal=causal, cp_impl="ulysses", **kwargs)

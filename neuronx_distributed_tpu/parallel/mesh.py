@@ -322,6 +322,56 @@ def named_sharding(*spec) -> NamedSharding:
     return NamedSharding(get_mesh(), P(*spec))
 
 
+def strip_axes_from_spec(spec: P, drop: frozenset) -> P:
+    """Remove the given mesh axes from a PartitionSpec (tuple entries keep
+    their remaining axes; emptied entries become None)."""
+
+    def strip(e):
+        if e is None:
+            return None
+        if isinstance(e, (tuple, list)):
+            kept = tuple(a for a in e if a not in drop)
+            return kept or None
+        return None if e in drop else e
+
+    return P(*(strip(e) for e in spec))
+
+
+_AXIS_ENV_WARNED = False
+
+
+def ambient_manual_axes() -> frozenset:
+    """Mesh axes already *manual* in the enclosing trace context.
+
+    Inside a ``shard_map`` body the manual axes are bound in JAX's axis
+    environment (that's what makes ``lax.psum(x, 'dp')`` legal there), so the
+    environment reveals which axes an enclosing shard_map — e.g. the 1F1B
+    engine's manual ``(dp, ep, pp)`` — already owns.  Two consumers need
+    this: a nested shard_map must go manual over exactly the *rest* (Mosaic
+    kernels refuse Auto axes; re-declaring an already-manual axis is an
+    error — ring/flash attention), and GSPMD sharding constraints inside the
+    body may only reference the remaining *auto* axes (MoE expert specs).
+    """
+    try:
+        from jax._src.core import get_axis_env
+
+        return frozenset(get_axis_env().axis_sizes) & frozenset(MESH_AXES)
+    except Exception as e:  # pragma: no cover - internals moved in a JAX bump
+        # Loud, not fatal: top-level callers still work with the empty set,
+        # but nested use (inside the 1F1B engine) would re-declare or
+        # re-constrain the outer manual axes and fail — log the real cause.
+        global _AXIS_ENV_WARNED
+        if not _AXIS_ENV_WARNED:
+            _AXIS_ENV_WARNED = True
+            logger.warning(
+                "jax._src.core.get_axis_env unavailable (%s): cannot detect "
+                "enclosing shard_map manual axes; flash/ring attention or MoE "
+                "inside the pipeline engine may fail to trace on this JAX "
+                "version", e,
+            )
+        return frozenset()
+
+
 def rmsg(msg: str) -> str:
     """Rank-annotated log message (reference: ``parallel_state.py:394-406``).
 

@@ -39,8 +39,22 @@ from neuronx_distributed_tpu.parallel.mesh import (
     BATCH_AXES,
     EXPERT_AXIS,
     TENSOR_AXES,
+    ambient_manual_axes,
+    strip_axes_from_spec,
 )
 from jax.sharding import PartitionSpec as P
+
+
+def _auto_spec(*entries) -> P:
+    """PartitionSpec with any ambient-*manual* mesh axes removed.
+
+    Inside the 1F1B engine's partial-manual shard_map (manual ``dp/ep/pp``)
+    GSPMD sharding constraints may only reference the remaining auto axes;
+    a manual axis in a constraint is an error.  Dropping it is also the
+    semantically right thing: under the engine the batch is already split
+    per (dp, ep) rank, so ``ep`` degenerates to pure data parallelism and
+    expert weights are simply replicated within the stage."""
+    return strip_axes_from_spec(P(*entries), ambient_manual_axes())
 
 Dtype = Any
 Initializer = Callable[..., jax.Array]
@@ -137,22 +151,22 @@ class ExpertParallelMLP(nn.Module):
             preferred_element_type=self.dtype,
         )
         # expert-major layout: experts over ep, tokens replicated within
-        xe = shard_activation(xe, P(EXPERT_AXIS, None, None))
+        xe = shard_activation(xe, _auto_spec(EXPERT_AXIS, None, None))
 
         def ffn(x_e, wi_e, wo_e):
             gu = jnp.einsum("ch,hfi->cfi", x_e, wi_e.astype(self.dtype),
                             preferred_element_type=self.dtype)
             h = jax.nn.silu(gu[:, 0, :]) * gu[:, 1, :]
-            h = shard_activation(h, P(None, TENSOR_AXES))
+            h = shard_activation(h, _auto_spec(None, TENSOR_AXES))
             return jnp.einsum("ci,ih->ch", h, wo_e.astype(self.dtype),
                               preferred_element_type=self.dtype)
 
         ye = jax.vmap(ffn)(xe, jnp.asarray(wi), jnp.asarray(wo))  # [E, C, H]
-        ye = shard_activation(ye, P(EXPERT_AXIS, None, None))
+        ye = shard_activation(ye, _auto_spec(EXPERT_AXIS, None, None))
 
         y = jnp.einsum(
             "ech,nec->nh", ye, combine.astype(self.dtype),
             preferred_element_type=self.dtype,
         )
-        y = shard_activation(y, P(BATCH_AXES, None))
+        y = shard_activation(y, _auto_spec(BATCH_AXES, None))
         return y.reshape(*lead, H).astype(self.dtype), aux.astype(jnp.float32)

@@ -68,8 +68,8 @@ LAYERS = "layers"
 HEAD = "head"
 
 
-def _make_stage_fn(blk, layer_mask):
-    """Stage executor: scan the stage's layer rows.
+def _make_stage_fn(blk, layer_mask, block_aux: bool = False):
+    """Stage executor: scan the stage's layer rows; returns ``(x, aux)``.
 
     ``layer_mask`` (``[L']`` of 0/1, or None) marks padded rows added for a
     non-divisible layer count (:func:`..partition.padded_layer_layout`):
@@ -79,14 +79,27 @@ def _make_stage_fn(blk, layer_mask):
     constant, NOT a parameter — it must never reach the optimizer (weight
     decay would erode it) or checkpoints.  Returns a ``stage_fn(stage_rows,
     x)`` operating on this stage's slice of the stack; under the pp
-    shard_map the mask constant is sliced with ``axis_index``."""
+    shard_map the mask constant is sliced with ``axis_index``.
+
+    ``block_aux``: the block returns ``(y, aux_scalar)`` (e.g. a MoE
+    load-balancing term) and ``aux`` is the sum over the stage's live
+    layers; otherwise ``aux`` is a constant 0 (folded away by XLA)."""
+
+    def call(layer_params, h):
+        if block_aux:
+            y, a = blk(layer_params, h)
+            return y, a.astype(jnp.float32)
+        return blk(layer_params, h), jnp.zeros((), jnp.float32)
+
     if layer_mask is None:
         def stage_fn(stage_params, x):
-            def body(h, layer_params):
-                return blk(layer_params, h), None
+            def body(carry, layer_params):
+                h, aux = carry
+                y, a = call(layer_params, h)
+                return (y, aux + a), None
 
-            x, _ = lax.scan(body, x, stage_params)
-            return x
+            (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), stage_params)
+            return x, aux
 
         return stage_fn
 
@@ -100,13 +113,14 @@ def _make_stage_fn(blk, layer_mask):
             rank = lax.axis_index(PIPELINE_AXIS)
             local = lax.dynamic_slice_in_dim(mask_const, rank * L_local, L_local)
 
-        def body(h, xs):
+        def body(carry, xs):
+            h, aux = carry
             layer_params, a = xs
-            y = blk(layer_params, h)
-            return jnp.where(a > 0, y, h), None
+            y, aux_l = call(layer_params, h)
+            return (jnp.where(a > 0, y, h), aux + a * aux_l), None
 
-        x, _ = lax.scan(body, x, (stage_params, local))
-        return x
+        (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), (stage_params, local))
+        return x, aux
 
     return stage_fn
 
@@ -158,12 +172,21 @@ def make_pipelined_loss_fn(
     remat_block: bool = True,
     remat_policy: Optional[Callable] = None,
     layer_mask=None,
+    block_aux: bool = False,
 ):
     """Build ``loss_fn(params, ids, labels) -> (loss_sum, token_count)``.
 
     ``params`` must be ``{EMBED: ..., LAYERS: stacked [L, ...], HEAD: ...}``.
     The returned function is differentiable and jittable; wrap its mean in
     ``jax.value_and_grad`` for training (the trainer does this).
+
+    ``block_aux``: blocks return ``(y, aux)`` and the per-layer aux terms
+    (e.g. MoE load balancing, coefficient already folded in by the caller)
+    are *averaged* over layers × microbatches [× data-parallel ranks] and
+    added to the reported mean loss — i.e. ``loss_sum`` gains
+    ``mean(aux) * token_count`` so the trainer's ``loss_sum / tok``
+    normalization reproduces ``ce_mean + mean(aux)``, matching the non-PP
+    ``causal_lm_loss`` semantics.
     """
     mesh = mesh if mesh is not None else get_mesh()
     pp = mesh.shape[PIPELINE_AXIS]
@@ -172,7 +195,10 @@ def make_pipelined_loss_fn(
     if remat_block:
         blk = jax.checkpoint(block_fn, policy=remat_policy, prevent_cse=False)
 
-    stage_fn = _make_stage_fn(blk, layer_mask)
+    stage_fn = _make_stage_fn(blk, layer_mask, block_aux)
+    n_real_layers = (
+        int(sum(layer_mask)) if layer_mask is not None else None  # else runtime L
+    )
 
     def loss_fn(params, ids: jax.Array, labels: jax.Array):
         """ids/labels: [B, S] global batch."""
@@ -182,15 +208,23 @@ def make_pipelined_loss_fn(
         labels_mb = microbatch(labels, num_microbatches, mesh if pp > 1 else None)
         L = jax.tree.leaves(params[LAYERS])[0].shape[0]
         layers_per_stage(L, pp)  # validate divisibility
+        L_real = n_real_layers if n_real_layers is not None else L
+        M = num_microbatches
 
         if pp == 1:
             # Degenerate case: no pipeline machinery, plain scan over layers.
+            tok_total = jnp.sum((labels >= 0).astype(jnp.float32))
+
             def one_mb(carry, mb):
                 i, l = mb
-                x = stage_fn(params[LAYERS], embed_fn(params[EMBED], i))
+                x, aux = stage_fn(params[LAYERS], embed_fn(params[EMBED], i))
                 ls, n = head_loss_fn(params[HEAD], x, l)
                 s, c = carry
-                return (s + ls, c + n), None
+                # aux: sum over layers for this microbatch; normalize to the
+                # layer x microbatch mean, scaled by tokens so the caller's
+                # /tok division recovers ce_mean + mean(aux)
+                s = s + ls + aux * tok_total / (L_real * M)
+                return (s, c + n), None
 
             (loss_sum, tok), _ = lax.scan(
                 one_mb, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
@@ -198,14 +232,21 @@ def make_pipelined_loss_fn(
             )
             return loss_sum, tok
 
-        M = num_microbatches
         T = M + pp - 1
+        dpsz = mesh.shape[DATA_AXIS] * mesh.shape[EXPERT_AXIS]
 
         def f(layer_stack, embed_params, head_params, ids_mb, labels_mb):
             # layer_stack leaves are the local [L/pp, ...] slice.
             rank = lax.axis_index(PIPELINE_AXIS)
             is_first = rank == 0
             is_last = rank == pp - 1
+            # aux weight: global token count x the layer/microbatch/dp-mean
+            # normalization (each dp rank computed aux on its batch shard;
+            # labels_mb is the local slice, batch replicated along pp)
+            tok_total = lax.psum(
+                jnp.sum((labels_mb >= 0).astype(jnp.float32)), (DATA_AXIS, EXPERT_AXIS)
+            )
+            aux_w = tok_total / (L_real * M * dpsz)
 
             mb_shape = ids_mb.shape[1:]
             probe = jax.eval_shape(embed_fn, embed_params, jnp.zeros(mb_shape, ids_mb.dtype))
@@ -217,7 +258,11 @@ def make_pipelined_loss_fn(
                 x0 = embed_fn(embed_params, ids_t)
                 x_in = jnp.where(is_first, x0, buf)
 
-                y = stage_fn(layer_stack, x_in)
+                y, aux = stage_fn(layer_stack, x_in)
+                # this stage computes microbatch t - rank at tick t; bubble
+                # ticks run on garbage and their aux must not count
+                fwd_valid = jnp.logical_and(t >= rank, t - rank < M)
+                loss_sum = loss_sum + jnp.where(fwd_valid, aux, 0.0) * aux_w
 
                 out_t = t - (pp - 1)
                 lbl = lax.dynamic_index_in_dim(
@@ -239,8 +284,9 @@ def make_pipelined_loss_fn(
                 jnp.zeros((), jnp.float32),
             )
             (_, loss_sum, tok_sum), _ = lax.scan(tick, init, jnp.arange(T))
-            # only the last stage accumulated (and each dp shard saw only
-            # its batch slice); make the result mesh-invariant
+            # only the last stage accumulated ce (and each dp shard saw only
+            # its batch slice); aux accumulated per stage — the pp psum sums
+            # distinct stage contributions, the dp psum is averaged by aux_w
             loss_sum = lax.psum(loss_sum, (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS))
             tok_sum = lax.psum(tok_sum, (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS))
             return loss_sum, tok_sum
@@ -274,6 +320,7 @@ def make_1f1b_loss_and_grad_fn(
     remat_policy: Optional[Callable] = None,
     act_spec: Optional[P] = None,
     layer_mask=None,
+    block_aux: bool = False,
 ):
     """Build ``fn(params, ids, labels) -> ((loss_sum, token_count), grads)``
     running the true 1F1B schedule in one jit — the production PP train path
@@ -333,14 +380,15 @@ def make_1f1b_loss_and_grad_fn(
     if remat_block:
         blk = jax.checkpoint(block_fn, policy=remat_policy, prevent_cse=False)
 
-    stage_fn = _make_stage_fn(blk, layer_mask)
+    stage_fn = _make_stage_fn(blk, layer_mask, block_aux)
+    n_real_layers = int(sum(layer_mask)) if layer_mask is not None else None
 
     if pp == 1:
         # no pipeline: autodiff the plain microbatched loss
         plain = make_pipelined_loss_fn(
             embed_fn, block_fn, head_loss_fn, M, mesh=mesh,
             remat_block=remat_block, remat_policy=remat_policy,
-            layer_mask=layer_mask,
+            layer_mask=layer_mask, block_aux=block_aux,
         )
 
         def loss_and_grad_pp1(params, ids, labels):
@@ -373,10 +421,18 @@ def make_1f1b_loss_and_grad_fn(
         L = jax.tree.leaves(params[LAYERS])[0].shape[0]
         layers_per_stage(L, pp)  # validate divisibility
 
+        L_real = n_real_layers if n_real_layers is not None else L
+        dpsz = mesh.shape[DATA_AXIS] * mesh.shape[EXPERT_AXIS]
+
         def f(layer_stack, embed_params, head_params, ids_mb, labels_mb):
             rank = lax.axis_index(PIPELINE_AXIS)
             is_first = rank == 0
             is_last = rank == pp - 1
+            # MoE-style aux normalization — see make_pipelined_loss_fn
+            tok_total = lax.psum(
+                jnp.sum((labels_mb >= 0).astype(jnp.float32)), (DATA_AXIS, EXPERT_AXIS)
+            )
+            aux_w = tok_total / (L_real * M * dpsz)
 
             mb_shape = ids_mb.shape[1:]
             probe = jax.eval_shape(
@@ -425,7 +481,8 @@ def make_1f1b_loss_and_grad_fn(
                 stash = lax.dynamic_update_index_in_dim(
                     stash, jnp.where(do_f, x_in, x_stash), mf % Kf, 0
                 )
-                y = cact(stage_fn(layer_stack, x_in))
+                y, _ = stage_fn(layer_stack, x_in)  # aux counted in the bwd pass
+                y = cact(y)
 
                 # ---------- backward part ----------
                 x_b = lax.dynamic_index_in_dim(stash, mb % Kf, 0, keepdims=False)
@@ -437,21 +494,22 @@ def make_1f1b_loss_and_grad_fn(
                     """Last stage: the real loss.  Middle stages: <y, g_in>,
                     whose vjp injects the incoming cotangent.  A scalar
                     ``where`` selects between them — the select's transpose
-                    zeroes the head grads on non-last ranks."""
-                    yy = stage_fn(lp, xx)
+                    zeroes the head grads on non-last ranks.  Every stage
+                    additionally adds its own (normalized) block-aux term,
+                    so aux gradients flow without any extra channel."""
+                    yy, aux = stage_fn(lp, xx)
                     ls, n = head_loss_fn(hp, yy, lbl)
                     dot = jnp.sum(yy.astype(jnp.float32) * g_in.astype(jnp.float32))
-                    obj = jnp.where(is_last, ls.astype(jnp.float32), dot)
-                    return obj, (ls.astype(jnp.float32), n.astype(jnp.float32))
+                    obj = jnp.where(is_last, ls.astype(jnp.float32), dot) + aux_w * aux
+                    return obj, (ls.astype(jnp.float32), n.astype(jnp.float32),
+                                 aux.astype(jnp.float32))
 
-                (obj, (ls, n)), vjp_fn = jax.vjp(
+                (obj, (ls, n, aux_b)), vjp_fn = jax.vjp(
                     lambda lp, hp, xx: objective(lp, hp, xx), layer_stack,
                     head_params, x_b, has_aux=False,
                 )
-                dl, dh, dx = vjp_fn(
-                    (jnp.ones((), jnp.float32), (jnp.zeros((), jnp.float32),
-                                                 jnp.zeros((), jnp.float32)))
-                )
+                zero = jnp.zeros((), jnp.float32)
+                dl, dh, dx = vjp_fn((jnp.ones((), jnp.float32), (zero, zero, zero)))
                 dx = cact(dx)
 
                 _, vjp_e = jax.vjp(
@@ -464,6 +522,7 @@ def make_1f1b_loss_and_grad_fn(
                 ge = masked_add(ge, de, jnp.logical_and(do_b, is_first))
                 use = jnp.logical_and(do_b, is_last)
                 loss_sum = loss_sum + jnp.where(use, ls, 0.0)
+                loss_sum = loss_sum + jnp.where(do_b, aux_b, 0.0) * aux_w
                 tok_sum = tok_sum + jnp.where(use, n, 0.0)
 
                 # ---------- end-of-slot neighbor transport ----------
@@ -575,6 +634,7 @@ def build_pipelined_model(
     seed: int = 0,
     schedule: str = "1f1b",
     act_spec: Optional[P] = None,
+    block_aux: bool = False,
 ) -> PipelinedModel:
     """Initialize a pipelined model with stage parameters born sharded.
 
@@ -605,9 +665,22 @@ def build_pipelined_model(
         abs_tree = jax.eval_shape(init, key)
         return _params_of(nn.get_partition_spec(abs_tree))
 
-    embed_specs = _specs_of(embed_init, r_embed)
-    head_specs = _specs_of(head_init, r_head)
-    block_specs = _specs_of(block_init, r_layers)
+    def _strip_manual_batch_axes(specs):
+        """Drop dp/ep from param specs: the engine's shard_map makes them
+        manual, so stage params must be replicated along them (MoE expert
+        weights lose their ep sharding under PP — ep degenerates to data
+        parallelism inside the engine; dense models are unaffected)."""
+        from neuronx_distributed_tpu.parallel.mesh import strip_axes_from_spec
+
+        manual = frozenset({DATA_AXIS, EXPERT_AXIS})
+        return jax.tree.map(
+            lambda s: strip_axes_from_spec(s, manual),
+            specs, is_leaf=lambda x: isinstance(x, P),
+        )
+
+    embed_specs = _strip_manual_batch_axes(_specs_of(embed_init, r_embed))
+    head_specs = _strip_manual_batch_axes(_specs_of(head_init, r_head))
+    block_specs = _strip_manual_batch_axes(_specs_of(block_init, r_layers))
     layer_specs = stacked_layer_specs(block_specs)
 
     def _shardings(specs):
@@ -649,10 +722,11 @@ def build_pipelined_model(
         remat_block=remat_block,
         remat_policy=remat_policy,
         layer_mask=layer_mask,
+        block_aux=block_aux,
     )
     forward_fn = make_pipelined_forward_fn(
         embed_fn, block_fn, head_fn, num_microbatches, mesh=mesh,
-        layer_mask=layer_mask,
+        layer_mask=layer_mask, block_aux=block_aux,
     )
     if schedule == "1f1b":
         loss_and_grad_fn = make_1f1b_loss_and_grad_fn(
@@ -665,6 +739,7 @@ def build_pipelined_model(
             remat_policy=remat_policy,
             act_spec=act_spec,
             layer_mask=layer_mask,
+            block_aux=block_aux,
         )
     elif schedule == "gpipe":
         def loss_and_grad_fn(params, ids, labels):
@@ -694,6 +769,7 @@ def make_pipelined_forward_fn(
     num_microbatches: int,
     mesh: Optional[Mesh] = None,
     layer_mask=None,
+    block_aux: bool = False,
 ):
     """Forward-only pipeline (the reference's ``InferenceSchedule`` path,
     ``pipeline/model.py:run_eval``): returns ``fn(params, ids) -> outputs``
@@ -706,7 +782,7 @@ def make_pipelined_forward_fn(
     mesh = mesh if mesh is not None else get_mesh()
     pp = mesh.shape[PIPELINE_AXIS]
 
-    stage_fn = _make_stage_fn(block_fn, layer_mask)
+    stage_fn = _make_stage_fn(block_fn, layer_mask, block_aux)
 
     def forward_fn(params, ids: jax.Array):
         ids_mb = microbatch(ids, num_microbatches, mesh if pp > 1 else None)
@@ -714,7 +790,8 @@ def make_pipelined_forward_fn(
 
         if pp == 1:
             def one_mb(_, i):
-                return None, head_fn(params[HEAD], stage_fn(params[LAYERS], embed_fn(params[EMBED], i)))
+                x, _ = stage_fn(params[LAYERS], embed_fn(params[EMBED], i))
+                return None, head_fn(params[HEAD], x)
 
             _, outs = lax.scan(one_mb, None, ids_mb)
             return outs.reshape(ids.shape[0], *outs.shape[2:])
@@ -733,7 +810,7 @@ def make_pipelined_forward_fn(
                 feed_t = jnp.clip(t, 0, M - 1)
                 ids_t = lax.dynamic_index_in_dim(ids_mb, feed_t, axis=0, keepdims=False)
                 x_in = jnp.where(is_first, embed_fn(embed_params, ids_t), buf)
-                y = stage_fn(layer_stack, x_in)
+                y, _ = stage_fn(layer_stack, x_in)
                 out_t = t - (pp - 1)
                 write = jnp.where(jnp.logical_and(is_last, out_t >= 0), y, 0.0).astype(y.dtype)
                 outs = lax.dynamic_update_index_in_dim(
