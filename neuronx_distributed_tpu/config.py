@@ -70,6 +70,12 @@ class TrainingConfig:
         default_factory=ActivationCheckpointConfig
     )
     sequence_parallel: bool = True
+    # ZeRO-3 / FSDP analogue (beyond the reference's ZeRO-1): parameters are
+    # sharded over the data-parallel axes on their largest divisible dim and
+    # XLA inserts the all-gather(param)/reduce-scatter(grad) pattern; the
+    # optimizer states inherit the sharding.  pp=1 only (the pipeline engine
+    # holds stage params replicated across its manual dp axis).
+    fsdp: bool = False
     # dtype policy: explicit instead of the reference's XLA_DOWNCAST_BF16 trick
     # (SURVEY §7 hard-part 5): bf16 compute, fp32 params + optimizer states.
     param_dtype: str = "float32"

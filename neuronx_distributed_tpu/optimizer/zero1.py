@@ -56,14 +56,48 @@ def zero1_spec(spec: Optional[P], shape: tuple, mesh: Optional[Mesh] = None) -> 
     if dp == 1:
         return spec if spec is not None else P()
     entries = _spec_entries(spec, len(shape))
+    if any(a in BATCH_AXES for e in entries for a in _axes_of(e)):
+        return P(*entries)  # already dp-sharded (e.g. fsdp params); leave alone
     for i, (dim, entry) in enumerate(zip(shape, entries)):
         axes = _axes_of(entry)
-        if any(a in BATCH_AXES for a in axes):
-            return P(*entries)  # already dp-sharded somehow; leave alone
         existing = math.prod(mesh.shape[a] for a in axes) if axes else 1
         if dim % (dp * existing) == 0:
             entries[i] = tuple(BATCH_AXES) + axes
             return P(*entries)
+    return P(*entries)
+
+
+def fsdp_spec(spec: Optional[P], shape: tuple, mesh: Optional[Mesh] = None) -> P:
+    """Extend a *parameter's* PartitionSpec with the dp axes — ZeRO-3 /
+    FSDP as a placement policy (capability beyond the reference, which stops
+    at ZeRO-1: SURVEY §2.10 "FSDP / ZeRO-2/3 — Absent").
+
+    Unlike :func:`zero1_spec` (first divisible dim, contiguous state shards),
+    this picks the LARGEST evenly-divisible unsharded dim: parameters are
+    all-gathered on use, so the sharded dim should carry the most bytes
+    (hidden/vocab dims), and a stacked ``[L, ...]`` scan-layers layer dim —
+    usually first and small — stays whole so each scan step gathers one
+    layer's weights, not a layer-shuffled mix.  Params with no eligible dim
+    (biases, norm scales) stay replicated — same fallback as ZeRO-1.
+
+    Under jit the consequence is exactly FSDP's communication pattern,
+    inserted by XLA: all-gather(params) per use in fwd/bwd,
+    reduce-scatter(grads), and optimizer states inheriting the dp-sharded
+    spec (``zero1_spec`` leaves already-dp-sharded specs alone)."""
+    mesh = mesh if mesh is not None else get_mesh()
+    dp = math.prod(mesh.shape[a] for a in BATCH_AXES)
+    if dp == 1:
+        return spec if spec is not None else P()
+    entries = _spec_entries(spec, len(shape))
+    if any(a in BATCH_AXES for e in entries for a in _axes_of(e)):
+        return P(*entries)  # already dp-sharded; leave alone
+    best, best_size = None, 0
+    for i, (dim, entry) in enumerate(zip(shape, entries)):
+        existing = math.prod(mesh.shape[a] for a in _axes_of(entry))
+        if dim % (dp * existing) == 0 and dim > best_size:
+            best, best_size = i, dim
+    if best is not None:
+        entries[best] = tuple(BATCH_AXES) + _axes_of(entries[best])
     return P(*entries)
 
 

@@ -168,6 +168,13 @@ def initialize_parallel_model(
     module = _align_module_with_config(module, config)
 
     if config.mesh.pipeline_parallel_size > 1:
+        if config.fsdp:
+            raise ValueError(
+                "fsdp=True requires pipeline_parallel_size == 1: the pipeline "
+                "engine's shard_map makes dp manual, so stage parameters must "
+                "be replicated along dp (its 1F1B stash already bounds "
+                "activation memory; use zero_one_enabled for state sharding)"
+            )
         builder = getattr(module, "build_pipelined", None)
         if builder is None:
             raise ValueError(
@@ -191,6 +198,17 @@ def initialize_parallel_model(
 
     abs_params = jax.eval_shape(module.init, rng, *example_inputs)
     param_specs = nn.get_partition_spec(abs_params)
+    if config.fsdp:
+        # ZeRO-3 placement: dp joins each param's spec on its largest free
+        # dim; grads/optimizer states follow, XLA inserts the FSDP
+        # all-gather/reduce-scatter pattern (optimizer/zero1.fsdp_spec)
+        from neuronx_distributed_tpu.optimizer.zero1 import fsdp_spec
+
+        param_specs = jax.tree.map(
+            lambda s, leaf: fsdp_spec(s, leaf.shape, mesh),
+            param_specs, nn.unbox(abs_params),
+            is_leaf=lambda x: isinstance(x, P),
+        )
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), param_specs, is_leaf=lambda x: isinstance(x, P)
     )
