@@ -18,6 +18,9 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", default="tiny", choices=["tiny", "neox_6_9b", "neox_20b"])
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1, help="pipeline parallel degree")
+    p.add_argument("--microbatches", type=int, default=1,
+                   help="pipeline microbatches (pp>1)")
     p.add_argument("--no-sp", action="store_true")
     p.add_argument("--no-zero1", action="store_true")
     p.add_argument("--batch-size", type=int, default=8)
@@ -54,7 +57,8 @@ def main():
     from neuronx_distributed_tpu.utils import initialize_distributed
 
     initialize_distributed()
-    nxd.initialize_model_parallel(tensor_parallel_size=args.tp)
+    nxd.initialize_model_parallel(tensor_parallel_size=args.tp,
+                                  pipeline_parallel_size=args.pp)
     on_tpu = jax.default_backend() == "tpu"
     cfg = getattr(GPTNeoXConfig, args.preset)(
         max_seq_len=args.seq_len,
@@ -64,6 +68,7 @@ def main():
     )
     config = nxd.training_config(
         tensor_parallel_size=args.tp, learning_rate=args.lr,
+        pipeline_parallel_size=args.pp, num_microbatches=args.microbatches,
         zero_one_enabled=not args.no_zero1,
         compute_dtype="bfloat16" if on_tpu else "float32")
     model = initialize_parallel_model(

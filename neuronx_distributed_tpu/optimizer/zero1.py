@@ -42,15 +42,14 @@ def _axes_of(entry) -> tuple:
     return tuple(entry)
 
 
-def zero1_spec(spec: Optional[P], shape: tuple, mesh: Optional[Mesh] = None) -> P:
-    """Extend a param's PartitionSpec with the dp axes for its optimizer state.
+def _dp_extend(spec: Optional[P], shape: tuple, mesh: Optional[Mesh], largest: bool) -> P:
+    """Shared dp-extension core for :func:`zero1_spec` / :func:`fsdp_spec`.
 
-    Picks the first dim whose size is divisible by ``dp * existing-sharding``
-    and prepends the data-parallel axes there (dp-major, so each dp rank owns
-    a contiguous state shard — the analogue of torch-xla ZeRO's contiguous
-    per-rank shards).  Falls back to the unmodified spec (replicated states)
-    for params too small to split, like biases and norm weights.
-    """
+    A dim is eligible when its size divides ``dp * its-existing-sharding``
+    (a TP-consumed dim stays eligible — dp just subdivides its shards
+    further).  Already-dp-sharded specs pass through unchanged; specs with
+    no eligible dim stay as they are (replicated along dp).  ``largest``
+    selects between first-eligible (ZeRO-1) and largest-eligible (FSDP)."""
     mesh = mesh if mesh is not None else get_mesh()
     dp = math.prod(mesh.shape[a] for a in BATCH_AXES)
     if dp == 1:
@@ -58,13 +57,29 @@ def zero1_spec(spec: Optional[P], shape: tuple, mesh: Optional[Mesh] = None) -> 
     entries = _spec_entries(spec, len(shape))
     if any(a in BATCH_AXES for e in entries for a in _axes_of(e)):
         return P(*entries)  # already dp-sharded (e.g. fsdp params); leave alone
+    best, best_size = None, 0
     for i, (dim, entry) in enumerate(zip(shape, entries)):
-        axes = _axes_of(entry)
-        existing = math.prod(mesh.shape[a] for a in axes) if axes else 1
+        existing = math.prod(mesh.shape[a] for a in _axes_of(entry))
         if dim % (dp * existing) == 0:
-            entries[i] = tuple(BATCH_AXES) + axes
-            return P(*entries)
+            if not largest:
+                best = i
+                break
+            if dim > best_size:
+                best, best_size = i, dim
+    if best is not None:
+        entries[best] = tuple(BATCH_AXES) + _axes_of(entries[best])
     return P(*entries)
+
+
+def zero1_spec(spec: Optional[P], shape: tuple, mesh: Optional[Mesh] = None) -> P:
+    """Extend a param's PartitionSpec with the dp axes for its optimizer state.
+
+    Picks the FIRST eligible dim (dp-major, so each dp rank owns a
+    contiguous state shard — the analogue of torch-xla ZeRO's contiguous
+    per-rank shards).  Params with no eligible dim (dims too small or not
+    divisible) keep their spec: their states stay replicated.
+    """
+    return _dp_extend(spec, shape, mesh, largest=False)
 
 
 def fsdp_spec(spec: Optional[P], shape: tuple, mesh: Optional[Mesh] = None) -> P:
@@ -72,33 +87,20 @@ def fsdp_spec(spec: Optional[P], shape: tuple, mesh: Optional[Mesh] = None) -> P
     FSDP as a placement policy (capability beyond the reference, which stops
     at ZeRO-1: SURVEY §2.10 "FSDP / ZeRO-2/3 — Absent").
 
-    Unlike :func:`zero1_spec` (first divisible dim, contiguous state shards),
-    this picks the LARGEST evenly-divisible unsharded dim: parameters are
-    all-gathered on use, so the sharded dim should carry the most bytes
+    Unlike :func:`zero1_spec`, picks the LARGEST eligible dim: parameters
+    are all-gathered on use, so the sharded dim should carry the most bytes
     (hidden/vocab dims), and a stacked ``[L, ...]`` scan-layers layer dim —
     usually first and small — stays whole so each scan step gathers one
-    layer's weights, not a layer-shuffled mix.  Params with no eligible dim
-    (biases, norm scales) stay replicated — same fallback as ZeRO-1.
+    layer's weights, not a layer-shuffled mix.  Eligibility is purely
+    divisibility: a TP-sharded dim can additionally take dp, and a 1-D norm
+    scale whose size divides dp IS dp-sharded (fine — it is gathered on use
+    like everything else); only dims with no divisible size stay replicated.
 
     Under jit the consequence is exactly FSDP's communication pattern,
     inserted by XLA: all-gather(params) per use in fwd/bwd,
     reduce-scatter(grads), and optimizer states inheriting the dp-sharded
     spec (``zero1_spec`` leaves already-dp-sharded specs alone)."""
-    mesh = mesh if mesh is not None else get_mesh()
-    dp = math.prod(mesh.shape[a] for a in BATCH_AXES)
-    if dp == 1:
-        return spec if spec is not None else P()
-    entries = _spec_entries(spec, len(shape))
-    if any(a in BATCH_AXES for e in entries for a in _axes_of(e)):
-        return P(*entries)  # already dp-sharded; leave alone
-    best, best_size = None, 0
-    for i, (dim, entry) in enumerate(zip(shape, entries)):
-        existing = math.prod(mesh.shape[a] for a in _axes_of(entry))
-        if dim % (dp * existing) == 0 and dim > best_size:
-            best, best_size = i, dim
-    if best is not None:
-        entries[best] = tuple(BATCH_AXES) + _axes_of(entries[best])
-    return P(*entries)
+    return _dp_extend(spec, shape, mesh, largest=True)
 
 
 def _params_path_map(params, param_specs):
