@@ -38,6 +38,10 @@ class PipelineConfig:
 
     num_microbatches: int = 1
     schedule: str = "1f1b"  # "1f1b" | "gpipe" | "inference"
+    # explicit uneven stage partition (layer indices beginning each new
+    # stage, the reference's pipeline_cuts).  Give the last stage fewer
+    # layers to offset its cond-gated head+loss work.  None = balanced.
+    pipeline_cuts: Optional[tuple] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -104,3 +104,82 @@ def dense_mha(
         scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bnst,btnd->bsnd", probs, v, preferred_element_type=q.dtype)
+
+
+def build_pipelined_causal_lm(
+    *,
+    embed_mod,
+    block_mod,
+    head_mod,
+    block_fn,
+    num_layers: int,
+    max_seq_len: int,
+    hidden_size: int,
+    dtype,
+    remat: str,
+    sequence_parallel: bool,
+    num_microbatches: int,
+    seed: int = 0,
+    schedule: str = "1f1b",
+    pipeline_cuts=None,
+    block_aux: bool = False,
+):
+    """Shared engine wiring for pipeline-parallel causal-LM families.
+
+    A family supplies its three modules and a ``block_fn(layer_params, x) ->
+    y`` (or ``(y, aux)`` with ``block_aux``); everything else — the
+    vocab-parallel head loss, init thunks, remat-policy mapping, SP
+    activation spec — is identical across families and lives here so an
+    engine-protocol change lands once (contrast the reference, where each
+    example port re-implements its trainer wiring)."""
+    import neuronx_distributed_tpu.pipeline.engine as engine
+    from neuronx_distributed_tpu.parallel.layers import trailing_spec
+    from neuronx_distributed_tpu.parallel.mesh import SEQUENCE_AXES, get_mesh
+
+    mesh = get_mesh()
+
+    def embed_fn(ep, ids):
+        return embed_mod.apply({"params": ep}, ids)
+
+    def head_fn(hp, h):
+        return head_mod.apply({"params": hp}, h)
+
+    def head_loss_fn(hp, h, labels):
+        logits = head_fn(hp, h)
+        per_tok = parallel_cross_entropy(logits, labels)
+        mask = (labels >= 0).astype(jnp.float32)
+        return jnp.sum(per_tok * mask), jnp.sum(mask)
+
+    return engine.build_pipelined_model(
+        embed_fn=embed_fn,
+        block_fn=block_fn,
+        head_loss_fn=head_loss_fn,
+        head_fn=head_fn,
+        embed_init=lambda r: embed_mod.init(r, jnp.zeros((1, max_seq_len), jnp.int32)),
+        block_init=lambda r: block_mod.init(
+            r,
+            jnp.zeros((1, max_seq_len, hidden_size), dtype),
+            jnp.zeros((1, max_seq_len), jnp.int32),
+        ),
+        head_init=lambda r: head_mod.init(
+            r, jnp.zeros((1, max_seq_len, hidden_size), dtype)
+        ),
+        num_layers=num_layers,
+        num_microbatches=num_microbatches,
+        mesh=mesh,
+        remat_block=remat != "none",
+        remat_policy=(
+            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+            if remat == "selective"
+            else None
+        ),
+        seed=seed,
+        schedule=schedule,
+        act_spec=(
+            trailing_spec(3, seq=SEQUENCE_AXES, last=None)
+            if sequence_parallel
+            else None
+        ),
+        block_aux=block_aux,
+        pipeline_cuts=pipeline_cuts,
+    )

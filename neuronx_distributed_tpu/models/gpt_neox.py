@@ -169,11 +169,13 @@ class GPTNeoXForCausalLM(nn.Module):
     config: GPTNeoXConfig
 
     @nn.nowrap
-    def build_pipelined(self, num_microbatches: int, schedule: str = "1f1b", seed: int = 0):
+    def build_pipelined(self, num_microbatches: int, schedule: str = "1f1b", seed: int = 0,
+                        pipeline_cuts=None):
         """Pipeline-capable-model protocol consumed by
         ``initialize_parallel_model`` when ``pipeline_parallel_size > 1``."""
         return build_pipelined_gpt_neox(
-            self.config, num_microbatches=num_microbatches, seed=seed, schedule=schedule
+            self.config, num_microbatches=num_microbatches, seed=seed, schedule=schedule,
+            pipeline_cuts=pipeline_cuts,
         )
 
     @nn.compact
@@ -231,16 +233,14 @@ class GPTNeoXHead(nn.Module):
 
 
 def build_pipelined_gpt_neox(
-    cfg: GPTNeoXConfig, num_microbatches: int, seed: int = 0, schedule: str = "1f1b"
+    cfg: GPTNeoXConfig, num_microbatches: int, seed: int = 0, schedule: str = "1f1b",
+    pipeline_cuts=None,
 ):
     """Pipeline-parallel GPT-NeoX (the reference's 20B milestone topology,
     TP8 x PP4 1F1B — BASELINE config 4); same engine protocol as
     ``llama.build_pipelined_llama``."""
-    import neuronx_distributed_tpu.pipeline.engine as engine
-    from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy
-    from neuronx_distributed_tpu.parallel.mesh import get_mesh
+    from neuronx_distributed_tpu.models.common import build_pipelined_causal_lm
 
-    mesh = get_mesh()
     embed_mod = ParallelEmbedding(
         num_embeddings=cfg.vocab_size,
         features=cfg.hidden_size,
@@ -251,50 +251,23 @@ def build_pipelined_gpt_neox(
     block_mod = GPTNeoXBlock(cfg)
     head_mod = GPTNeoXHead(cfg)
 
-    def embed_fn(ep, ids):
-        return embed_mod.apply({"params": ep}, ids)
-
     def block_fn(lp, x):
         positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
         return block_mod.apply({"params": lp}, x, positions)
 
-    def head_fn(hp, h):
-        return head_mod.apply({"params": hp}, h)
-
-    def head_loss_fn(hp, h, labels):
-        logits = head_fn(hp, h)
-        per_tok = parallel_cross_entropy(logits, labels)
-        mask = (labels >= 0).astype(jnp.float32)
-        return jnp.sum(per_tok * mask), jnp.sum(mask)
-
-    return engine.build_pipelined_model(
-        embed_fn=embed_fn,
+    return build_pipelined_causal_lm(
+        embed_mod=embed_mod,
+        block_mod=block_mod,
+        head_mod=head_mod,
         block_fn=block_fn,
-        head_loss_fn=head_loss_fn,
-        head_fn=head_fn,
-        embed_init=lambda r: embed_mod.init(r, jnp.zeros((1, cfg.max_seq_len), jnp.int32)),
-        block_init=lambda r: block_mod.init(
-            r,
-            jnp.zeros((1, cfg.max_seq_len, cfg.hidden_size), cfg.dtype),
-            jnp.zeros((1, cfg.max_seq_len), jnp.int32),
-        ),
-        head_init=lambda r: head_mod.init(
-            r, jnp.zeros((1, cfg.max_seq_len, cfg.hidden_size), cfg.dtype)
-        ),
         num_layers=cfg.num_layers,
+        max_seq_len=cfg.max_seq_len,
+        hidden_size=cfg.hidden_size,
+        dtype=cfg.dtype,
+        remat=cfg.remat,
+        sequence_parallel=cfg.sequence_parallel,
         num_microbatches=num_microbatches,
-        mesh=mesh,
-        remat_block=cfg.remat != "none",
-        remat_policy=(
-            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
-            if cfg.remat == "selective"
-            else None
-        ),
         seed=seed,
         schedule=schedule,
-        act_spec=(
-            trailing_spec(3, seq=SEQUENCE_AXES, last=None)
-            if cfg.sequence_parallel
-            else None
-        ),
+        pipeline_cuts=pipeline_cuts,
     )

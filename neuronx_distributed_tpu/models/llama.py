@@ -393,11 +393,13 @@ class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
 
     @nn.nowrap
-    def build_pipelined(self, num_microbatches: int, schedule: str = "1f1b", seed: int = 0):
+    def build_pipelined(self, num_microbatches: int, schedule: str = "1f1b", seed: int = 0,
+                        pipeline_cuts=None):
         """Pipeline-capable-model protocol consumed by
         ``initialize_parallel_model`` when ``pipeline_parallel_size > 1``."""
         return build_pipelined_llama(
-            self.config, num_microbatches=num_microbatches, seed=seed, schedule=schedule
+            self.config, num_microbatches=num_microbatches, seed=seed, schedule=schedule,
+            pipeline_cuts=pipeline_cuts,
         )
 
     @nn.compact
@@ -445,7 +447,8 @@ class LlamaHead(nn.Module):
 
 
 def build_pipelined_llama(
-    cfg: LlamaConfig, num_microbatches: int, seed: int = 0, schedule: str = "1f1b"
+    cfg: LlamaConfig, num_microbatches: int, seed: int = 0, schedule: str = "1f1b",
+    pipeline_cuts=None,
 ):
     """Construct a :class:`~neuronx_distributed_tpu.pipeline.engine.PipelinedModel`
     for pipeline-parallel Llama training.
@@ -454,10 +457,8 @@ def build_pipelined_llama(
     the ``pp`` mesh axis (the engine's partitioning-by-sharding; contrast the
     reference's FX split into ``submod_i`` children,
     ``pipeline/partition.py:17-42``)."""
-    import neuronx_distributed_tpu.pipeline.engine as engine
-    from neuronx_distributed_tpu.parallel.mesh import get_mesh
+    from neuronx_distributed_tpu.models.common import build_pipelined_causal_lm
 
-    mesh = get_mesh()
     embed_mod = ParallelEmbedding(
         num_embeddings=cfg.vocab_size,
         features=cfg.hidden_size,
@@ -468,9 +469,6 @@ def build_pipelined_llama(
     block_mod = LlamaBlock(cfg)
     head_mod = LlamaHead(cfg)
     moe = cfg.num_experts > 1
-
-    def embed_fn(ep, ids):
-        return embed_mod.apply({"params": ep}, ids)
 
     if moe:
         # MoE block: hand the sown load-balancing term to the engine's aux
@@ -496,48 +494,21 @@ def build_pipelined_llama(
             y, _ = block_mod.apply({"params": lp}, x, positions)
             return y
 
-    def head_fn(hp, h):
-        return head_mod.apply({"params": hp}, h)
-
-    def head_loss_fn(hp, h, labels):
-        logits = head_fn(hp, h)
-        per_tok = parallel_cross_entropy(logits, labels)
-        mask = (labels >= 0).astype(jnp.float32)
-        return jnp.sum(per_tok * mask), jnp.sum(mask)
-
-    return engine.build_pipelined_model(
-        embed_fn=embed_fn,
+    return build_pipelined_causal_lm(
+        embed_mod=embed_mod,
+        block_mod=block_mod,
+        head_mod=head_mod,
         block_fn=block_fn,
-        head_loss_fn=head_loss_fn,
-        head_fn=head_fn,
-        embed_init=lambda r: embed_mod.init(r, jnp.zeros((1, cfg.max_seq_len), jnp.int32)),
-        block_init=lambda r: block_mod.init(
-            r,
-            jnp.zeros((1, cfg.max_seq_len, cfg.hidden_size), cfg.dtype),
-            jnp.zeros((1, cfg.max_seq_len), jnp.int32),
-        ),
-        head_init=lambda r: head_mod.init(
-            r, jnp.zeros((1, cfg.max_seq_len, cfg.hidden_size), cfg.dtype)
-        ),
         num_layers=cfg.num_layers,
+        max_seq_len=cfg.max_seq_len,
+        hidden_size=cfg.hidden_size,
+        dtype=cfg.dtype,
+        remat=cfg.remat,
+        sequence_parallel=cfg.sequence_parallel,
         num_microbatches=num_microbatches,
-        mesh=mesh,
-        remat_block=cfg.remat != "none",
-        remat_policy=(
-            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
-            if cfg.remat == "selective"
-            else None
-        ),
         seed=seed,
         schedule=schedule,
-        # inter-stage residual sharding: sequence-sharded under SP (the
-        # constraint LlamaBlock applies at its exit) — the 1F1B engine
-        # re-applies it on cond branches that bypass the model
-        act_spec=(
-            trailing_spec(3, seq=SEQUENCE_AXES, last=None)
-            if cfg.sequence_parallel
-            else None
-        ),
+        pipeline_cuts=pipeline_cuts,
         block_aux=moe,
     )
 

@@ -68,22 +68,44 @@ LAYERS = "layers"
 HEAD = "head"
 
 
-def _make_stage_fn(blk, layer_mask, block_aux: bool = False):
+def _make_cact(act_spec):
+    """Closure pinning an activation to ``act_spec`` over the context mesh
+    (identity when no spec).  Used wherever a ``lax.cond``/``where`` branch
+    bypasses the model: XLA requires both branches identically sharded, and
+    the model's own branch constrains its output internally under SP."""
+    if act_spec is None:
+        return lambda a: a
+    from neuronx_distributed_tpu.parallel.layers import shard_activation
+
+    return lambda a: shard_activation(a, act_spec)
+
+
+def _make_stage_fn(blk, layer_mask, block_aux: bool = False, act_spec: Optional[P] = None):
     """Stage executor: scan the stage's layer rows; returns ``(x, aux)``.
 
     ``layer_mask`` (``[L']`` of 0/1, or None) marks padded rows added for a
-    non-divisible layer count (:func:`..partition.padded_layer_layout`):
-    masked rows compute the block uniformly (SPMD — no divergent control
-    flow) but select the identity, and the ``where`` transpose zeroes their
-    zero-initialized parameters' gradients.  The mask is a compile-time
-    constant, NOT a parameter — it must never reach the optimizer (weight
-    decay would erode it) or checkpoints.  Returns a ``stage_fn(stage_rows,
-    x)`` operating on this stage's slice of the stack; under the pp
-    shard_map the mask constant is sliced with ``axis_index``.
+    non-divisible layer count or uneven ``pipeline_cuts``
+    (:func:`..partition.layout_from_spans`): a padded row runs under
+    ``lax.cond(active, block, identity)``, so it costs (almost) nothing —
+    which is what makes uneven cuts an actual *rebalancing* tool: a stage
+    holding fewer real layers genuinely finishes its tick earlier.  The
+    predicate is legal for the same reason as the engines' embed/head conds:
+    it depends only on the pp rank (the mask is a compile-time constant
+    sliced by ``axis_index``), and the manual axes carry no GSPMD
+    collectives, so every participant of any auto-axis collective channel
+    inside the block takes the same branch.  The cond's vjp zeroes the
+    padded rows' (zero-initialized) parameter gradients.  The mask is NOT a
+    parameter — it must never reach the optimizer or checkpoints.
+
+    ``act_spec`` pins both cond branches' output sharding (the block
+    constrains its output internally under SP; the identity branch must
+    match or the partitioner rejects the conditional).
 
     ``block_aux``: the block returns ``(y, aux_scalar)`` (e.g. a MoE
     load-balancing term) and ``aux`` is the sum over the stage's live
     layers; otherwise ``aux`` is a constant 0 (folded away by XLA)."""
+
+    cact = _make_cact(act_spec)
 
     def call(layer_params, h):
         if block_aux:
@@ -116,8 +138,13 @@ def _make_stage_fn(blk, layer_mask, block_aux: bool = False):
         def body(carry, xs):
             h, aux = carry
             layer_params, a = xs
-            y, aux_l = call(layer_params, h)
-            return (jnp.where(a > 0, y, h), aux + a * aux_l), None
+            y, aux_l = lax.cond(
+                a > 0,
+                lambda lp, hh: (lambda o: (cact(o[0]), o[1]))(call(lp, hh)),
+                lambda lp, hh: (cact(hh), jnp.zeros((), jnp.float32)),
+                layer_params, h,
+            )
+            return (y, aux + aux_l), None
 
         (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), (stage_params, local))
         return x, aux
@@ -173,6 +200,7 @@ def make_pipelined_loss_fn(
     remat_policy: Optional[Callable] = None,
     layer_mask=None,
     block_aux: bool = False,
+    act_spec: Optional[P] = None,
 ):
     """Build ``loss_fn(params, ids, labels) -> (loss_sum, token_count)``.
 
@@ -195,7 +223,7 @@ def make_pipelined_loss_fn(
     if remat_block:
         blk = jax.checkpoint(block_fn, policy=remat_policy, prevent_cse=False)
 
-    stage_fn = _make_stage_fn(blk, layer_mask, block_aux)
+    stage_fn = _make_stage_fn(blk, layer_mask, block_aux, act_spec)
     n_real_layers = (
         int(sum(layer_mask)) if layer_mask is not None else None  # else runtime L
     )
@@ -251,11 +279,23 @@ def make_pipelined_loss_fn(
             mb_shape = ids_mb.shape[1:]
             probe = jax.eval_shape(embed_fn, embed_params, jnp.zeros(mb_shape, ids_mb.dtype))
 
+            cact = _make_cact(act_spec)
+
             def tick(carry, t):
                 buf, loss_sum, tok_sum = carry
                 feed_t = jnp.clip(t, 0, M - 1)
                 ids_t = lax.dynamic_index_in_dim(ids_mb, feed_t, axis=0, keepdims=False)
-                x0 = embed_fn(embed_params, ids_t)
+                # embed/head run under lax.cond on their owning pp rank, not
+                # uniformly-then-masked: the predicate is pp-only and the
+                # manual axes carry no GSPMD collectives, so every member of
+                # any auto-axis collective channel inside (tp/kvr/cp) takes
+                # the same branch — see the 1F1B objective's note
+                x0 = lax.cond(
+                    is_first,
+                    lambda ep: cact(embed_fn(ep, ids_t).astype(probe.dtype)),
+                    lambda ep: cact(jnp.zeros(probe.shape, probe.dtype)),
+                    embed_params,
+                )
                 x_in = jnp.where(is_first, x0, buf)
 
                 y, aux = stage_fn(layer_stack, x_in)
@@ -268,10 +308,18 @@ def make_pipelined_loss_fn(
                 lbl = lax.dynamic_index_in_dim(
                     labels_mb, jnp.clip(out_t, 0, M - 1), axis=0, keepdims=False
                 )
-                ls, n = head_loss_fn(head_params, y, lbl)
+                ls, n = lax.cond(
+                    is_last,
+                    lambda hp_, y_: tuple(
+                        o.astype(jnp.float32) for o in head_loss_fn(hp_, y_, lbl)
+                    ),
+                    lambda hp_, y_: (jnp.zeros((), jnp.float32),
+                                     jnp.zeros((), jnp.float32)),
+                    head_params, y,
+                )
                 use = jnp.logical_and(is_last, out_t >= 0)
-                loss_sum = loss_sum + jnp.where(use, ls, 0.0).astype(jnp.float32)
-                tok_sum = tok_sum + jnp.where(use, n, 0.0).astype(jnp.float32)
+                loss_sum = loss_sum + jnp.where(use, ls, 0.0)
+                tok_sum = tok_sum + jnp.where(use, n, 0.0)
 
                 nxt = lax.ppermute(
                     y, PIPELINE_AXIS, [(i, (i + 1) % pp) for i in range(pp)]
@@ -380,7 +428,7 @@ def make_1f1b_loss_and_grad_fn(
     if remat_block:
         blk = jax.checkpoint(block_fn, policy=remat_policy, prevent_cse=False)
 
-    stage_fn = _make_stage_fn(blk, layer_mask, block_aux)
+    stage_fn = _make_stage_fn(blk, layer_mask, block_aux, act_spec)
     n_real_layers = int(sum(layer_mask)) if layer_mask is not None else None
 
     if pp == 1:
@@ -388,7 +436,7 @@ def make_1f1b_loss_and_grad_fn(
         plain = make_pipelined_loss_fn(
             embed_fn, block_fn, head_loss_fn, M, mesh=mesh,
             remat_block=remat_block, remat_policy=remat_policy,
-            layer_mask=layer_mask, block_aux=block_aux,
+            layer_mask=layer_mask, block_aux=block_aux, act_spec=act_spec,
         )
 
         def loss_and_grad_pp1(params, ids, labels):
@@ -440,13 +488,7 @@ def make_1f1b_loss_and_grad_fn(
             )
             act = jax.ShapeDtypeStruct(probe.shape, probe.dtype)
 
-            def cact(a):
-                """Pin activation sharding so lax.cond branches agree."""
-                if act_spec is None:
-                    return a
-                from neuronx_distributed_tpu.parallel.layers import shard_activation
-
-                return shard_activation(a, act_spec)
+            cact = _make_cact(act_spec)
 
             my_f = jnp.take(jnp.asarray(fwd_tab), rank, axis=0)
             my_b = jnp.take(jnp.asarray(bwd_tab), rank, axis=0)
@@ -462,15 +504,25 @@ def make_1f1b_loss_and_grad_fn(
             def tick(carry, xs):
                 stash, gstash, gl, ge, gh, loss_sum, tok_sum = carry
                 mf, mb, inf, inb = xs
-                # both parts run uniformly every tick (bubble slots compute
-                # on garbage and are masked out) — divergent control flow
-                # around the collective-bearing stage compute is forbidden.
+                # the STAGE compute runs uniformly every tick (bubble slots
+                # compute on garbage and are masked out): a rank-and-tick-
+                # varying cond around stage_fn would put the tick's ppermutes
+                # behind divergent control flow — forbidden.  The embed/head
+                # conds below are different: their collectives span only auto
+                # axes, whose members all share one pp rank (see objective).
                 do_f = mf >= 0
                 do_b = mb >= 0
 
                 # ---------- forward part ----------
                 ids_f = lax.dynamic_index_in_dim(ids_mb, mf, 0, keepdims=False)
-                x_emb = cact(embed_fn(embed_params, ids_f).astype(act.dtype))
+                # embed only where its result is consumed (stage 0) — same
+                # pp-uniform-predicate argument as the head cond below
+                x_emb = lax.cond(
+                    is_first,
+                    lambda ep: cact(embed_fn(ep, ids_f).astype(act.dtype)),
+                    lambda ep: cact(jnp.zeros(act.shape, act.dtype)),
+                    embed_params,
+                )
                 x_stash = cact(
                     lax.dynamic_index_in_dim(stash, mf % Kf, 0, keepdims=False)
                 )
@@ -492,17 +544,35 @@ def make_1f1b_loss_and_grad_fn(
 
                 def objective(lp, hp, xx):
                     """Last stage: the real loss.  Middle stages: <y, g_in>,
-                    whose vjp injects the incoming cotangent.  A scalar
-                    ``where`` selects between them — the select's transpose
-                    zeroes the head grads on non-last ranks.  Every stage
+                    whose vjp injects the incoming cotangent.  Every stage
                     additionally adds its own (normalized) block-aux term,
-                    so aux gradients flow without any extra channel."""
+                    so aux gradients flow without any extra channel.
+
+                    The head+loss runs under ``lax.cond(is_last, ...)`` — NOT
+                    uniformly-then-masked: the predicate depends only on the
+                    pp rank, and inside this shard_map the manual axes
+                    (dp/ep/pp) carry no GSPMD-inserted collectives, so every
+                    participant of any auto-axis collective channel the head
+                    contains (tp/kvr/cp — e.g. the SP seq-gather, the
+                    vocab-parallel loss psums) shares one pp rank and takes
+                    the same branch.  This removes the per-tick head tax on
+                    P-1 of P ranks (``scheduler.sync_1f1b_head_overhead``);
+                    combine with ``pipeline_cuts`` giving the last stage
+                    fewer layers to rebalance the tick critical path.  The
+                    cond's vjp zeroes head grads on non-last ranks."""
                     yy, aux = stage_fn(lp, xx)
-                    ls, n = head_loss_fn(hp, yy, lbl)
+                    ls, n = lax.cond(
+                        is_last,
+                        lambda hp_, yy_: tuple(
+                            o.astype(jnp.float32) for o in head_loss_fn(hp_, yy_, lbl)
+                        ),
+                        lambda hp_, yy_: (jnp.zeros((), jnp.float32),
+                                          jnp.zeros((), jnp.float32)),
+                        hp, yy,
+                    )
                     dot = jnp.sum(yy.astype(jnp.float32) * g_in.astype(jnp.float32))
-                    obj = jnp.where(is_last, ls.astype(jnp.float32), dot) + aux_w * aux
-                    return obj, (ls.astype(jnp.float32), n.astype(jnp.float32),
-                                 aux.astype(jnp.float32))
+                    obj = jnp.where(is_last, ls, dot) + aux_w * aux
+                    return obj, (ls, n, aux.astype(jnp.float32))
 
                 (obj, (ls, n, aux_b)), vjp_fn = jax.vjp(
                     lambda lp, hp, xx: objective(lp, hp, xx), layer_stack,
@@ -512,14 +582,20 @@ def make_1f1b_loss_and_grad_fn(
                 dl, dh, dx = vjp_fn((jnp.ones((), jnp.float32), (zero, zero, zero)))
                 dx = cact(dx)
 
-                _, vjp_e = jax.vjp(
-                    lambda ep: embed_fn(ep, ids_b).astype(act.dtype), embed_params
+                # embedding backward (a vocab-sized scatter-add) only on the
+                # stage that owns it, and only on live slots
+                de = lax.cond(
+                    jnp.logical_and(do_b, is_first),
+                    lambda ep: jax.vjp(
+                        lambda e: embed_fn(e, ids_b).astype(act.dtype), ep
+                    )[1](dx)[0],
+                    lambda ep: jax.tree.map(jnp.zeros_like, ep),
+                    embed_params,
                 )
-                (de,) = vjp_e(dx)
 
                 gl = masked_add(gl, dl, do_b)
                 gh = masked_add(gh, dh, do_b)
-                ge = masked_add(ge, de, jnp.logical_and(do_b, is_first))
+                ge = jax.tree.map(jnp.add, ge, de)  # cond already zeroes
                 use = jnp.logical_and(do_b, is_last)
                 loss_sum = loss_sum + jnp.where(use, ls, 0.0)
                 loss_sum = loss_sum + jnp.where(do_b, aux_b, 0.0) * aux_w
@@ -635,6 +711,7 @@ def build_pipelined_model(
     schedule: str = "1f1b",
     act_spec: Optional[P] = None,
     block_aux: bool = False,
+    pipeline_cuts: Optional[Tuple[int, ...]] = None,
 ) -> PipelinedModel:
     """Initialize a pipelined model with stage parameters born sharded.
 
@@ -648,11 +725,24 @@ def build_pipelined_model(
 
     mesh = mesh if mesh is not None else get_mesh()
     pp = mesh.shape[PIPELINE_AXIS]
-    if num_layers % pp == 0:
+    if pipeline_cuts is not None:
+        # explicit uneven stage partition (the reference's pipeline_cuts,
+        # reference pipeline/partition.py:17-42).  The classic use: give the
+        # LAST stage fewer layers so its extra head+loss work (which the
+        # engines cond-gate onto it) stops being the per-tick critical path.
+        from neuronx_distributed_tpu.pipeline.partition import (
+            layout_from_spans,
+            spans_from_cuts,
+        )
+
+        spans = spans_from_cuts(pipeline_cuts, num_layers)
+        padded_layers, row_of_layer, layer_mask = layout_from_spans(spans, pp)
+        if all(m == 1 for m in layer_mask):
+            layer_mask = None  # cuts happen to be uniform: no padding needed
+    elif num_layers % pp == 0:
         padded_layers, row_of_layer, layer_mask = num_layers, list(range(num_layers)), None
     else:
-        # non-divisible: pad the stack with identity rows (the reference's
-        # pipeline_cuts flexibility, reference pipeline/partition.py:17-42)
+        # non-divisible: pad the stack with identity rows
         padded_layers, row_of_layer, layer_mask = padded_layer_layout(num_layers, pp)
 
     rng = jax.random.PRNGKey(seed)
@@ -723,10 +813,11 @@ def build_pipelined_model(
         remat_policy=remat_policy,
         layer_mask=layer_mask,
         block_aux=block_aux,
+        act_spec=act_spec,
     )
     forward_fn = make_pipelined_forward_fn(
         embed_fn, block_fn, head_fn, num_microbatches, mesh=mesh,
-        layer_mask=layer_mask, block_aux=block_aux,
+        layer_mask=layer_mask, block_aux=block_aux, act_spec=act_spec,
     )
     if schedule == "1f1b":
         loss_and_grad_fn = make_1f1b_loss_and_grad_fn(
@@ -770,6 +861,7 @@ def make_pipelined_forward_fn(
     mesh: Optional[Mesh] = None,
     layer_mask=None,
     block_aux: bool = False,
+    act_spec: Optional[P] = None,
 ):
     """Forward-only pipeline (the reference's ``InferenceSchedule`` path,
     ``pipeline/model.py:run_eval``): returns ``fn(params, ids) -> outputs``
@@ -782,7 +874,7 @@ def make_pipelined_forward_fn(
     mesh = mesh if mesh is not None else get_mesh()
     pp = mesh.shape[PIPELINE_AXIS]
 
-    stage_fn = _make_stage_fn(block_fn, layer_mask, block_aux)
+    stage_fn = _make_stage_fn(block_fn, layer_mask, block_aux, act_spec)
 
     def forward_fn(params, ids: jax.Array):
         ids_mb = microbatch(ids, num_microbatches, mesh if pp > 1 else None)

@@ -64,25 +64,26 @@ def layers_per_stage(num_layers: int, num_stages: int) -> int:
     return num_layers // num_stages
 
 
-def padded_layer_layout(num_layers: int, num_stages: int) -> Tuple[int, List[int], List[int]]:
-    """Layout for a non-divisible layer count on the stacked engine.
+def layout_from_spans(
+    spans: Sequence[Tuple[int, int]], num_stages: int
+) -> Tuple[int, List[int], List[int]]:
+    """Padded stack layout realizing an arbitrary contiguous stage partition.
 
     The engine's "partition" is a sharding of a homogeneous ``[L', ...]``
-    layer stack over ``pp``; when ``num_layers % num_stages != 0`` the stack
-    is padded to ``L' = ceil(L/P)*P`` rows.  Padded rows hold zero parameters
-    and an ``active=0`` flag: the engine computes them uniformly (SPMD) but
-    selects the identity, so numerics equal the unpadded model exactly and
-    the ``where`` transpose zeroes their gradients.  Real layers fill each
-    stage's leading rows following :func:`partition_uniform` (earlier stages
-    take the extra layers — the reference's ``pipeline_cuts`` convention,
-    reference ``pipeline/partition.py:17-42``).
+    layer stack over ``pp``; ``L' = max-span * P`` with padded rows holding
+    zero parameters and an ``active=0`` flag: the engine computes them
+    uniformly (SPMD) but selects the identity, so numerics equal the
+    unpadded model exactly and the ``where`` transpose zeroes their
+    gradients.  Real layers fill each stage's leading rows.
 
     Returns ``(padded_len, row_of_layer, mask)``: ``row_of_layer[i]`` is the
-    stack row of real layer ``i`` (execution order preserved), ``mask[r]`` is
-    1 for real rows, 0 for padding.
+    stack row of real layer ``i`` (execution order preserved), ``mask[r]``
+    is 1 for real rows, 0 for padding; ``mask is None`` never happens here —
+    callers drop the mask themselves when every span is full.
     """
-    spans = partition_uniform(num_layers, num_stages)
-    per = -(-num_layers // num_stages)  # ceil
+    if len(spans) != num_stages:
+        raise ValueError(f"{len(spans)} spans for {num_stages} stages")
+    per = max(hi - lo for lo, hi in spans)
     padded = per * num_stages
     row_of_layer: List[int] = []
     mask = [0] * padded
@@ -92,3 +93,11 @@ def padded_layer_layout(num_layers: int, num_stages: int) -> Tuple[int, List[int
             row_of_layer.append(row)
             mask[row] = 1
     return padded, row_of_layer, mask
+
+
+def padded_layer_layout(num_layers: int, num_stages: int) -> Tuple[int, List[int], List[int]]:
+    """:func:`layout_from_spans` over the balanced :func:`partition_uniform`
+    spans — the default layout for a non-divisible layer count (earlier
+    stages take the extra layers, the reference's ``pipeline_cuts``
+    convention, reference ``pipeline/partition.py:17-42``)."""
+    return layout_from_spans(partition_uniform(num_layers, num_stages), num_stages)

@@ -212,15 +212,20 @@ def sync_1f1b_head_overhead(
     vocab: int,
     intermediate: Optional[int] = None,
 ) -> float:
-    """Extra compute fraction from the sync engine running the (masked)
-    embedding + LM-head + loss every tick on every rank (engine uniformity).
+    """Per-tick compute imbalance from the LAST stage owning the LM-head.
 
-    Per-tick useful stage compute ≈ ``layers_per_stage`` transformer blocks;
-    the head adds one ``hidden x vocab`` matmul (fwd+bwd).  Per-token fwd
+    The engines run embed/head under ``lax.cond`` on the owning pp rank
+    (pp-uniform predicate — every auto-axis collective channel inside takes
+    one branch), so the head is no longer paid on every rank; what remains
+    is that the last stage's tick costs ``layers_per_stage`` blocks + one
+    ``hidden x vocab`` matmul while the others cost blocks alone, and the
+    synchronous tick waits for the slowest rank.  This function returns that
+    critical-path excess as a fraction of a balanced stage.  Per-token fwd
     matmul FLOPs (MHA): qkv ``6h²`` + o-proj ``2h²`` + mlp ``6hi`` → block =
     ``8h² + 6hi``; head = ``2hV`` (same ratio holds fwd+bwd; attention-core
-    FLOPs are excluded, so this slightly over-states).  ≈8% for 7B/PP4
-    (L=32, h=4096, i=11008, V=32000), ≈1% for 70B/PP4."""
+    FLOPs excluded, so this slightly over-states).  ≈8% for 7B/PP4, ≈1% for
+    70B/PP4 — and removable by giving the last stage fewer layers via
+    ``pipeline_cuts`` (one layer ≈ head when ``2hV ≈ 8h²+6hi``)."""
     i = intermediate if intermediate is not None else 4 * hidden
     lps = num_layers / num_stages
     block = 8 * hidden * hidden + 6 * hidden * i

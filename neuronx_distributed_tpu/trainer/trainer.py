@@ -149,11 +149,13 @@ def initialize_parallel_model(
     ``example_inputs`` are abstract-evaluated only — no compute runs on them.
 
     When ``config.mesh.pipeline_parallel_size > 1`` the module must expose
-    ``build_pipelined(num_microbatches, schedule, seed)`` (the Llama family
-    does); the returned :class:`~..pipeline.engine.PipelinedModel` honors
-    ``config.pipeline.num_microbatches`` / ``config.pipeline.schedule`` —
-    the same one-config contract as the reference's pp>1 branch
-    (``trainer/trainer.py:112-115``)."""
+    ``build_pipelined(num_microbatches, schedule, seed)`` (the Llama and
+    GPT-NeoX families do; ``pipeline_cuts=`` is additionally passed when the
+    config sets it, so only cut-aware builders need accept it); the returned
+    :class:`~..pipeline.engine.PipelinedModel` honors
+    ``config.pipeline.num_microbatches`` / ``config.pipeline.schedule`` /
+    ``config.pipeline.pipeline_cuts`` — the same one-config contract as the
+    reference's pp>1 branch (``trainer/trainer.py:112-115``)."""
     if not mesh_lib.model_parallel_is_initialized():
         mesh_lib.initialize_model_parallel(
             tensor_parallel_size=config.mesh.tensor_parallel_size,
@@ -183,10 +185,12 @@ def initialize_parallel_model(
                 "use a pipeline-capable model family or pp=1"
             )
         pc = config.pipeline
+        extra = {} if pc.pipeline_cuts is None else {"pipeline_cuts": pc.pipeline_cuts}
         pmodel = builder(
             num_microbatches=pc.num_microbatches,
             schedule=pc.schedule,
             seed=config.seed if seed is None else seed,
+            **extra,
         )
         logger.info(
             "initialized pipelined model: %.2fM params, schedule=%s, microbatches=%d",
