@@ -61,7 +61,8 @@ def build_model(args):
     icfg = InferenceConfig(
         batch_size=args.batch_size, context_len=args.context_len,
         max_total_len=args.max_total_len,
-        kv_cache_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+        kv_cache_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        chunked_prefill=getattr(args, "chunked_prefill", False))
     return cfg, module, params, ParallelInferenceModel(module, params, icfg)
 
 
@@ -144,6 +145,10 @@ def main():
             sp.add_argument("--batch-size", type=int, default=1)
             sp.add_argument("--context-len", type=int, default=128)
             sp.add_argument("--max-total-len", type=int, default=256)
+            sp.add_argument("--chunked-prefill", action="store_true",
+                            help="also compile a chunk-prefill executable so "
+                                 "prompts of any multiple of --context-len serve "
+                                 "without re-tracing")
 
     sp = sub.add_parser("trace", help="compile + save a serving artifact")
     common(sp)

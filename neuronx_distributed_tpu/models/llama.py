@@ -42,7 +42,6 @@ from neuronx_distributed_tpu.parallel.layers import (
     shard_activation,
     trailing_spec,
 )
-from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy
 from neuronx_distributed_tpu.parallel.mesh import (
     BATCH_AXES,
     KV_REPLICA_AXIS,

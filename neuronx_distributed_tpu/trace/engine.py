@@ -132,12 +132,20 @@ def parallel_model_trace(
 @dataclasses.dataclass(frozen=True)
 class InferenceConfig:
     """Serving shapes — fixed at trace time, like the reference's compiled
-    context/decode NEFF pair."""
+    context/decode NEFF pair.
+
+    ``chunked_prefill`` compiles a THIRD executable that prefills
+    ``context_len``-sized chunks at a traced cache offset, so prompts of any
+    multiple of ``context_len`` (up to ``max_total_len``) are served by one
+    compiled program instead of one trace per prompt length — the bounded-
+    compile-shape answer to long prompts (the reference would need a new
+    NEFF per context length)."""
 
     batch_size: int
     context_len: int
     max_total_len: int
     kv_cache_dtype: Any = jnp.bfloat16
+    chunked_prefill: bool = False
 
     def __post_init__(self):
         if self.max_total_len < self.context_len:
@@ -197,10 +205,11 @@ class _ServingBase:
             raise ValueError("temperature sampling requires an rng key")
         return _sample_logits(logits, rng, temperature, top_k, top_p)
 
-    def _valid_ctx(self, prompt_lens) -> jax.Array:
-        """Left-padded key-validity mask [B, C] from per-example lengths."""
+    def _valid_ctx(self, prompt_lens, length: Optional[int] = None) -> jax.Array:
+        """Left-padded key-validity mask [B, length] from per-example lengths."""
         cfg = self.config
-        B, C = cfg.batch_size, cfg.context_len
+        B = cfg.batch_size
+        C = cfg.context_len if length is None else length
         if prompt_lens is None:
             return jnp.ones((B, C), jnp.int32)
         lens = jnp.asarray(prompt_lens, jnp.int32)
@@ -263,21 +272,48 @@ class _ServingBase:
         HF-generate driving, ``neuron_modeling_llama.py:437-465``)."""
         cfg = self.config
         B, C = prompt_ids.shape
-        if (B, C) != (cfg.batch_size, cfg.context_len):
+        chunk = cfg.context_len
+        # length bounds are the max_total_len check's job, not the shape check's
+        chunkable = cfg.chunked_prefill and C % chunk == 0
+        if B != cfg.batch_size or (C != chunk and not chunkable):
             raise ValueError(
                 f"prompt shape {(B, C)} does not match traced shape "
-                f"{(cfg.batch_size, cfg.context_len)}"
+                f"{(cfg.batch_size, chunk)}"
+                + (
+                    "" if cfg.chunked_prefill
+                    else " (chunked_prefill=True serves any multiple of context_len)"
+                )
             )
         if C + max_new_tokens > cfg.max_total_len:
             raise ValueError(
                 f"context {C} + new {max_new_tokens} exceeds max_total_len {cfg.max_total_len}"
             )
-        valid = self._valid_ctx(prompt_lens)
-        logits, caches = self.context(self.params, prompt_ids.astype(jnp.int32), valid)
         T = cfg.max_total_len
-        valid_full = jnp.concatenate(
-            [valid, jnp.zeros((B, T - C), jnp.int32)], axis=1
-        )
+        if C == chunk:
+            valid = self._valid_ctx(prompt_lens)
+            logits, caches = self.context(self.params, prompt_ids.astype(jnp.int32), valid)
+            valid_full = jnp.concatenate(
+                [valid, jnp.zeros((B, T - C), jnp.int32)], axis=1
+            )
+        else:
+            # chunked prefill: one compiled chunk program, host loop over
+            # offsets — prompts left-padded to C, validity precomputed over
+            # the whole cache so chunk positions see the global prefix counts
+            if not hasattr(self, "prefill_chunk"):
+                raise ValueError(
+                    "this serving wrapper has no compiled chunk-prefill "
+                    "executable (exported models carry only context/decode); "
+                    "re-trace with InferenceConfig(chunked_prefill=True)"
+                )
+            valid = self._valid_ctx(prompt_lens, C)
+            valid_full = jnp.concatenate([valid, jnp.zeros((B, T - C), jnp.int32)], 1)
+            caches = self.empty_caches()
+            ids = prompt_ids.astype(jnp.int32)
+            for i in range(C // chunk):
+                logits, caches = self.prefill_chunk(
+                    self.params, ids[:, i * chunk:(i + 1) * chunk],
+                    jnp.int32(i * chunk), caches, valid_full,
+                )
         first_rng = jax.random.fold_in(rng, 0) if rng is not None else None
         first = self._sample(logits, first_rng, temperature, top_k, top_p)[:, None]
         if max_new_tokens == 1:
@@ -421,6 +457,30 @@ class ParallelInferenceModel(_ServingBase):
     def _decode_step_traceable(self, params, tok, offset, caches, valid):
         return self._decode_fn(params, tok, offset, caches, valid)
 
+    def empty_caches(self):
+        """Fresh zero KV caches shaped/sharded like the traced ones."""
+        return init_kv_caches(
+            self.num_layers, self.config.batch_size, self.config.max_total_len,
+            self.num_kv_heads, self.head_dim, self.config.kv_cache_dtype,
+        )
+
+    def _prefill_chunk_fn(self, params, ids, offset, caches, valid):
+        """Prefill one ``[B, Cc]`` chunk at (traced) cache ``offset``.
+
+        ``valid [B, T]`` is the whole-cache key-validity mask with the full
+        prompt's (left-padded) validity pre-written and zeros beyond it;
+        chunk token positions are global prefix counts of that mask, so
+        RoPE phases match the one-shot context exactly.  Keys beyond the
+        chunk are causally masked (q_offset = cache offset), so the not-yet-
+        written cache tail contributes nothing."""
+        Cc = ids.shape[1]
+        counts = jnp.cumsum(valid, axis=1) - valid  # valid keys strictly before
+        positions = jax.lax.dynamic_slice_in_dim(counts, offset, Cc, axis=1)
+        logits, caches = self.module.apply(
+            params, ids, positions.astype(jnp.int32), caches, offset, kv_valid=valid
+        )
+        return logits[:, -1, :], caches
+
     def _decode_fn(self, params, tok, offset, caches, valid):
         """One token step; ``valid [B, T]`` tracks key validity over the full
         cache.  Returns the updated mask so callers can thread it."""
@@ -467,6 +527,11 @@ class ParallelInferenceModel(_ServingBase):
         self.decode = self._decode_jit.lower(
             params_spec, tok_spec, off_spec, cache_spec, valid_spec
         ).compile()
+        if cfg.chunked_prefill:
+            self._prefill_chunk_jit = jax.jit(self._prefill_chunk_fn, donate_argnums=(3,))
+            self.prefill_chunk = self._prefill_chunk_jit.lower(
+                params_spec, ids_spec, off_spec, cache_spec, valid_spec
+            ).compile()
         self._loop_cache = {}
         self._arg_specs = (
             params_spec, ids_spec, vctx_spec, tok_spec, off_spec, cache_spec,

@@ -42,7 +42,8 @@ def _data():
 
 def _run(devices, *, tp=1, pp=1, cp=1, kvr=1, sp=False, remat="none",
          zero1=True, dtype="float32", attn="dense", num_mb=1, kv_heads=8,
-         num_layers=2, pipelined=None):
+         num_layers=2, pipelined=None, fsdp=False, cp_impl="ring",
+         num_experts=1, cuts=None):
     """One grid cell.  ``pipelined`` forces the pipelined-model code path
     even at pp=1 (the PP rows' golden: same stacked init, single device)."""
     nxd.destroy_model_parallel()
@@ -54,20 +55,22 @@ def _run(devices, *, tp=1, pp=1, cp=1, kvr=1, sp=False, remat="none",
     )
     cfg = LlamaConfig.tiny(
         vocab_size=VOCAB, num_heads=8, num_kv_heads=kv_heads, num_layers=num_layers,
-        sequence_parallel=sp, remat=remat, attention_impl=attn,
+        sequence_parallel=sp, remat=remat, attention_impl=attn, cp_impl=cp_impl,
+        num_experts=num_experts, moe_capacity_factor=8.0,
         dtype=jnp.dtype(dtype), param_dtype=jnp.float32, max_seq_len=S,
     )
     config = nxd.training_config(
         tensor_parallel_size=tp, pipeline_parallel_size=pp,
         context_parallel_size=cp, kv_size_multiplier=kvr,
-        num_microbatches=num_mb, schedule="1f1b",
-        learning_rate=LR, zero_one_enabled=zero1,
+        num_microbatches=num_mb, schedule="1f1b", pipeline_cuts=cuts,
+        learning_rate=LR, zero_one_enabled=zero1, fsdp=fsdp,
         compute_dtype=dtype, param_dtype="float32",
     )
     use_pipelined = pipelined if pipelined is not None else pp > 1
     if use_pipelined:
         model = LlamaForCausalLM(cfg).build_pipelined(
-            num_microbatches=num_mb, schedule="1f1b", seed=config.seed
+            num_microbatches=num_mb, schedule="1f1b", seed=config.seed,
+            pipeline_cuts=cuts,
         )
         opt = initialize_parallel_optimizer(config, model)
         from neuronx_distributed_tpu.trainer.trainer import make_pipelined_train_step
@@ -109,6 +112,7 @@ def _golden(family: str):
             "gqa4": dict(kv_heads=4),
             "pipelined": dict(pipelined=True),
             "pipelined4": dict(pipelined=True, num_layers=4),
+            "moe": dict(pipelined=True, num_experts=4),
         }[family]
         _GOLDEN_CACHE[family] = _run(devs[:8], **kwargs)
     return _GOLDEN_CACHE[family]
@@ -124,6 +128,11 @@ GRID = {
     "TP2_SP0_SCnone_PP2_Zero1_FP32": ("pipelined", dict(tp=2, pp=2, num_mb=2, zero1=True)),
     "TP1_SP0_SCfull_PP4_Zero1_FP32": ("pipelined4", dict(pp=4, num_mb=4, num_layers=4, remat="full", zero1=True)),
     "TP2_CP2_FLASH_PP1_Zero1_FP32": ("mha", dict(tp=2, cp=2, attn="flash", zero1=True)),
+    # round-3 dimensions: FSDP placement, ulysses CP, uneven cuts, MoE-PP
+    "TP2_FSDP_PP1_Zero1_FP32": ("mha", dict(tp=2, fsdp=True, zero1=True)),
+    "TP2_CP2_ULYSSES_PP1_Zero1_FP32": ("mha", dict(tp=2, cp=2, attn="flash", cp_impl="ulysses", zero1=True)),
+    "TP1_CUTS31_PP2_Zero1_FP32": ("pipelined4", dict(pp=2, num_mb=2, num_layers=4, cuts=(3,), zero1=True)),
+    "TP2_MOE4_PP2_Zero1_FP32": ("moe", dict(tp=2, pp=2, num_mb=2, num_experts=4, zero1=True)),
 }
 
 

@@ -173,3 +173,61 @@ def test_top_p_keeps_the_nucleus():
     # nucleus at p=0.9 = {0, 1, 2}; token 3 excluded; more than one sampled
     assert seen <= {0, 1, 2}, seen
     assert len(seen) >= 2, f"top_p degenerated to deterministic output: {seen}"
+
+
+def test_chunked_prefill_matches_one_shot(devices8):
+    """A 16-token prompt prefilled as two 8-token chunks must generate the
+    same tokens as a model traced for context_len=16 one-shot — including a
+    ragged (left-padded) batch."""
+    initialize_model_parallel(tensor_parallel_size=8, devices=devices8)
+    cfg = LlamaConfig.tiny(
+        sequence_parallel=False, dtype=jnp.float32, param_dtype=jnp.float32,
+        max_seq_len=32, remat="none",
+    )
+    module = LlamaForCausalLM(cfg)
+    params = sharded_params(module.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)))
+
+    chunked = ParallelInferenceModel(
+        module, params,
+        InferenceConfig(batch_size=2, context_len=8, max_total_len=24,
+                        chunked_prefill=True),
+    )
+    oneshot = ParallelInferenceModel(
+        module, params,
+        InferenceConfig(batch_size=2, context_len=16, max_total_len=24),
+    )
+    prompts = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0, cfg.vocab_size)
+
+    out_c = chunked.generate(prompts, max_new_tokens=6)
+    out_o = oneshot.generate(prompts, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out_c), np.asarray(out_o))
+
+    # ragged: lengths 5 and 13, left-padded to 16
+    lens = jnp.asarray([5, 13], jnp.int32)
+    out_cr = chunked.generate(prompts, max_new_tokens=6, prompt_lens=lens)
+    out_or = oneshot.generate(prompts, max_new_tokens=6, prompt_lens=lens)
+    np.testing.assert_array_equal(np.asarray(out_cr), np.asarray(out_or))
+
+    # an 8-token prompt still takes the plain context path
+    out8 = chunked.generate(prompts[:, :8], max_new_tokens=4)
+    assert out8.shape == (2, 12)
+
+
+def test_chunked_prefill_shape_errors(devices8):
+    initialize_model_parallel(tensor_parallel_size=8, devices=devices8)
+    cfg = LlamaConfig.tiny(sequence_parallel=False, dtype=jnp.float32,
+                           param_dtype=jnp.float32, max_seq_len=32, remat="none")
+    module = LlamaForCausalLM(cfg)
+    params = sharded_params(module.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)))
+    plain = ParallelInferenceModel(
+        module, params, InferenceConfig(batch_size=2, context_len=8, max_total_len=24))
+    with pytest.raises(ValueError, match="chunked_prefill"):
+        plain.generate(jnp.zeros((2, 16), jnp.int32), max_new_tokens=2)
+    chunked = ParallelInferenceModel(
+        module, params,
+        InferenceConfig(batch_size=2, context_len=8, max_total_len=24,
+                        chunked_prefill=True))
+    with pytest.raises(ValueError, match="does not match"):
+        chunked.generate(jnp.zeros((2, 12), jnp.int32), max_new_tokens=2)  # not a multiple
+    with pytest.raises(ValueError, match="exceeds max_total_len"):
+        chunked.generate(jnp.zeros((2, 24), jnp.int32), max_new_tokens=4)
