@@ -89,6 +89,14 @@ class LlamaConfig:
     num_experts: int = 1
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # LoRA fine-tuning (peft.py; capability beyond the reference): rank > 0
+    # adds zero-initialized low-rank adapters to the targeted projections.
+    # Targets: "qkv" (q+v, the standard pair), "o_proj", "mlp", "lm_head".
+    # Freeze the base via initialize_parallel_optimizer(trainable=
+    # peft.lora_trainable).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ("qkv",)
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -219,6 +227,8 @@ class LlamaAttention(nn.Module):
             num_kv_heads=cfg.num_kv_heads,
             head_dim=D,
             sequence_parallel=cfg.sequence_parallel,
+            lora_rank=cfg.lora_rank if "qkv" in cfg.lora_targets else 0,
+            lora_alpha=cfg.lora_alpha,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="qkv",
@@ -253,6 +263,8 @@ class LlamaAttention(nn.Module):
             use_bias=False,
             sequence_parallel=cfg.sequence_parallel,
             input_partition_axes=Q_HEAD_AXES,  # attention out is in q-head order
+            lora_rank=cfg.lora_rank if "o_proj" in cfg.lora_targets else 0,
+            lora_alpha=cfg.lora_alpha,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="o_proj",
@@ -271,6 +283,8 @@ class LlamaMLP(nn.Module):
             n_fused=2,  # reference fused gate-up stride=2
             use_bias=False,
             sequence_parallel=cfg.sequence_parallel,
+            lora_rank=cfg.lora_rank if "mlp" in cfg.lora_targets else 0,
+            lora_alpha=cfg.lora_alpha,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="gate_up",
@@ -281,6 +295,8 @@ class LlamaMLP(nn.Module):
             features=cfg.hidden_size,
             use_bias=False,
             sequence_parallel=cfg.sequence_parallel,
+            lora_rank=cfg.lora_rank if "mlp" in cfg.lora_targets else 0,
+            lora_alpha=cfg.lora_alpha,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="down",
@@ -414,6 +430,8 @@ class LlamaForCausalLM(nn.Module):
             features=cfg.vocab_size,
             use_bias=False,
             gather_output=False,  # keep vocab-sharded for the parallel loss
+            lora_rank=cfg.lora_rank if "lm_head" in cfg.lora_targets else 0,
+            lora_alpha=cfg.lora_alpha,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="lm_head",

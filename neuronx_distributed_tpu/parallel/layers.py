@@ -101,6 +101,13 @@ class ColumnParallelLinear(nn.Module):
     gather_output: bool = False
     sequence_parallel: bool = False
     n_fused: int = 1
+    # LoRA (low-rank adaptation): rank > 0 adds a frozen-base-friendly
+    # ``y += (alpha/r) * (x @ A) @ B`` path.  A ``[in, r]`` is replicated,
+    # B follows the kernel's output sharding and starts at ZERO (the adapter
+    # begins as the identity).  Freeze the base with
+    # ``peft.lora_trainable`` + ``initialize_parallel_optimizer(trainable=)``.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     kernel_init: Initializer = nn.initializers.lecun_normal()
@@ -141,6 +148,34 @@ class ColumnParallelLinear(nn.Module):
         # GSPMD insert the Megatron collectives (and their bwd conjugates).
         y = shard_activation(y, trailing_spec(y.ndim, last=TENSOR_AXES))
 
+        if self.lora_rank > 0:
+            r = self.lora_rank
+            a = self.param(
+                "lora_a",
+                nn.with_partitioning(nn.initializers.lecun_normal(), (None, None)),
+                (in_features, r), self.param_dtype,
+            )
+            xa = jnp.einsum("...h,hr->...r", x, jnp.asarray(a, self.dtype),
+                            preferred_element_type=self.dtype)
+            if self.n_fused == 1:
+                b = self.param(
+                    "lora_b",
+                    nn.with_partitioning(nn.initializers.zeros_init(), (None, TENSOR_AXES)),
+                    (r, self.features), self.param_dtype,
+                )
+                delta = jnp.einsum("...r,rp->...p", xa, jnp.asarray(b, self.dtype),
+                                   preferred_element_type=self.dtype)
+            else:
+                b = self.param(
+                    "lora_b",
+                    nn.with_partitioning(nn.initializers.zeros_init(),
+                                         (None, None, TENSOR_AXES)),
+                    (r, self.n_fused, per_fused), self.param_dtype,
+                )
+                delta = jnp.einsum("...r,rfp->...fp", xa, jnp.asarray(b, self.dtype),
+                                   preferred_element_type=self.dtype)
+            y = y + (self.lora_alpha / r) * delta
+
         if self.use_bias:
             if self.n_fused == 1:
                 bias = self.param(
@@ -180,6 +215,10 @@ class RowParallelLinear(nn.Module):
     # q-head order — sharded ('tp','kvr') — so the o_proj sets this to match
     # and no resharding happens between attention and projection.
     input_partition_axes: tuple = TENSOR_AXES
+    # LoRA: A follows the kernel's input sharding (the x @ A contraction gets
+    # the same psum as the base matmul), B is replicated and starts at zero.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     kernel_init: Initializer = nn.initializers.lecun_normal()
@@ -207,6 +246,27 @@ class RowParallelLinear(nn.Module):
             y = shard_activation(y, trailing_spec(y.ndim, seq=SEQUENCE_AXES, last=None))
         else:
             y = shard_activation(y, trailing_spec(y.ndim, last=None))
+        if self.lora_rank > 0:
+            r = self.lora_rank
+            a = self.param(
+                "lora_a",
+                nn.with_partitioning(nn.initializers.lecun_normal(),
+                                     (self.input_partition_axes, None)),
+                (in_features, r), self.param_dtype,
+            )
+            xa = jnp.einsum("...h,hr->...r", x, jnp.asarray(a, self.dtype),
+                            preferred_element_type=self.dtype)
+            # the contraction runs over the sharded dim: replicating the
+            # result makes GSPMD finish the partial sums (same psum as y's)
+            xa = shard_activation(xa, trailing_spec(xa.ndim, last=None))
+            b = self.param(
+                "lora_b",
+                nn.with_partitioning(nn.initializers.zeros_init(), (None, None)),
+                (r, self.features), self.param_dtype,
+            )
+            delta = jnp.einsum("...r,rp->...p", xa, jnp.asarray(b, self.dtype),
+                               preferred_element_type=self.dtype)
+            y = y + (self.lora_alpha / r) * delta
         if self.use_bias:
             # Bias is replicated and added after the reduction (reference adds
             # bias post all-reduce on the full output, layers.py:650-659).

@@ -78,6 +78,11 @@ class GQAQKVColumnParallelLinear(nn.Module):
     head_dim: int
     use_bias: bool = False
     sequence_parallel: bool = False
+    # LoRA on the q/k/v projections: per-projection A ``[in, r]`` replicated,
+    # B shaped/sharded like the projection's head layout, zero-initialized.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ("q", "v")  # the standard LoRA targets
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     kernel_init: Initializer = nn.initializers.lecun_normal()
@@ -115,15 +120,35 @@ class GQAQKVColumnParallelLinear(nn.Module):
         if self.sequence_parallel:
             x = shard_activation(x, trailing_spec(x.ndim, seq=SEQUENCE_AXES))
 
-        def proj(w, head_axes):
+        def proj(w, head_axes, name):
             y = jnp.einsum("...h,hnd->...nd", x, jnp.asarray(w, self.dtype),
                            preferred_element_type=self.dtype)
             # head dim sits at -2 ([..., n_heads, head_dim])
-            return shard_activation(y, trailing_spec(y.ndim, seq=head_axes))
+            y = shard_activation(y, trailing_spec(y.ndim, seq=head_axes))
+            if self.lora_rank > 0 and name in self.lora_targets:
+                r = self.lora_rank
+                n_heads = w.shape[1]
+                a = self.param(
+                    f"lora_a_{name}",
+                    nn.with_partitioning(nn.initializers.lecun_normal(), (None, None)),
+                    (in_features, r), self.param_dtype,
+                )
+                b = self.param(
+                    f"lora_b_{name}",
+                    nn.with_partitioning(nn.initializers.zeros_init(),
+                                         (None, head_axes, None)),
+                    (r, n_heads, self.head_dim), self.param_dtype,
+                )
+                xa = jnp.einsum("...h,hr->...r", x, jnp.asarray(a, self.dtype),
+                                preferred_element_type=self.dtype)
+                delta = jnp.einsum("...r,rnd->...nd", xa, jnp.asarray(b, self.dtype),
+                                   preferred_element_type=self.dtype)
+                y = y + (self.lora_alpha / r) * delta
+            return y
 
-        q = proj(wq, Q_HEAD_AXES)
-        k = proj(wk, KV_HEAD_AXES)
-        v = proj(wv, KV_HEAD_AXES)
+        q = proj(wq, Q_HEAD_AXES, "q")
+        k = proj(wk, KV_HEAD_AXES, "k")
+        v = proj(wv, KV_HEAD_AXES, "v")
 
         if self.use_bias:
             bq = self.param(

@@ -1,0 +1,102 @@
+"""Parameter-efficient fine-tuning (LoRA) utilities.
+
+Capability beyond the reference (which has no PEFT story): the TP layers
+(:class:`~.parallel.layers.ColumnParallelLinear`,
+:class:`~.parallel.layers.RowParallelLinear`,
+:class:`~.parallel.qkv.GQAQKVColumnParallelLinear`) grow ``lora_rank`` /
+``lora_alpha`` knobs adding a zero-initialized low-rank delta
+``y += (alpha/r) * (x @ A) @ B`` whose factors shard consistently with the
+base kernels (B follows the kernel's output sharding, A the input's), so
+LoRA composes with TP/SP/FSDP/ZeRO unchanged.  This module holds the pieces
+around the layers:
+
+- :func:`lora_trainable` — the ``trainable=`` predicate for
+  ``initialize_parallel_optimizer``: train the adapters, freeze the base
+  (frozen params get ``optax.set_to_zero`` and carry NO Adam state — the
+  PEFT memory win is real, not cosmetic);
+- :func:`lora_params` / :func:`strip_lora` — split a params tree into the
+  adapter-only checkpoint and the base;
+- :func:`merge_lora` — fold trained adapters into the base kernels
+  (``kernel += (alpha/r) * A @ B``) producing a dense tree for serving with
+  ``lora_rank=0`` modules.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def lora_trainable(path: str) -> bool:
+    """``trainable=`` predicate: only LoRA adapter params update."""
+    return "lora_" in path
+
+
+def _is_lora_leaf_path(path_keys) -> bool:
+    last = str(getattr(path_keys[-1], "key", path_keys[-1])) if path_keys else ""
+    return "lora_" in last
+
+
+def lora_params(params: Any) -> Any:
+    """The adapter-only subtree (for small LoRA checkpoints): non-adapter
+    leaves are replaced with None (pruned on save by orbax/pytree users)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [leaf if _is_lora_leaf_path(p) else None for p, leaf in flat]
+    )
+
+
+def strip_lora(params: Any) -> Any:
+    """Drop every LoRA leaf — the base-model tree a ``lora_rank=0`` module
+    expects (use after :func:`merge_lora`)."""
+
+    def strip(node):
+        if isinstance(node, dict):
+            return {k: strip(v) for k, v in node.items() if "lora_" not in k}
+        return node
+
+    return strip(params)
+
+
+def merge_lora(params: Any, alpha: float = 16.0) -> Any:
+    """Fold adapters into their base kernels and drop them.
+
+    Handles the two layouts the layers produce: plain linears
+    (``lora_a``/``lora_b`` beside ``kernel``; fused kernels merge through a
+    reshape) and the GQA QKV module (``lora_a_q``/``lora_b_q`` beside
+    ``q_kernel`` etc.).  ``alpha`` must match the modules' ``lora_alpha``.
+    Returns a new tree; pass it to a ``lora_rank=0`` model."""
+
+    def merge_pair(kernel, a, b):
+        # a [..., in, r], b [..., r, *rest], kernel [..., in, *rest] — the
+        # leading dims cover scan_layers/pipeline-stacked [L, ...] params
+        a = np.asarray(jax.device_get(a))
+        bm = np.asarray(jax.device_get(b))
+        k = np.asarray(jax.device_get(kernel))
+        r = a.shape[-1]
+        lead = a.shape[:-2]
+        delta = np.einsum(
+            "...ir,...rk->...ik", a, bm.reshape(*lead, r, -1)
+        ).reshape(k.shape)
+        return (k + (alpha / r) * delta).astype(k.dtype)
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for key, val in node.items():
+            if "lora_" in key:
+                continue  # consumed below
+            out[key] = walk(val)
+        if "lora_a" in node and "lora_b" in node and "kernel" in node:
+            out["kernel"] = merge_pair(node["kernel"], node["lora_a"], node["lora_b"])
+        for t in ("q", "k", "v"):
+            if f"lora_a_{t}" in node and f"{t}_kernel" in node:
+                out[f"{t}_kernel"] = merge_pair(
+                    node[f"{t}_kernel"], node[f"lora_a_{t}"], node[f"lora_b_{t}"]
+                )
+        return out
+
+    return walk(params)

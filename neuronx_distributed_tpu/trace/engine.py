@@ -273,8 +273,9 @@ class _ServingBase:
         cfg = self.config
         B, C = prompt_ids.shape
         chunk = cfg.context_len
-        # length bounds are the max_total_len check's job, not the shape check's
-        chunkable = cfg.chunked_prefill and C % chunk == 0
+        # length bounds are the max_total_len check's job, not the shape
+        # check's; C > 0 guards the degenerate empty prompt
+        chunkable = cfg.chunked_prefill and C > 0 and C % chunk == 0
         if B != cfg.batch_size or (C != chunk and not chunkable):
             raise ValueError(
                 f"prompt shape {(B, C)} does not match traced shape "

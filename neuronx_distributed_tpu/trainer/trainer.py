@@ -231,9 +231,15 @@ def initialize_parallel_optimizer(
     model: ParallelModel,
     tx: Optional[optax.GradientTransformation] = None,
     learning_rate: Optional[Any] = None,
+    trainable: Optional[Callable[[str], bool]] = None,
 ) -> ParallelOptimizer:
     """Create the optimizer with ZeRO-1 state sharding per config
-    (reference ``initialize_parallel_optimizer``, ``trainer/trainer.py:163-178``)."""
+    (reference ``initialize_parallel_optimizer``, ``trainer/trainer.py:163-178``).
+
+    ``trainable`` (a predicate over ``jax.tree_util.keystr`` param paths)
+    freezes everything it rejects: frozen params get ``optax.set_to_zero``
+    updates and carry no optimizer state — the PEFT path
+    (``peft.lora_trainable`` trains only LoRA adapters)."""
     oc = config.optimizer
     if tx is None:
         tx = adamw_fp32(
@@ -242,6 +248,21 @@ def initialize_parallel_optimizer(
             b2=oc.beta2,
             eps=oc.eps,
             weight_decay=oc.weight_decay,
+        )
+    if trainable is not None:
+        labels = jax.tree_util.tree_map_with_path(
+            lambda p, _: "train" if trainable(jax.tree_util.keystr(p)) else "freeze",
+            model.params,
+        )
+        n_train = sum(
+            int(x.size)
+            for x, l in zip(jax.tree.leaves(model.params), jax.tree.leaves(labels))
+            if l == "train"
+        )
+        logger.info("trainable filter active: %.3fM of %.3fM params update",
+                    n_train / 1e6, model.num_parameters() / 1e6)
+        tx = optax.multi_transform(
+            {"train": tx, "freeze": optax.set_to_zero()}, labels
         )
     state_struct = jax.eval_shape(tx.init, model.params)
     state_specs = optimizer_state_specs(

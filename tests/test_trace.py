@@ -231,3 +231,17 @@ def test_chunked_prefill_shape_errors(devices8):
         chunked.generate(jnp.zeros((2, 12), jnp.int32), max_new_tokens=2)  # not a multiple
     with pytest.raises(ValueError, match="exceeds max_total_len"):
         chunked.generate(jnp.zeros((2, 24), jnp.int32), max_new_tokens=4)
+
+
+def test_chunked_prefill_rejects_empty_prompt(devices8):
+    initialize_model_parallel(tensor_parallel_size=8, devices=devices8)
+    cfg = LlamaConfig.tiny(sequence_parallel=False, dtype=jnp.float32,
+                           param_dtype=jnp.float32, max_seq_len=32, remat="none")
+    module = LlamaForCausalLM(cfg)
+    params = sharded_params(module.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)))
+    chunked = ParallelInferenceModel(
+        module, params,
+        InferenceConfig(batch_size=2, context_len=8, max_total_len=24,
+                        chunked_prefill=True))
+    with pytest.raises(ValueError, match="does not match"):
+        chunked.generate(jnp.zeros((2, 0), jnp.int32), max_new_tokens=2)
