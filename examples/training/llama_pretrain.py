@@ -115,6 +115,9 @@ def main():
         num_microbatches=args.microbatches,
         schedule=args.pp_schedule,
         learning_rate=args.lr,
+        lr_schedule="cosine",
+        warmup_steps=args.warmup_steps,
+        total_steps=max(args.steps, args.warmup_steps + 1),
         zero_one_enabled=not args.no_zero1,
         compute_dtype="bfloat16" if (args.bf16 or on_tpu) else "float32",
         param_dtype="float32",
@@ -134,11 +137,8 @@ def main():
         config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, args.seq_len), jnp.int32),),
         seed=args.seed,
     )
-    import optax
-
-    schedule = optax.warmup_cosine_decay_schedule(
-        0.0, args.lr, args.warmup_steps, max(args.steps, args.warmup_steps + 1))
-    opt = initialize_parallel_optimizer(config, model, learning_rate=schedule)
+    # warmup-cosine comes from the config contract (OptimizerConfig.lr_schedule)
+    opt = initialize_parallel_optimizer(config, model)
     step_fn = make_train_step(
         config, model, opt, causal_lm_loss,
         batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()},

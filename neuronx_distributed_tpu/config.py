@@ -22,6 +22,15 @@ class OptimizerConfig:
     grad_clipping: bool = True
     max_grad_norm: float = 1.0
     learning_rate: float = 3e-4
+    # LR schedule (the reference's get_linear_schedule_with_warmup,
+    # tp_zero1_llama2_7b_hf_pretrain.py:465): "constant" | "linear" |
+    # "cosine"; decaying schedules need total_steps and bottom out at
+    # min_lr_ratio * learning_rate.  Resume needs no scheduler blob — the
+    # schedule reads the optimizer's own checkpointed step count.
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    total_steps: Optional[int] = None
+    min_lr_ratio: float = 0.0
     weight_decay: float = 0.01
     beta1: float = 0.9
     beta2: float = 0.95

@@ -1,10 +1,10 @@
 """Optimizers: ZeRO-1 state sharding + fp32-state AdamW (reference ``optimizer/``)."""
 
-from neuronx_distributed_tpu.optimizer.adamw_fp32 import adamw_fp32
+from neuronx_distributed_tpu.optimizer.adamw_fp32 import adamw_fp32, build_lr_schedule
 from neuronx_distributed_tpu.optimizer.zero1 import (
     optimizer_state_specs,
     shard_optimizer_state,
     zero1_spec,
 )
 
-__all__ = ["adamw_fp32", "optimizer_state_specs", "shard_optimizer_state", "zero1_spec"]
+__all__ = ["adamw_fp32", "build_lr_schedule", "optimizer_state_specs", "shard_optimizer_state", "zero1_spec"]

@@ -37,3 +37,51 @@ def adamw_fp32(
         mu_dtype=jnp.float32,
         mask=mask,
     )
+
+
+def build_lr_schedule(
+    learning_rate: float,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    total_steps: Optional[int] = None,
+    min_lr_ratio: float = 0.0,
+) -> Union[float, optax.Schedule]:
+    """LR schedule from config knobs — the reference drives its examples
+    with ``get_linear_schedule_with_warmup``
+    (``tp_zero1_llama2_7b_hf_pretrain.py:465``) and checkpoints the scheduler
+    separately; here the schedule is a pure function of the optimizer's own
+    step count, so checkpoint/resume needs no scheduler blob at all (the
+    count rides in the Adam state).
+
+    ``schedule``: "constant" | "linear" (warmup then linear decay to
+    ``min_lr_ratio * lr``) | "cosine" (warmup then cosine decay to the same
+    floor).  ``total_steps`` is required for the decaying schedules.
+    """
+    if schedule == "constant" and warmup_steps == 0:
+        return learning_rate
+    floor = learning_rate * min_lr_ratio
+    warmup = optax.linear_schedule(
+        init_value=0.0 if warmup_steps else learning_rate,
+        end_value=learning_rate, transition_steps=max(warmup_steps, 1),
+    )
+    if schedule == "constant":
+        decay = optax.constant_schedule(learning_rate)
+    elif schedule in ("linear", "cosine"):
+        if total_steps is None:
+            raise ValueError(f"lr_schedule={schedule!r} requires total_steps")
+        decay_steps = max(total_steps - warmup_steps, 1)
+        if schedule == "linear":
+            decay = optax.linear_schedule(
+                init_value=learning_rate, end_value=floor,
+                transition_steps=decay_steps,
+            )
+        else:
+            decay = optax.cosine_decay_schedule(
+                init_value=learning_rate, decay_steps=decay_steps,
+                alpha=min_lr_ratio,
+            )
+    else:
+        raise ValueError(
+            f"unknown lr_schedule {schedule!r} (constant | linear | cosine)"
+        )
+    return optax.join_schedules([warmup, decay], boundaries=[warmup_steps])

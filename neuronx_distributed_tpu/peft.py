@@ -49,8 +49,10 @@ def lora_params(params: Any) -> Any:
 
 
 def strip_lora(params: Any) -> Any:
-    """Drop every LoRA leaf — the base-model tree a ``lora_rank=0`` module
-    expects (use after :func:`merge_lora`)."""
+    """Discard the adapters WITHOUT merging — the original base-model tree a
+    ``lora_rank=0`` module expects (abandoning a fine-tune; after
+    :func:`merge_lora` there is nothing left to strip — it already drops the
+    adapter leaves)."""
 
     def strip(node):
         if isinstance(node, dict):
@@ -60,13 +62,14 @@ def strip_lora(params: Any) -> Any:
     return strip(params)
 
 
-def merge_lora(params: Any, alpha: float = 16.0) -> Any:
+def merge_lora(params: Any, alpha: float) -> Any:
     """Fold adapters into their base kernels and drop them.
 
     Handles the two layouts the layers produce: plain linears
     (``lora_a``/``lora_b`` beside ``kernel``; fused kernels merge through a
     reshape) and the GQA QKV module (``lora_a_q``/``lora_b_q`` beside
-    ``q_kernel`` etc.).  ``alpha`` must match the modules' ``lora_alpha``.
+    ``q_kernel`` etc.).  ``alpha`` is REQUIRED and must equal the modules'
+    ``lora_alpha`` — a wrong value silently mis-scales every merged kernel.
     Returns a new tree; pass it to a ``lora_rank=0`` model."""
 
     def merge_pair(kernel, a, b):

@@ -66,6 +66,10 @@ class ParallelOptimizer:
     state: Any
     state_specs: Any
     mesh: Mesh
+    # bool tree marking trainable params (None = all).  The train step zeroes
+    # frozen grads BEFORE grad-norm/clipping, so a frozen base can never
+    # leak into the clip scale applied to the trainable (e.g. LoRA) updates.
+    update_mask: Any = None
 
     @property
     def state_shardings(self):
@@ -242,8 +246,18 @@ def initialize_parallel_optimizer(
     (``peft.lora_trainable`` trains only LoRA adapters)."""
     oc = config.optimizer
     if tx is None:
+        from neuronx_distributed_tpu.optimizer.adamw_fp32 import build_lr_schedule
+
+        lr = (
+            learning_rate
+            if learning_rate is not None
+            else build_lr_schedule(
+                oc.learning_rate, oc.lr_schedule, oc.warmup_steps,
+                oc.total_steps, oc.min_lr_ratio,
+            )
+        )
         tx = adamw_fp32(
-            learning_rate if learning_rate is not None else oc.learning_rate,
+            lr,
             b1=oc.beta1,
             b2=oc.beta2,
             eps=oc.eps,
@@ -264,6 +278,9 @@ def initialize_parallel_optimizer(
         tx = optax.multi_transform(
             {"train": tx, "freeze": optax.set_to_zero()}, labels
         )
+        update_mask = jax.tree.map(lambda l: l == "train", labels)
+    else:
+        update_mask = None
     state_struct = jax.eval_shape(tx.init, model.params)
     state_specs = optimizer_state_specs(
         state_struct, model.params, model.param_specs, zero1=oc.zero_one_enabled, mesh=model.mesh
@@ -272,7 +289,8 @@ def initialize_parallel_optimizer(
         lambda s: NamedSharding(model.mesh, s), state_specs, is_leaf=lambda x: isinstance(x, P)
     )
     state = jax.jit(tx.init, out_shardings=state_shardings)(model.params)
-    return ParallelOptimizer(tx=tx, state=state, state_specs=state_specs, mesh=model.mesh)
+    return ParallelOptimizer(tx=tx, state=state, state_specs=state_specs,
+                             mesh=model.mesh, update_mask=update_mask)
 
 
 def _batch_shardings(mesh: Mesh, batch_spec: Any):
@@ -375,8 +393,14 @@ def make_train_step(
         return loss_sum * scale, jax.tree.map(
             lambda g, p: (g * scale).astype(p.dtype), grads, params)
 
+    mask = optimizer.update_mask
+
     def _step(params, opt_state, batch, rng):
         loss, grads = _loss_and_grad(params, batch, rng)
+        if mask is not None:
+            # frozen grads must not shape the clip norm (PEFT correctness)
+            grads = jax.tree.map(
+                lambda m, g: g if m else jnp.zeros_like(g), mask, grads)
         if oc.grad_clipping:
             grads, grad_norm = clip_grad_norm(grads, oc.max_grad_norm)
         else:
@@ -426,12 +450,17 @@ def make_pipelined_train_step(
         def loss_and_grad(p, ids, labels):
             return jax.value_and_grad(model.loss_fn, has_aux=True)(p, ids, labels)
 
+    mask = optimizer.update_mask
+
     def _step(params, opt_state, batch, rng):
         (loss_sum, tok), grads = loss_and_grad(params, batch["ids"], batch["labels"])
         tok = jnp.maximum(tok, 1.0)
         loss = loss_sum / tok
         # d(mean)/dp = d(sum)/dp / tok — tok depends only on the labels
         grads = jax.tree.map(lambda g: (g / tok).astype(g.dtype), grads)
+        if mask is not None:
+            grads = jax.tree.map(
+                lambda m, g: g if m else jnp.zeros_like(g), mask, grads)
         if oc.grad_clipping:
             grads, grad_norm = clip_grad_norm(grads, oc.max_grad_norm)
         else:

@@ -138,3 +138,46 @@ def test_merge_lora_scan_layers_stacked(devices8):
     lg_merged = jax.jit(LlamaForCausalLM(cfg0).apply)(merged, ids)
     np.testing.assert_allclose(np.asarray(lg_merged), np.asarray(lg_adapted),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_strip_lora_recovers_base(devices8):
+    """strip_lora discards adapters without merging: the stripped tree is
+    the untouched base model."""
+    cfg0, cfgL, config, model = _models(devices8)
+    params = jax.tree.map(np.asarray, model.params)
+    params = jax.tree_util.tree_map_with_path(
+        lambda p, x: x + 0.5 if "lora_b" in jax.tree_util.keystr(p) else x, params)
+    stripped = peft.strip_lora(params)
+    assert not any("lora_" in jax.tree_util.keystr(p)
+                   for p, _ in jax.tree_util.tree_flatten_with_path(stripped)[0])
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, cfg0.vocab_size)
+    lg_s = jax.jit(LlamaForCausalLM(cfg0).apply)(stripped, ids)
+    base = initialize_parallel_model(
+        config, lambda: LlamaForCausalLM(cfg0), (jnp.zeros((1, 16), jnp.int32),))
+    lg_b = jax.jit(base.apply)(base.params, ids)
+    np.testing.assert_array_equal(np.asarray(lg_s), np.asarray(lg_b))
+
+
+def test_frozen_grads_do_not_shape_clip_norm(devices8):
+    """With trainable= set, the reported grad_norm is the ADAPTER-only norm —
+    the frozen base's gradients must not scale adapter updates."""
+    cfg0, cfgL, config, model = _models(devices8)
+    opt = initialize_parallel_optimizer(config, model, trainable=peft.lora_trainable)
+    assert opt.update_mask is not None
+    step = make_train_step(
+        config, model, opt, causal_lm_loss,
+        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()})
+    ids = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, cfg0.vocab_size)
+    batch = {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+
+    # oracle first — the train step DONATES params
+    grads = jax.jit(jax.grad(
+        lambda p: causal_lm_loss(model.module, p, batch)))(model.params)
+    flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+    sq = lambda leaves: float(sum(jnp.sum(jnp.square(x)) for x in leaves)) ** 0.5
+    adapter_norm = sq([g for p, g in flat if "lora_" in jax.tree_util.keystr(p)])
+    full_norm = sq([g for _, g in flat])
+    assert full_norm > adapter_norm * 1.2  # the base carries real extra mass
+
+    _, _, m = step(model.params, opt.state, batch, jax.random.PRNGKey(0))
+    assert float(m["grad_norm"]) == pytest.approx(adapter_norm, rel=1e-4)

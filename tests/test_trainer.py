@@ -172,3 +172,76 @@ def test_eval_step_matches_loss(config):
     _, _, m_train = step(jax.tree.map(jnp.copy, model.params),
                          jax.tree.map(jnp.copy, opt.state), batch, None)
     assert float(m_eval["loss"]) == pytest.approx(float(m_train["loss"]), rel=1e-6)
+
+
+def test_lr_schedules(devices8):
+    """build_lr_schedule shapes: warmup ramp, linear/cosine decay floors,
+    and the config contract errors (the reference's
+    get_linear_schedule_with_warmup counterpart)."""
+    import pytest as _pytest
+    from neuronx_distributed_tpu.optimizer import build_lr_schedule
+
+    lin = build_lr_schedule(1.0, "linear", warmup_steps=10, total_steps=110,
+                            min_lr_ratio=0.1)
+    assert float(lin(0)) == 0.0
+    assert float(lin(10)) == _pytest.approx(1.0)
+    assert float(lin(110)) == _pytest.approx(0.1)
+    cos = build_lr_schedule(1.0, "cosine", warmup_steps=10, total_steps=110,
+                            min_lr_ratio=0.1)
+    assert float(cos(10)) == _pytest.approx(1.0)
+    assert float(cos(110)) == _pytest.approx(0.1, rel=1e-3)
+    assert float(cos(60)) < 1.0
+    assert build_lr_schedule(1.0, "constant") == 1.0
+    warm = build_lr_schedule(1.0, "constant", warmup_steps=5)
+    assert float(warm(0)) == 0.0 and float(warm(7)) == 1.0
+    with _pytest.raises(ValueError, match="total_steps"):
+        build_lr_schedule(1.0, "cosine")
+    with _pytest.raises(ValueError, match="unknown lr_schedule"):
+        build_lr_schedule(1.0, "bogus", total_steps=10)
+
+
+def test_lr_schedule_resumes_from_opt_state(devices8):
+    """The schedule reads the optimizer's checkpointed count: training K
+    steps, snapshotting the opt state, and continuing must apply the SAME
+    per-step learning rates as an uninterrupted run (no scheduler blob)."""
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, causal_lm_loss
+
+    nxd.destroy_model_parallel()
+    nxd.initialize_model_parallel(tensor_parallel_size=2, devices=devices8)
+    cfg = LlamaConfig.tiny(sequence_parallel=False, remat="none",
+                           dtype=jnp.float32, param_dtype=jnp.float32)
+    config = nxd.training_config(
+        tensor_parallel_size=2, learning_rate=1e-2, lr_schedule="linear",
+        warmup_steps=2, total_steps=8, compute_dtype="float32",
+    )
+    def fresh():
+        model = initialize_parallel_model(
+            config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, 16), jnp.int32),))
+        opt = initialize_parallel_optimizer(config, model)
+        step = make_train_step(
+            config, model, opt, causal_lm_loss,
+            batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()})
+        return model, opt, step
+
+    ids = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, cfg.vocab_size)
+    batch = {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+
+    model, opt, step = fresh()
+    p1, s1 = model.params, opt.state
+    for i in range(6):
+        p1, s1, _ = step(p1, s1, batch, jax.random.PRNGKey(i))
+    p1 = jax.tree.map(np.asarray, p1)
+
+    model, opt, step = fresh()
+    p2, s2 = model.params, opt.state
+    for i in range(3):
+        p2, s2, _ = step(p2, s2, batch, jax.random.PRNGKey(i))
+    # "resume": round-trip the state through host memory (what the
+    # checkpoint does) and keep going
+    p2 = jax.tree.map(jnp.asarray, jax.tree.map(np.asarray, p2))
+    s2 = jax.tree.map(lambda x: jnp.asarray(np.asarray(x)), s2)
+    for i in range(3, 6):
+        p2, s2, _ = step(p2, s2, batch, jax.random.PRNGKey(i))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6, atol=1e-7),
+        p1, p2)
