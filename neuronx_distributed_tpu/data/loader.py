@@ -175,6 +175,16 @@ class TokenDataset:
             return 0
         return (self.num_tokens - 1) // seq_len
 
+    def max_token_id(self) -> int:
+        """Largest token id in the file (one mmap scan, cached).  Launchers
+        validate this against the model's vocab_size: an out-of-range id
+        otherwise surfaces as a silent NaN loss (the vocab-parallel CE's
+        psum-MAX eats the bad one-hot)."""
+        if not hasattr(self, "_max_token"):
+            data = self._np_tokens if self._np_tokens is not None else read_token_file(self.path)
+            self._max_token = int(data.max()) if data.size else 0
+        return self._max_token
+
     def close(self):
         if self._handle is not None:
             self._lib.nxd_close(self._handle)

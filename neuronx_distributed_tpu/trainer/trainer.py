@@ -22,7 +22,7 @@ from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from neuronx_distributed_tpu.config import TrainingConfig
-from neuronx_distributed_tpu.optimizer.adamw_fp32 import adamw_fp32
+from neuronx_distributed_tpu.optimizer.adamw_fp32 import adamw_fp32, build_lr_schedule
 from neuronx_distributed_tpu.optimizer.zero1 import optimizer_state_specs
 from neuronx_distributed_tpu.parallel.grads import clip_grad_norm
 from neuronx_distributed_tpu.parallel import mesh as mesh_lib
@@ -246,8 +246,6 @@ def initialize_parallel_optimizer(
     (``peft.lora_trainable`` trains only LoRA adapters)."""
     oc = config.optimizer
     if tx is None:
-        from neuronx_distributed_tpu.optimizer.adamw_fp32 import build_lr_schedule
-
         lr = (
             learning_rate
             if learning_rate is not None

@@ -2,6 +2,8 @@
 DP-partition correctness, determinism, resume-skip, and bit-identical parity
 between the native and numpy paths (same splitmix64 Fisher-Yates)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -223,3 +225,64 @@ def test_pack_documents_mask_separators():
         [np.array([1, 2, 3])], seq_len=8, eos_id=99, mask_separators=True)
     # position predicting EOS is masked; the EOS position always is
     np.testing.assert_array_equal(labels[0][:4], [2, 3, IGNORE, IGNORE])
+
+
+def test_build_nxdt_cli_roundtrip(tmp_path):
+    """tools/build_nxdt.py: text -> NXDT -> TokenDataset -> loader batches."""
+    import json
+    import subprocess
+    import sys
+
+    src = tmp_path / "corpus.txt"
+    src.write_text("hello world\nthe quick brown fox\n" * 20, encoding="utf-8")
+    out = tmp_path / "corpus.nxdt"
+    proc = subprocess.run(
+        [sys.executable, "tools/build_nxdt.py", str(src), "--out", str(out),
+         "--tokenizer", "bytes"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr
+    meta = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert meta["documents"] == 40 and meta["eos_id"] == 256
+
+    from neuronx_distributed_tpu.data import TokenDataLoader, TokenDataset
+    from neuronx_distributed_tpu.data.loader import read_token_file
+
+    toks = read_token_file(str(out))
+    assert toks.size == meta["tokens"]
+    assert int(toks.max()) == 256  # eos
+    ds = TokenDataset(str(out))
+    loader = TokenDataLoader(ds, batch_size=2, seq_len=16, seed=0)
+    loader.set_epoch(0)
+    b = next(iter(loader))
+    assert b["ids"].shape == (2, 16) and b["labels"].shape == (2, 16)
+    ds.close()
+
+
+def test_build_nxdt_jsonl(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    src = tmp_path / "docs.jsonl"
+    src.write_text("\n".join(json.dumps({"text": f"doc {i}"}) for i in range(5)),
+                   encoding="utf-8")
+    out = tmp_path / "docs.nxdt"
+    proc = subprocess.run(
+        [sys.executable, "tools/build_nxdt.py", str(src), "--out", str(out),
+         "--tokenizer", "bytes"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout.strip().splitlines()[-1])["documents"] == 5
+
+
+def test_max_token_id(tmp_path):
+    path = str(tmp_path / "t.nxdt")
+    write_token_file(path, np.asarray([3, 7, 255, 2], np.int64))
+    ds = TokenDataset(path)
+    assert ds.max_token_id() == 255
+    assert ds.max_token_id() == 255  # cached path
+    ds.close()

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Build an NXDT token file from raw text — the preprocessing step the
+reference delegates to HF `datasets` arrow pipelines
+(`tp_zero1_llama2_7b_hf_pretrain.py` loads a pre-tokenized dataset dir).
+
+Inputs: one or more text / jsonl files (one document per line; jsonl uses the
+"text" field).  Tokenizer: any local HF tokenizer directory/file via
+`--tokenizer` (transformers is in the image), or the zero-dependency
+`--tokenizer bytes` fallback (utf-8 byte-level ids, vocab 256 + eos) for
+smoke tests and synthetic corpora.  Documents are joined with the eos token —
+`TokenDataLoader` chunks the stream, `data.packing` can re-segment it.
+
+  python tools/build_nxdt.py --out corpus.nxdt --tokenizer bytes a.txt b.txt
+  python tools/build_nxdt.py --out c.nxdt --tokenizer /path/to/tok c.jsonl
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def iter_documents(paths):
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                if path.endswith(".jsonl"):
+                    doc = json.loads(line).get("text", "")
+                    if doc:
+                        yield doc
+                else:
+                    yield line
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("inputs", nargs="+", help="text or jsonl files")
+    p.add_argument("--out", required=True, help="output .nxdt path")
+    p.add_argument("--tokenizer", default="bytes",
+                   help="'bytes' or a local HF tokenizer path")
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="override the eos id (default: tokenizer's, or 256 for bytes)")
+    args = p.parse_args()
+
+    from neuronx_distributed_tpu.data.loader import write_token_file
+
+    if args.tokenizer == "bytes":
+        eos = 256 if args.eos_id is None else args.eos_id
+
+        def encode(doc):
+            return np.frombuffer(doc.encode("utf-8"), np.uint8).astype(np.int64)
+    else:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(args.tokenizer)
+        if args.eos_id is not None:
+            eos = args.eos_id
+        elif tok.eos_token_id is not None:
+            eos = tok.eos_token_id
+        else:
+            raise SystemExit(
+                "tokenizer has no eos_token_id; pass --eos-id explicitly "
+                "(a silent default would corrupt document boundaries)"
+            )
+
+        def encode(doc):
+            return np.asarray(tok.encode(doc, add_special_tokens=False), np.int64)
+
+    # per-doc numpy pieces + one concatenate: ~int64-array memory, not a
+    # Python list of ints (20-30x larger) — corpora are big
+    pieces = []
+    eos_piece = np.asarray([eos], np.int64)
+    n_docs = 0
+    for doc in iter_documents(args.inputs):
+        pieces.append(encode(doc))
+        pieces.append(eos_piece)
+        n_docs += 1
+    if not pieces:
+        raise SystemExit("no documents found in the inputs")
+    tokens = np.concatenate(pieces)
+    write_token_file(args.out, tokens)
+    print(json.dumps({
+        "out": args.out, "documents": n_docs, "tokens": int(tokens.size),
+        "vocab_max": int(tokens.max()), "eos_id": eos,
+    }))
+
+
+if __name__ == "__main__":
+    main()
