@@ -45,8 +45,11 @@ PEAK_FLOPS = {
 # flash-without-remat leads: flash attention never materializes the [S,S]
 # score matrix, so the 438M bench model's activations fit HBM un-remated and
 # the recompute FLOPs remat would add (not counted by the MFU formula's
-# 6*params accounting) are simply not spent.
+# 6*params accounting) are simply not spent.  A batch-16 rung tops the
+# ladder (selective remat to be HBM-safe): the measured 0.33-MFU b8 number
+# left MXU headroom, and bigger batches amortize per-step overheads.
 LADDER = [
+    ("tpu", "flash", 16, "selective"),
     ("tpu", "flash", 8, "none"),
     ("tpu", "flash", 8, "selective"),
     ("tpu", "flash", 4, "selective"),

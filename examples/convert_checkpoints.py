@@ -77,21 +77,26 @@ def _family(args):
     jax.config.update("jax_platforms", args.platform)
     from neuronx_distributed_tpu import convert as C
 
+    def build_cfg(cls):
+        if not args.config:
+            return cls()
+        if args.config.endswith(".json") or os.path.exists(args.config):
+            with open(args.config) as f:
+                return cls(**json.load(f))
+        return getattr(cls, args.config)()
+
     if args.family == "llama":
         from neuronx_distributed_tpu.models.llama import LlamaConfig
 
-        cfg = getattr(LlamaConfig, args.config)() if args.config else LlamaConfig()
-        return cfg, C.llama_params_from_hf, C.llama_params_to_hf
+        return build_cfg(LlamaConfig), C.llama_params_from_hf, C.llama_params_to_hf
     if args.family == "gpt_neox":
         from neuronx_distributed_tpu.models.gpt_neox import GPTNeoXConfig
 
-        cfg = getattr(GPTNeoXConfig, args.config)() if args.config else GPTNeoXConfig()
-        return cfg, C.gpt_neox_params_from_hf, C.gpt_neox_params_to_hf
+        return build_cfg(GPTNeoXConfig), C.gpt_neox_params_from_hf, C.gpt_neox_params_to_hf
     if args.family == "bert":
         from neuronx_distributed_tpu.models.bert import BertConfig
 
-        cfg = getattr(BertConfig, args.config)() if args.config else BertConfig()
-        return cfg, C.bert_params_from_hf, C.bert_params_to_hf
+        return build_cfg(BertConfig), C.bert_params_from_hf, C.bert_params_to_hf
     raise ValueError(f"unknown family {args.family}")
 
 
@@ -118,6 +123,33 @@ def cmd_to_hf(args):
     params = ocp.Checkpointer(ocp.StandardCheckpointHandler()).restore(
         os.path.join(os.path.abspath(args.ckpt), "model")
     )
+    if "layers" in params and "head" in params:
+        # pipeline-engine checkpoint ({embed, layers: stacked, head}): flatten
+        # through layer_rows (uneven cuts / padding) to the standard tree
+        import neuronx_distributed_tpu.convert as C
+
+        stack_rows = next(iter(_leaves(params["layers"]))).shape[0]
+        if args.layer_rows is None:
+            if stack_rows != cfg.num_layers:
+                raise SystemExit(
+                    f"pipelined stack has {stack_rows} rows but the config has "
+                    f"{cfg.num_layers} layers (uneven pipeline_cuts / padding): "
+                    "pass --layer-rows with the PipelinedModel.layer_rows "
+                    "mapping — an identity default would export padding rows "
+                    "as layers")
+            rows = list(range(cfg.num_layers))
+        else:
+            rows = [int(r) for r in args.layer_rows.split(",")]
+            if len(rows) != cfg.num_layers or (rows and max(rows) >= stack_rows):
+                raise SystemExit(
+                    f"--layer-rows must list {cfg.num_layers} rows < {stack_rows}")
+        flat = {
+            "llama": C.llama_params_from_pipelined,
+            "gpt_neox": C.gpt_neox_params_from_pipelined,
+        }.get(args.family)
+        if flat is None:
+            raise SystemExit(f"pipelined checkpoints unsupported for {args.family}")
+        params = flat(params, rows)
     sd = to_hf(params, cfg)
     _save_hf_state_dict(sd, args.out)
     print(json.dumps({"tensors": len(sd), "out": args.out}))
@@ -138,6 +170,8 @@ def main():
         sp = sub.add_parser(name)
         sp.add_argument("--family", required=True, choices=["llama", "gpt_neox", "bert"])
         sp.add_argument("--config", default=None,
+                        # a preset name (tiny, llama2_7b, ...) or a JSON file
+                        # of config-field overrides
                         help="preset name on the family config (e.g. llama2_7b, tiny)")
         sp.add_argument("--platform", default="cpu",
                         help="jax platform for the conversion (default cpu)")
@@ -146,6 +180,10 @@ def main():
             sp.add_argument("--hf", required=True, help="HF model directory")
         else:
             sp.add_argument("--ckpt", required=True, help="framework checkpoint tag dir")
+            sp.add_argument("--layer-rows", default=None,
+                            help="comma-separated stack row of each real layer for "
+                                 "pipeline-engine checkpoints with uneven cuts / "
+                                 "padding (default: identity 0..num_layers-1)")
         sp.set_defaults(fn=fn)
     args = p.parse_args()
     sys.exit(args.fn(args))

@@ -83,11 +83,7 @@ def main():
         from neuronx_distributed_tpu.data import TokenDataLoader, TokenDataset
 
         ds = TokenDataset(args.data)
-        if ds.max_token_id() >= cfg.vocab_size:
-            raise SystemExit(
-                f"data file {args.data} contains token id {ds.max_token_id()} "
-                f">= model vocab_size {cfg.vocab_size}; rebuild the data or "
-                "pick a larger-vocab preset (out-of-range ids train to NaN)")
+        ds.validate_vocab(cfg.vocab_size)
         loader = TokenDataLoader(ds, args.batch_size,
                                  args.seq_len, seed=args.seed)
         loader.set_epoch(0)

@@ -158,11 +158,7 @@ def main():
         from neuronx_distributed_tpu.data import TokenDataLoader, TokenDataset
 
         ds = TokenDataset(args.data)
-        if ds.max_token_id() >= cfg.vocab_size:
-            raise SystemExit(
-                f"data file {args.data} contains token id {ds.max_token_id()} "
-                f">= model vocab_size {cfg.vocab_size}; rebuild the data or "
-                "pick a larger-vocab preset (out-of-range ids train to NaN)")
+        ds.validate_vocab(cfg.vocab_size)
         loader = TokenDataLoader(
             ds, batch_size=args.batch_size, seq_len=args.seq_len,
             dp_rank=0, dp_size=1, seed=args.seed)  # single-controller: full batch

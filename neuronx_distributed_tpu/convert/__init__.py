@@ -5,8 +5,10 @@ from neuronx_distributed_tpu.convert.hf import (  # noqa: F401
     bert_params_from_hf,
     bert_params_to_hf,
     gpt_neox_params_from_hf,
+    gpt_neox_params_from_pipelined,
     gpt_neox_params_to_hf,
     llama_params_from_hf,
+    llama_params_from_pipelined,
     llama_params_to_hf,
     llama_stack_layers,
     llama_unstack_layers,

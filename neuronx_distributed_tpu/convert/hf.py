@@ -375,3 +375,42 @@ def bert_params_to_hf(params: Mapping[str, Any], cfg) -> Dict[str, np.ndarray]:
             "cls.seq_relationship.bias": _np(tree["nsp_classifier"]["bias"]),
         })
     return out
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-engine checkpoints
+# ---------------------------------------------------------------------------
+#
+# The PP engine's param tree is {"embed": ..., "layers": stacked [L', ...],
+# "head": {...}} with layer_rows mapping real layer i to its stack row
+# (padded rows from non-divisible counts / pipeline_cuts hold zeros and are
+# dropped here).  These rebuild the standard per-layer module tree so the
+# HF exporters above — and plain pp=1 serving — consume PP-trained
+# checkpoints directly.
+
+
+def llama_params_from_pipelined(pparams: Mapping[str, Any], layer_rows) -> Dict[str, Any]:
+    """Pipelined-Llama engine tree → the ``LlamaForCausalLM`` param tree."""
+    model: Dict[str, Any] = {"embed": jax.tree.map(_np, dict(pparams["embed"]))}
+    head = dict(pparams["head"])
+    model["final_norm"] = jax.tree.map(_np, head["final_norm"])
+    # one device->host transfer of the stack; per-row numpy views after
+    stacked = jax.tree.map(_np, pparams["layers"])
+    for i, row in enumerate(layer_rows):
+        model[f"layer_{i}"] = jax.tree.map(lambda x, r=row: x[r], stacked)
+    return {"params": {"model": model,
+                       "lm_head": jax.tree.map(_np, head["lm_head"])}}
+
+
+def gpt_neox_params_from_pipelined(pparams: Mapping[str, Any], layer_rows) -> Dict[str, Any]:
+    """Pipelined-GPT-NeoX engine tree → the ``GPTNeoXForCausalLM`` tree."""
+    head = dict(pparams["head"])
+    out: Dict[str, Any] = {
+        "embed_in": jax.tree.map(_np, dict(pparams["embed"])),
+        "final_norm": jax.tree.map(_np, head["final_norm"]),
+        "embed_out": jax.tree.map(_np, head["embed_out"]),
+    }
+    stacked = jax.tree.map(_np, pparams["layers"])
+    for i, row in enumerate(layer_rows):
+        out[f"layer_{i}"] = jax.tree.map(lambda x, r=row: x[r], stacked)
+    return {"params": out}

@@ -176,14 +176,34 @@ class TokenDataset:
         return (self.num_tokens - 1) // seq_len
 
     def max_token_id(self) -> int:
-        """Largest token id in the file (one mmap scan, cached).  Launchers
-        validate this against the model's vocab_size: an out-of-range id
-        otherwise surfaces as a silent NaN loss (the vocab-parallel CE's
-        psum-MAX eats the bad one-hot)."""
+        """Largest token id in the file (one streaming mmap scan, cached —
+        never a resident copy of the corpus, whichever loader path is
+        active)."""
         if not hasattr(self, "_max_token"):
-            data = self._np_tokens if self._np_tokens is not None else read_token_file(self.path)
+            if self._np_tokens is not None:
+                data = self._np_tokens
+            else:
+                with open(self.path, "rb") as f:
+                    head32 = np.frombuffer(f.read(16), np.uint32)
+                    if head32[0] != _MAGIC or head32[1] != _VERSION:
+                        raise ValueError(f"{self.path} is not an NXDT token file")
+                    n = int(np.frombuffer(f.read(8), np.uint64)[0])
+                data = np.memmap(self.path, _DTYPES[int(head32[2])], mode="r",
+                                 offset=24, shape=(n,))
             self._max_token = int(data.max()) if data.size else 0
         return self._max_token
+
+    def validate_vocab(self, vocab_size: int, what: str = "model") -> None:
+        """Fail loudly when the file holds ids outside ``[0, vocab_size)`` —
+        an out-of-range id otherwise trains to a silent NaN loss (the
+        vocab-parallel CE's psum-MAX eats the bad one-hot).  One shared
+        check for every launcher."""
+        if self.max_token_id() >= vocab_size:
+            raise ValueError(
+                f"data file {self.path} contains token id {self.max_token_id()} "
+                f">= {what} vocab_size {vocab_size}; rebuild the data or pick "
+                "a larger-vocab config (out-of-range ids train to NaN)"
+            )
 
     def close(self):
         if self._handle is not None:

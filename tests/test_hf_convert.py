@@ -167,3 +167,57 @@ def test_padded_heads_preserve_function(devices8):
     model = LlamaForCausalLM(cfg8)
     got = jax.jit(lambda p, i: model.apply(p, i))(padded, jnp.asarray(ids.numpy()))
     _assert_logits_close(got, want)
+
+
+def test_pipelined_llama_checkpoint_exports(devices8):
+    """A PP-trained (uneven-cuts, padded-stack) Llama checkpoint converts to
+    the standard tree — dense logits match the pipelined forward — and on
+    through to HF keys."""
+    from neuronx_distributed_tpu.convert import (
+        llama_params_from_pipelined, llama_params_to_hf,
+    )
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaConfig, LlamaForCausalLM, build_pipelined_llama,
+    )
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2, pipeline_parallel_size=2,
+                                  devices=devices8)
+    cfg = LlamaConfig.tiny(num_layers=6, sequence_parallel=False, remat="none",
+                           dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16)
+    pmodel = build_pipelined_llama(cfg, num_microbatches=2, seed=9, pipeline_cuts=(4,))
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, cfg.vocab_size)
+
+    flat = llama_params_from_pipelined(pmodel.params, pmodel.layer_rows)
+    dense_logits = jax.jit(LlamaForCausalLM(cfg).apply)(flat, ids)
+    # pipelined forward on the same batch (hidden -> head happens inside)
+    pp_logits = jax.jit(pmodel.forward_fn)(pmodel.params, ids)
+    np.testing.assert_allclose(np.asarray(pp_logits), np.asarray(dense_logits),
+                               rtol=2e-4, atol=2e-4)
+
+    sd = llama_params_to_hf(flat, cfg)
+    assert "model.layers.5.self_attn.q_proj.weight" in sd
+    assert sd["lm_head.weight"].shape == (cfg.vocab_size, cfg.hidden_size)
+
+
+def test_pipelined_neox_checkpoint_exports(devices8):
+    from neuronx_distributed_tpu.convert import (
+        gpt_neox_params_from_pipelined, gpt_neox_params_to_hf,
+    )
+    from neuronx_distributed_tpu.models.gpt_neox import (
+        GPTNeoXConfig, GPTNeoXForCausalLM, build_pipelined_gpt_neox,
+    )
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2, pipeline_parallel_size=2,
+                                  devices=devices8)
+    cfg = GPTNeoXConfig.tiny(num_layers=4, sequence_parallel=False, remat="none",
+                             dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16)
+    pmodel = build_pipelined_gpt_neox(cfg, num_microbatches=2, seed=9)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, cfg.vocab_size)
+
+    flat = gpt_neox_params_from_pipelined(pmodel.params, pmodel.layer_rows)
+    dense_logits = jax.jit(GPTNeoXForCausalLM(cfg).apply)(flat, ids)
+    pp_logits = jax.jit(pmodel.forward_fn)(pmodel.params, ids)
+    np.testing.assert_allclose(np.asarray(pp_logits), np.asarray(dense_logits),
+                               rtol=2e-4, atol=2e-4)
+    sd = gpt_neox_params_to_hf(flat, cfg)
+    assert any(k.startswith("gpt_neox.layers.3.") for k in sd)
