@@ -7,6 +7,7 @@ from neuronx_distributed_tpu.trace.engine import (
     ParallelInferenceModel,
     init_kv_caches,
     parallel_model_trace,
+    speculative_generate,
 )
 from neuronx_distributed_tpu.trace.export import (
     LoadedInferenceModel,
@@ -22,4 +23,5 @@ __all__ = [
     "parallel_model_trace",
     "parallel_model_save",
     "parallel_model_load",
+    "speculative_generate",
 ]

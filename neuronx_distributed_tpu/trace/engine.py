@@ -482,6 +482,33 @@ class ParallelInferenceModel(_ServingBase):
         )
         return logits[:, -1, :], caches
 
+    def _score_chunk_fn(self, params, ids, offset, caches, valid):
+        """Like :meth:`_prefill_chunk_fn` but (a) marks the chunk's cache
+        slots valid itself (decode-phase convention: the tail starts as
+        zeros) and (b) returns EVERY position's logits — the target-model
+        verification step of speculative decoding, where position ``i``'s
+        logits judge the draft's proposal ``i+1``."""
+        B, Cc = ids.shape
+        valid = jax.lax.dynamic_update_slice(
+            valid, jnp.ones((B, Cc), valid.dtype), (0, offset)
+        )
+        counts = jnp.cumsum(valid, axis=1) - valid
+        positions = jax.lax.dynamic_slice_in_dim(counts, offset, Cc, axis=1)
+        logits, caches = self.module.apply(
+            params, ids, positions.astype(jnp.int32), caches, offset, kv_valid=valid
+        )
+        return logits, caches, valid
+
+    def score_chunk(self, ids, offset, caches, valid):
+        """Compiled chunk scorer (lazily jitted per chunk length)."""
+        if not hasattr(self, "_score_cache"):
+            self._score_cache = {}
+        fn = self._score_cache.get(ids.shape[1])
+        if fn is None:
+            fn = jax.jit(self._score_chunk_fn, donate_argnums=(3,))
+            self._score_cache[ids.shape[1]] = fn
+        return fn(self.params, ids, jnp.int32(offset), caches, valid)
+
     def _decode_fn(self, params, tok, offset, caches, valid):
         """One token step; ``valid [B, T]`` tracks key validity over the full
         cache.  Returns the updated mask so callers can thread it."""
@@ -538,3 +565,133 @@ class ParallelInferenceModel(_ServingBase):
             params_spec, ids_spec, vctx_spec, tok_spec, off_spec, cache_spec,
             valid_spec,
         )
+
+
+def speculative_generate(
+    target: "ParallelInferenceModel",
+    draft: "ParallelInferenceModel",
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    k: int = 4,
+    prompt_lens: Optional[jax.Array] = None,
+    return_stats: bool = False,
+):
+    """Greedy speculative decoding: a small draft model proposes ``k`` tokens
+    per round, the target verifies them in ONE chunked forward, and the
+    output is PROVABLY identical to the target's own greedy decode (accept
+    while the target's argmax agrees; the first disagreement is replaced by
+    the target's token, and a fully-accepted round yields the target's bonus
+    token).  Per-round host sync replaces per-token host sync, and the
+    target runs ``ceil(n / (accepted+1))`` chunk forwards instead of ``n``
+    single-token steps — the serving win when the draft is much smaller.
+
+    ``target``/``draft`` must share the tokenizer and serving shapes
+    (``batch_size``, ``context_len``, ``max_total_len``).  Rejected cache
+    slots are never rewound: they sit at indices >= the next write offset,
+    index-based causal masking hides them, and the next round's chunk write
+    overwrites them before any query can attend that far.
+
+    Capability beyond the reference (whose serving is plain per-token
+    HF-generate driving, ``neuron_modeling_llama.py:437-465``).
+    """
+    tcfg, dcfg = target.config, draft.config
+    for f in ("batch_size", "context_len", "max_total_len"):
+        if getattr(tcfg, f) != getattr(dcfg, f):
+            raise ValueError(
+                f"target/draft serving shapes differ on {f}: "
+                f"{getattr(tcfg, f)} vs {getattr(dcfg, f)}"
+            )
+    B, C = prompt_ids.shape
+    T = tcfg.max_total_len
+    if (B, C) != (tcfg.batch_size, tcfg.context_len):
+        raise ValueError(
+            f"prompt shape {(B, C)} does not match traced shape "
+            f"{(tcfg.batch_size, tcfg.context_len)}"
+        )
+    if C + max_new_tokens > T:
+        # the final round clips kk to the remaining budget, so the largest
+        # write index is C + max_new_tokens - 1 — the same bound as generate()
+        raise ValueError(
+            f"context {C} + new {max_new_tokens} exceeds max_total_len {T}"
+        )
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+
+    valid_ctx = target._valid_ctx(prompt_lens)
+    tail = jnp.zeros((B, T - C), jnp.int32)
+    valid_t = jnp.concatenate([valid_ctx, tail], axis=1)
+    valid_d = valid_t
+
+    logits_t, caches_t = target.context(target.params, prompt_ids.astype(jnp.int32), valid_ctx)
+    _, caches_d = draft.context(draft.params, prompt_ids.astype(jnp.int32), valid_ctx)
+
+    committed = [jnp.argmax(logits_t, axis=-1).astype(jnp.int32)[:, None]]
+    n_done = 1
+    offset = C  # cache index of the next write; committed[-1] not yet written
+    rounds = proposed_total = accepted_total = 0
+
+    while n_done < max_new_tokens:
+        kk = min(k, max_new_tokens - n_done)
+        # --- draft proposes kk tokens (its decode also ingests committed[-1])
+        proposals = []
+        tok = committed[-1]
+        vd = valid_d
+        for j in range(kk):
+            dlogits, caches_d, vd = draft.decode(
+                draft.params, tok, jnp.int32(offset + j), caches_d, vd
+            )
+            tok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)[:, None]
+            proposals.append(tok)
+        props = jnp.concatenate(proposals, axis=1)  # [B, kk]
+
+        # --- target verifies the whole round in one chunk forward
+        chunk = jnp.concatenate([committed[-1], props], axis=1)  # [B, kk+1]
+        logits_full, caches_t, valid_t = target.score_chunk(
+            chunk, offset, caches_t, valid_t
+        )
+        tgt = jnp.argmax(logits_full, axis=-1).astype(jnp.int32)  # [B, kk+1]
+
+        # leading agreement across the batch (lockstep: the whole batch
+        # advances by the minimum acceptance, keeping one shared offset)
+        agree = np.asarray(tgt[:, :kk] == props)  # host sync, once per round
+        lead = np.minimum.accumulate(agree, axis=1)
+        j = int(lead.all(axis=0).sum())  # tokens accepted this round
+
+        take = min(j + 1, max_new_tokens - n_done)  # proposals then a target token
+        for i in range(take - 1):
+            committed.append(props[:, i:i + 1])
+        # tgt[:, take-1] is t_{take}: the corrective/bonus token when
+        # take == j+1, and (== p_take) the clipped final token otherwise
+        committed.append(tgt[:, take - 1:take])
+        if take == kk + 1:
+            # full accept: the draft proposed p_kk but never WROTE it (its
+            # last decode produced it); the slot now lies inside the
+            # committed region where nothing will overwrite it, so ingest it
+            # — one extra draft step, only on fully-accepted rounds
+            _, caches_d, vd = draft.decode(
+                draft.params, props[:, kk - 1:kk], jnp.int32(offset + kk),
+                caches_d, vd,
+            )
+        n_done += take
+        offset += take
+        # draft follows the same offset; its stale slots (> offset) are
+        # overwritten next round, and its valid mask matches the target's
+        valid_d = valid_t
+        rounds += 1
+        proposed_total += kk
+        # verdict-level agreement (j <= kk): a proposal that agreed but fell
+        # past max_new_tokens was not *rejected* — the rate measures draft
+        # quality, not the output-length clip
+        accepted_total += j
+
+    out = jnp.concatenate([prompt_ids] + committed, axis=1)
+    if return_stats:
+        return out, {
+            "rounds": rounds,
+            "proposed": proposed_total,
+            "accepted": accepted_total,
+            "acceptance_rate": accepted_total / max(proposed_total, 1),
+        }
+    return out

@@ -245,3 +245,86 @@ def test_chunked_prefill_rejects_empty_prompt(devices8):
                         chunked_prefill=True))
     with pytest.raises(ValueError, match="does not match"):
         chunked.generate(jnp.zeros((2, 0), jnp.int32), max_new_tokens=2)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding
+# ---------------------------------------------------------------------------
+
+
+def _spec_pair(devices8, seed=0):
+    from neuronx_distributed_tpu.models.llama import LlamaConfig as LC
+
+    initialize_model_parallel(tensor_parallel_size=8, devices=devices8)
+    icfg = InferenceConfig(batch_size=2, context_len=8, max_total_len=40)
+    base = dict(sequence_parallel=False, dtype=jnp.float32,
+                param_dtype=jnp.float32, max_seq_len=64, remat="none")
+    tgt_cfg = LC.tiny(num_layers=3, **base)
+    drf_cfg = LC.tiny(num_layers=1, hidden_size=32, intermediate_size=64, **base)
+    tgt_mod = LlamaForCausalLM(tgt_cfg)
+    drf_mod = LlamaForCausalLM(drf_cfg)
+    tgt = ParallelInferenceModel(
+        tgt_mod, sharded_params(tgt_mod.init(jax.random.PRNGKey(seed), jnp.zeros((2, 8), jnp.int32))),
+        icfg)
+    drf = ParallelInferenceModel(
+        drf_mod, sharded_params(drf_mod.init(jax.random.PRNGKey(seed + 1), jnp.zeros((2, 8), jnp.int32))),
+        icfg)
+    return tgt, drf, tgt_cfg
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_speculative_matches_target_greedy(devices8, k):
+    """The output contract: greedy speculative decoding produces EXACTLY the
+    target model's own greedy output, for any draft and any k."""
+    from neuronx_distributed_tpu.trace import speculative_generate
+
+    tgt, drf, cfg = _spec_pair(devices8)
+    prompts = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, cfg.vocab_size)
+    want = tgt.generate(prompts, max_new_tokens=12)
+    got, stats = speculative_generate(tgt, drf, prompts, max_new_tokens=12, k=k,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert stats["rounds"] >= 1 and 0.0 <= stats["acceptance_rate"] <= 1.0
+
+
+def test_speculative_self_draft_accepts_everything(devices8):
+    """Draft == target ⇒ every proposal is accepted (the acceptance logic's
+    positive control) and rounds collapse to ~n/(k+1)."""
+    from neuronx_distributed_tpu.trace import speculative_generate
+
+    tgt, _, cfg = _spec_pair(devices8)
+    prompts = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 0, cfg.vocab_size)
+    want = tgt.generate(prompts, max_new_tokens=12)
+    got, stats = speculative_generate(tgt, tgt, prompts, max_new_tokens=12, k=3,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert stats["acceptance_rate"] == 1.0
+    assert stats["rounds"] == -(-11 // 4)  # ceil((n-1)/(k+1))
+
+
+def test_speculative_ragged_prompts(devices8):
+    from neuronx_distributed_tpu.trace import speculative_generate
+
+    tgt, drf, cfg = _spec_pair(devices8, seed=7)
+    prompts = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0, cfg.vocab_size)
+    lens = jnp.asarray([3, 8], jnp.int32)
+    want = tgt.generate(prompts, max_new_tokens=10, prompt_lens=lens)
+    got = speculative_generate(tgt, drf, prompts, max_new_tokens=10, k=3,
+                               prompt_lens=lens)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_shape_errors(devices8):
+    from neuronx_distributed_tpu.trace import speculative_generate
+
+    tgt, drf, cfg = _spec_pair(devices8)
+    prompts = jnp.zeros((2, 8), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds"):
+        speculative_generate(tgt, drf, prompts, max_new_tokens=33, k=3)
+    with pytest.raises(ValueError, match="k must be"):
+        speculative_generate(tgt, drf, prompts, max_new_tokens=4, k=0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        speculative_generate(tgt, drf, prompts, max_new_tokens=0, k=3)
+    # the full cache budget is usable (same bound as generate())
+    out = speculative_generate(tgt, drf, prompts, max_new_tokens=32, k=3)
+    assert out.shape == (2, 40)
