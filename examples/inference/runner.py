@@ -29,7 +29,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
-def build_model(args):
+def build_model(args, preset=None, seed=None):
     import jax
     import jax.numpy as jnp
     from flax import linen as nn
@@ -37,12 +37,15 @@ def build_model(args):
 
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-    from neuronx_distributed_tpu.parallel.mesh import get_mesh
+    from neuronx_distributed_tpu.parallel.mesh import (
+        get_mesh, model_parallel_is_initialized,
+    )
     from neuronx_distributed_tpu.trace import InferenceConfig, ParallelInferenceModel
 
-    nxd.initialize_model_parallel(tensor_parallel_size=args.tp)
+    if not model_parallel_is_initialized():
+        nxd.initialize_model_parallel(tensor_parallel_size=args.tp)
     on_tpu = jax.default_backend() == "tpu"
-    cfg = getattr(LlamaConfig, args.preset)(
+    cfg = getattr(LlamaConfig, preset or args.preset)(
         max_seq_len=args.max_total_len,
         sequence_parallel=False,
         remat="none",
@@ -51,7 +54,7 @@ def build_model(args):
     )
     module = LlamaForCausalLM(cfg)
     ids0 = jnp.zeros((args.batch_size, args.context_len), jnp.int32)
-    params = module.init(jax.random.PRNGKey(args.seed), ids0)
+    params = module.init(jax.random.PRNGKey(args.seed if seed is None else seed), ids0)
     specs = nn.get_partition_spec(params)
     mesh = get_mesh()
     params = jax.tree.map(
@@ -99,6 +102,41 @@ def cmd_infer(args):
                          rng=jax.random.PRNGKey(args.seed) if args.temperature else None,
                          prompt_lens=lens)
     print(json.dumps({"generated": out[:, cfg.context_len:].tolist()}))
+
+
+def cmd_spec_decode(args):
+    import time
+
+    from neuronx_distributed_tpu.trace import speculative_generate
+
+    tcfg, _, _, target = build_model(args)
+    _, _, _, draft = build_model(args, preset=args.draft_preset, seed=args.seed + 1)
+    prompt = _prompt_ids(args.seed, args.batch_size, args.context_len, tcfg.vocab_size)
+
+    # warm both paths, then time
+    import jax
+
+    jax.block_until_ready(target.generate(prompt, args.max_new_tokens))
+    jax.block_until_ready(
+        speculative_generate(target, draft, prompt, args.max_new_tokens, k=args.spec_k))
+    t0 = time.perf_counter()
+    want = target.generate(prompt, args.max_new_tokens)
+    jax.block_until_ready(want)
+    t_plain = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    got, stats = speculative_generate(
+        target, draft, prompt, args.max_new_tokens, k=args.spec_k, return_stats=True)
+    jax.block_until_ready(got)
+    t_spec = time.perf_counter() - t0
+    import numpy as np
+
+    identical = bool((np.asarray(got) == np.asarray(want)).all())
+    print(json.dumps({
+        "identical_to_target_greedy": identical,
+        "plain_s": round(t_plain, 4), "spec_s": round(t_spec, 4),
+        "speedup": round(t_plain / max(t_spec, 1e-9), 3), **stats,
+    }))
+    sys.exit(0 if identical else 1)
 
 
 def cmd_benchmark(args):
@@ -166,6 +204,14 @@ def main():
     sp = sub.add_parser("benchmark", help="p50/p99 per-token latency")
     common(sp, traced=True)
     sp.set_defaults(fn=cmd_benchmark)
+
+    sp = sub.add_parser("spec-decode", help="speculative decoding: verify + time vs plain greedy")
+    common(sp)
+    sp.add_argument("--draft-preset", default="tiny",
+                    choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b"],
+                    help="draft model preset (should be much smaller than the target)")
+    sp.add_argument("--spec-k", type=int, default=4, help="draft tokens per round")
+    sp.set_defaults(fn=cmd_spec_decode)
 
     sp = sub.add_parser("check-accuracy", help="cached decode vs teacher forcing")
     common(sp)

@@ -500,12 +500,19 @@ class ParallelInferenceModel(_ServingBase):
         return logits, caches, valid
 
     def score_chunk(self, ids, offset, caches, valid):
-        """Compiled chunk scorer (lazily jitted per chunk length)."""
+        """Compiled chunk scorer (lazily jitted per chunk length); outputs
+        pinned to the same batch/cache shardings as the AOT executables so
+        its caches/masks feed straight back into them."""
         if not hasattr(self, "_score_cache"):
             self._score_cache = {}
         fn = self._score_cache.get(ids.shape[1])
         if fn is None:
-            fn = jax.jit(self._score_chunk_fn, donate_argnums=(3,))
+            io = getattr(self, "_io_shardings", None)
+            out = (
+                (None, io["cache_out"], io["batch"](None))
+                if io is not None else None
+            )
+            fn = jax.jit(self._score_chunk_fn, donate_argnums=(3,), out_shardings=out)
             self._score_cache[ids.shape[1]] = fn
         return fn(self.params, ids, jnp.int32(offset), caches, valid)
 
@@ -535,28 +542,67 @@ class ParallelInferenceModel(_ServingBase):
 
         cfg = self.config
         B, C, T = cfg.batch_size, cfg.context_len, cfg.max_total_len
-        ids_spec = jax.ShapeDtypeStruct((B, C), jnp.int32)
-        vctx_spec = jax.ShapeDtypeStruct((B, C), jnp.int32)
-        tok_spec = jax.ShapeDtypeStruct((B, 1), jnp.int32)
+
+        # Pin the batch-dim sharding of every array that loops BETWEEN
+        # executables (tokens, validity masks, logits, caches).  AOT programs
+        # are strict about committed-argument placement, and without pinning
+        # the compiler is free to choose e.g. a replicated cache output from
+        # `context` while `decode` was compiled expecting a dp-sharded cache
+        # input — a guaranteed mismatch the moment dp > 1.  Policy matches
+        # init_kv_caches: batch over dp when divisible, else replicated.
+        if model_parallel_is_initialized():
+            from jax.sharding import PartitionSpec as P
+
+            mesh = get_mesh()
+            bax = BATCH_AXES if B % get_data_parallel_size() == 0 else None
+
+            def bsh(*rest):
+                return NamedSharding(mesh, P(bax, *rest))
+        else:
+            def bsh(*rest):
+                return None
+
+        def bsds(shape, dtype=jnp.int32):
+            return jax.ShapeDtypeStruct(shape, dtype,
+                                        sharding=bsh(*(None,) * (len(shape) - 1)))
+
+        ids_spec = bsds((B, C))
+        vctx_spec = bsds((B, C))
+        tok_spec = bsds((B, 1))
         off_spec = jax.ShapeDtypeStruct((), jnp.int32)
-        valid_spec = jax.ShapeDtypeStruct((B, T), jnp.int32)
+        valid_spec = bsds((B, T))
         cache_spec = jax.tree.map(
             sds,
             init_kv_caches(self.num_layers, B, T, self.num_kv_heads, self.head_dim,
                            cfg.kv_cache_dtype),
         )
+        cache_out = jax.tree.map(lambda s: s.sharding, cache_spec)
         params_spec = jax.tree.map(sds, self.params)
         # keep the jitted phase fns: lower+compile here, and the export path
         # reuses them (their lowering cache) instead of re-jitting from scratch
-        self._context_jit = jax.jit(self._context_fn)
-        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(3,))
+        # logits never re-enter an AOT program (they go straight to eager
+        # argmax/sampling), so their sharding stays unconstrained — pinning
+        # them would force a full-vocab all-gather off the tp-split lm_head
+        self._context_jit = jax.jit(
+            self._context_fn, out_shardings=(None, cache_out)
+        )
+        self._decode_jit = jax.jit(
+            self._decode_fn, donate_argnums=(3,),
+            out_shardings=(None, cache_out, bsh(None)),
+        )
         self.context = self._context_jit.lower(params_spec, ids_spec, vctx_spec).compile()
         # donated caches (arg 3) → in-place KV update
         self.decode = self._decode_jit.lower(
             params_spec, tok_spec, off_spec, cache_spec, valid_spec
         ).compile()
+        self._io_shardings = {
+            "batch": bsh, "cache_out": cache_out,
+        }
         if cfg.chunked_prefill:
-            self._prefill_chunk_jit = jax.jit(self._prefill_chunk_fn, donate_argnums=(3,))
+            self._prefill_chunk_jit = jax.jit(
+                self._prefill_chunk_fn, donate_argnums=(3,),
+                out_shardings=(None, cache_out),
+            )
             self.prefill_chunk = self._prefill_chunk_jit.lower(
                 params_spec, ids_spec, off_spec, cache_spec, valid_spec
             ).compile()
@@ -601,6 +647,14 @@ def speculative_generate(
                 f"target/draft serving shapes differ on {f}: "
                 f"{getattr(tcfg, f)} vs {getattr(dcfg, f)}"
             )
+    tv = getattr(getattr(target, "module", None), "config", None)
+    dv = getattr(getattr(draft, "module", None), "config", None)
+    if tv is not None and dv is not None and getattr(tv, "vocab_size", None) != getattr(dv, "vocab_size", None):
+        raise ValueError(
+            f"target/draft vocab_size differ ({tv.vocab_size} vs {dv.vocab_size}): "
+            "speculative decoding needs one shared tokenizer — out-of-range "
+            "proposals would be silently clamped, not rejected"
+        )
     B, C = prompt_ids.shape
     T = tcfg.max_total_len
     if (B, C) != (tcfg.batch_size, tcfg.context_len):

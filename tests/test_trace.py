@@ -328,3 +328,42 @@ def test_speculative_shape_errors(devices8):
     # the full cache budget is usable (same bound as generate())
     out = speculative_generate(tgt, drf, prompts, max_new_tokens=32, k=3)
     assert out.shape == (2, 40)
+
+
+def test_serving_at_dp_greater_than_one(devices8):
+    """tp=4 on 8 devices leaves dp=2: every executable's cache/token/mask
+    shardings are pinned so context -> decode -> score_chunk compose (the
+    unpinned compiler choices used to disagree the moment dp > 1)."""
+    from neuronx_distributed_tpu.trace import speculative_generate
+
+    initialize_model_parallel(tensor_parallel_size=4, devices=devices8)
+    cfg = LlamaConfig.tiny(sequence_parallel=False, dtype=jnp.float32,
+                           param_dtype=jnp.float32, max_seq_len=32, remat="none")
+    module = LlamaForCausalLM(cfg)
+    params = sharded_params(module.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32)))
+    m = ParallelInferenceModel(
+        module, params, InferenceConfig(batch_size=2, context_len=8, max_total_len=24))
+    prompts = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, cfg.vocab_size)
+    fused = m.generate(prompts, max_new_tokens=6)
+    stepped = m.generate(prompts, max_new_tokens=6, fused=False)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(stepped))
+    spec = speculative_generate(m, m, prompts, max_new_tokens=6, k=2)
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(fused))
+
+
+def test_speculative_vocab_mismatch_raises(devices8):
+    from neuronx_distributed_tpu.models.llama import LlamaConfig as LC
+    from neuronx_distributed_tpu.trace import speculative_generate
+
+    initialize_model_parallel(tensor_parallel_size=8, devices=devices8)
+    icfg = InferenceConfig(batch_size=2, context_len=8, max_total_len=24)
+    base = dict(sequence_parallel=False, dtype=jnp.float32,
+                param_dtype=jnp.float32, max_seq_len=32, remat="none")
+    t_mod = LlamaForCausalLM(LC.tiny(**base))
+    d_mod = LlamaForCausalLM(LC.tiny(vocab_size=512, **base))
+    tgt = ParallelInferenceModel(
+        t_mod, sharded_params(t_mod.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))), icfg)
+    drf = ParallelInferenceModel(
+        d_mod, sharded_params(d_mod.init(jax.random.PRNGKey(1), jnp.zeros((2, 8), jnp.int32))), icfg)
+    with pytest.raises(ValueError, match="vocab_size"):
+        speculative_generate(tgt, drf, jnp.zeros((2, 8), jnp.int32), max_new_tokens=4)
