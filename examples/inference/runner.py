@@ -44,6 +44,13 @@ def build_model(args, preset=None, seed=None):
 
     if not model_parallel_is_initialized():
         nxd.initialize_model_parallel(tensor_parallel_size=args.tp)
+    else:
+        from neuronx_distributed_tpu.parallel.mesh import get_tensor_parallel_size
+
+        if get_tensor_parallel_size() != args.tp:
+            raise SystemExit(
+                f"model parallel already initialized with tp="
+                f"{get_tensor_parallel_size()}, but --tp {args.tp} requested")
     on_tpu = jax.default_backend() == "tpu"
     cfg = getattr(LlamaConfig, preset or args.preset)(
         max_seq_len=args.max_total_len,

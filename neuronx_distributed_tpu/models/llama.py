@@ -129,6 +129,16 @@ class LlamaConfig:
             num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0), **overrides})
 
     @staticmethod
+    def mixtral_8x7b(**overrides) -> "LlamaConfig":
+        """Mixtral-8x7B-shaped MoE config (8 experts, top-2) — the
+        expert-parallel flagship shape; beyond the reference, which has no
+        MoE at all (SURVEY §2.10)."""
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=1e6,
+            num_experts=8, moe_top_k=2), **overrides})
+
+    @staticmethod
     def tiny(**overrides) -> "LlamaConfig":
         """Test-scale config (the reference's 4-layer combinatorial config)."""
         return LlamaConfig(**{**dict(

@@ -154,6 +154,24 @@ class InferenceConfig:
             )
 
 
+def _serving_batch_axes(batch_size: int):
+    """The one batch-dim sharding policy for serving arrays: over dp when
+    divisible, else replicated (with a warning — replication multiplies
+    per-device memory).  Shared by cache construction and the executables'
+    loop-array pinning so the two can never diverge."""
+    if not model_parallel_is_initialized():
+        return None
+    dp = get_data_parallel_size()
+    if batch_size % dp == 0:
+        return BATCH_AXES
+    if dp > 1:
+        logger.warning(
+            "serving batch dim (%d) not divisible by dp (%d); replicating",
+            batch_size, dp,
+        )
+    return None
+
+
 def init_kv_caches(
     num_layers: int,
     batch_size: int,
@@ -173,13 +191,8 @@ def init_kv_caches(
         # shard only the dims the shapes actually divide (small serving
         # batches are often < dp; few kv heads may be < tp) — and say so,
         # since replication multiplies per-device cache memory
-        batch_axes = BATCH_AXES if batch_size % get_data_parallel_size() == 0 else None
+        batch_axes = _serving_batch_axes(batch_size)
         kv_axes = TENSOR_AXIS if num_kv_heads % mesh.shape[TENSOR_AXIS] == 0 else None
-        if batch_axes is None and get_data_parallel_size() > 1:
-            logger.warning(
-                "kv cache batch dim (%d) not divisible by dp (%d); replicating",
-                batch_size, get_data_parallel_size(),
-            )
         if kv_axes is None and mesh.shape[TENSOR_AXIS] > 1:
             logger.warning(
                 "kv cache head dim (%d) not divisible by tp (%d); replicating",
@@ -507,12 +520,10 @@ class ParallelInferenceModel(_ServingBase):
             self._score_cache = {}
         fn = self._score_cache.get(ids.shape[1])
         if fn is None:
-            io = getattr(self, "_io_shardings", None)
-            out = (
-                (None, io["cache_out"], io["batch"](None))
-                if io is not None else None
-            )
-            fn = jax.jit(self._score_chunk_fn, donate_argnums=(3,), out_shardings=out)
+            io = self._io_shardings  # set by _build; unpinned outputs would
+            # silently reintroduce the dp>1 placement mismatch, so fail loudly
+            fn = jax.jit(self._score_chunk_fn, donate_argnums=(3,),
+                         out_shardings=(None, io["cache_out"], io["batch"](None)))
             self._score_cache[ids.shape[1]] = fn
         return fn(self.params, ids, jnp.int32(offset), caches, valid)
 
@@ -554,7 +565,7 @@ class ParallelInferenceModel(_ServingBase):
             from jax.sharding import PartitionSpec as P
 
             mesh = get_mesh()
-            bax = BATCH_AXES if B % get_data_parallel_size() == 0 else None
+            bax = _serving_batch_axes(B)
 
             def bsh(*rest):
                 return NamedSharding(mesh, P(bax, *rest))

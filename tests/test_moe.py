@@ -185,3 +185,13 @@ def test_moe_pipeline_aux_normalization_matches_pp1(devices8):
         (ls, tok), _ = jax.jit(pmodel.loss_and_grad_fn)(pmodel.params, ids, labels)
         losses[pp] = float(ls) / float(tok)
     assert losses[1] == pytest.approx(losses[2], rel=5e-4), losses
+
+
+def test_mixtral_preset_shapes():
+    cfg = LlamaConfig.mixtral_8x7b()
+    assert (cfg.num_experts, cfg.moe_top_k) == (8, 2)
+    assert (cfg.hidden_size, cfg.intermediate_size, cfg.num_kv_heads) == (4096, 14336, 8)
+    tiny = LlamaConfig.mixtral_8x7b(hidden_size=64, intermediate_size=128,
+                                    num_layers=2, num_heads=8, num_kv_heads=8,
+                                    vocab_size=256, max_seq_len=64)
+    assert tiny.num_experts == 8
