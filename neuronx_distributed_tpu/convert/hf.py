@@ -86,13 +86,25 @@ def llama_params_from_hf(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
     }
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
+        qkv = {
+            "q_kernel": sd[p + "self_attn.q_proj.weight"].T.reshape(H, NQ, D),
+            "k_kernel": sd[p + "self_attn.k_proj.weight"].T.reshape(H, NKV, D),
+            "v_kernel": sd[p + "self_attn.v_proj.weight"].T.reshape(H, NKV, D),
+        }
+        if getattr(cfg, "qkv_bias", False):
+            # Qwen2: biased q/k/v projections
+            qkv["q_bias"] = sd[p + "self_attn.q_proj.bias"].reshape(NQ, D)
+            qkv["k_bias"] = sd[p + "self_attn.k_proj.bias"].reshape(NKV, D)
+            qkv["v_bias"] = sd[p + "self_attn.v_proj.bias"].reshape(NKV, D)
+        elif p + "self_attn.q_proj.bias" in sd:
+            raise ValueError(
+                "HF checkpoint carries QKV biases (Qwen2-style) but the "
+                "config has qkv_bias=False — converting would silently zero "
+                "them; build the config with qkv_bias=True"
+            )
         model[f"layer_{i}"] = {
             "attn": {
-                "qkv": {
-                    "q_kernel": sd[p + "self_attn.q_proj.weight"].T.reshape(H, NQ, D),
-                    "k_kernel": sd[p + "self_attn.k_proj.weight"].T.reshape(H, NKV, D),
-                    "v_kernel": sd[p + "self_attn.v_proj.weight"].T.reshape(H, NKV, D),
-                },
+                "qkv": qkv,
                 "o_proj": {"kernel": sd[p + "self_attn.o_proj.weight"].T},
             },
             "mlp": {
@@ -144,6 +156,12 @@ def llama_params_to_hf(params: Mapping[str, Any], cfg) -> Dict[str, np.ndarray]:
             p + "input_layernorm.weight": _np(lyr["input_norm"]["weight"]),
             p + "post_attention_layernorm.weight": _np(lyr["post_attn_norm"]["weight"]),
         })
+        if "q_bias" in qkv:  # Qwen2 biased projections
+            out.update({
+                p + "self_attn.q_proj.bias": _np(qkv["q_bias"]).reshape(-1),
+                p + "self_attn.k_proj.bias": _np(qkv["k_bias"]).reshape(-1),
+                p + "self_attn.v_proj.bias": _np(qkv["v_bias"]).reshape(-1),
+            })
     return out
 
 

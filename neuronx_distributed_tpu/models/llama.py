@@ -65,6 +65,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_eps: float = 1e-5
     sequence_parallel: bool = True
+    # biases on the q/k/v projections (Qwen2's one architectural delta from
+    # Llama; everything else — GQA, SwiGLU, RMSNorm, RoPE — is shared)
+    qkv_bias: bool = False
     remat: str = "selective"  # none | selective | full
     # "dense": GSPMD einsum core (CPU-friendly; always used for cached decode).
     # "flash": pallas flash kernel under shard_map; rings KV over the cp axis
@@ -127,6 +130,14 @@ class LlamaConfig:
         return LlamaConfig(**{**dict(
             vocab_size=128256, hidden_size=4096, intermediate_size=14336,
             num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0), **overrides})
+
+    @staticmethod
+    def qwen2_7b(**overrides) -> "LlamaConfig":
+        """Qwen2-7B: Llama architecture + QKV biases, GQA kv4, 152k vocab."""
+        return LlamaConfig(**{**dict(
+            vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+            num_layers=28, num_heads=28, num_kv_heads=4, rope_theta=1e6,
+            qkv_bias=True, rms_eps=1e-6), **overrides})
 
     @staticmethod
     def mixtral_8x7b(**overrides) -> "LlamaConfig":
@@ -236,6 +247,7 @@ class LlamaAttention(nn.Module):
             num_heads=cfg.num_heads,
             num_kv_heads=cfg.num_kv_heads,
             head_dim=D,
+            use_bias=cfg.qkv_bias,
             sequence_parallel=cfg.sequence_parallel,
             lora_rank=cfg.lora_rank if "qkv" in cfg.lora_targets else 0,
             lora_alpha=cfg.lora_alpha,

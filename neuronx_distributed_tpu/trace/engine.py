@@ -154,17 +154,21 @@ class InferenceConfig:
             )
 
 
+_BATCH_REPLICATION_WARNED: set = set()
+
+
 def _serving_batch_axes(batch_size: int):
     """The one batch-dim sharding policy for serving arrays: over dp when
-    divisible, else replicated (with a warning — replication multiplies
-    per-device memory).  Shared by cache construction and the executables'
-    loop-array pinning so the two can never diverge."""
+    divisible, else replicated (warn once per batch size — replication
+    multiplies per-device memory).  Shared by cache construction and the
+    executables' loop-array pinning so the two can never diverge."""
     if not model_parallel_is_initialized():
         return None
     dp = get_data_parallel_size()
     if batch_size % dp == 0:
         return BATCH_AXES
-    if dp > 1:
+    if dp > 1 and (batch_size, dp) not in _BATCH_REPLICATION_WARNED:
+        _BATCH_REPLICATION_WARNED.add((batch_size, dp))
         logger.warning(
             "serving batch dim (%d) not divisible by dp (%d); replicating",
             batch_size, dp,

@@ -221,3 +221,60 @@ def test_pipelined_neox_checkpoint_exports(devices8):
                                rtol=2e-4, atol=2e-4)
     sd = gpt_neox_params_to_hf(flat, cfg)
     assert any(k.startswith("gpt_neox.layers.3.") for k in sd)
+
+
+def test_qwen2_logits_parity(devices8):
+    """Qwen2 = Llama + QKV biases: HF Qwen2 logits parity through the same
+    converter (qkv_bias drives the bias import/export), plus roundtrip."""
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=8, num_key_value_heads=2, max_position_embeddings=64,
+        rms_norm_eps=1e-6, rope_theta=10000.0, tie_word_embeddings=False,
+        attention_dropout=0.0, use_sliding_window=False,
+    )
+    torch.manual_seed(0)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval().float()
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2, kv_size_multiplier=2)
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=8, num_kv_heads=2, max_seq_len=64, rms_eps=1e-6,
+        qkv_bias=True, sequence_parallel=False, remat="none",
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    params = jax.tree.map(jnp.asarray, llama_params_from_hf(hf.state_dict(), cfg))
+    model = LlamaForCausalLM(cfg)
+    got = jax.jit(lambda p, i: model.apply(p, i))(params, jnp.asarray(ids.numpy()))
+    _assert_logits_close(got, want)
+
+    _roundtrip(hf.state_dict(), llama_params_from_hf, llama_params_to_hf, cfg)
+
+
+def test_qwen2_preset_shapes():
+    from neuronx_distributed_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.qwen2_7b()
+    assert cfg.qkv_bias and cfg.num_kv_heads == 4 and cfg.vocab_size == 152064
+
+
+def test_qwen2_bias_checkpoint_requires_flag(devices8):
+    """Converting a biased (Qwen2) checkpoint with qkv_bias=False must fail
+    loudly, not silently zero the biases."""
+    from neuronx_distributed_tpu.models.llama import LlamaConfig
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=1,
+        num_attention_heads=8, num_key_value_heads=2, max_position_embeddings=64,
+        tie_word_embeddings=False,
+    )
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval().float()
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=96,
+                      num_layers=1, num_heads=8, num_kv_heads=2, max_seq_len=64,
+                      dtype=jnp.float32, param_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="qkv_bias"):
+        llama_params_from_hf(hf.state_dict(), cfg)
