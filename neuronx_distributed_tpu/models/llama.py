@@ -195,6 +195,18 @@ class CoreAttention(nn.Module):
     def __call__(self, q, k, v, q_offset=0, allow_flash=True, kv_valid=None,
                  segment_ids=None):
         cfg = self.config
+        if cfg.attention_impl == "flash" and allow_flash and segment_ids is not None:
+            # packed pretraining on the flash path: the segmented kernel
+            # blocks cross-document attention without materializing [S, S].
+            # Fall through to the dense core when the kernel cannot serve the
+            # case (cp > 1, odd sequence lengths, serving-side offsets).
+            from neuronx_distributed_tpu.parallel.mesh import get_context_parallel_size
+            from neuronx_distributed_tpu.ops.ring_attention import ring_attention
+
+            if (q_offset == 0 and kv_valid is None
+                    and get_context_parallel_size() == 1
+                    and q.shape[1] % 128 == 0):  # seg tiles need 128-divisible seq
+                return ring_attention(q, k, v, causal=True, segment_ids=segment_ids)
         if cfg.attention_impl == "flash" and allow_flash and segment_ids is None:
             from neuronx_distributed_tpu.ops.ring_attention import ring_attention
 

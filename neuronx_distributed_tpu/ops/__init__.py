@@ -4,6 +4,7 @@ work to the Neuron compiler (SURVEY §2.9); on TPU these are first-class."""
 
 from neuronx_distributed_tpu.ops.flash_attention import (
     flash_attention,
+    flash_attention_segmented,
     flash_attention_with_lse,
     mha_reference,
 )
@@ -16,6 +17,7 @@ from neuronx_distributed_tpu.ops.ring_attention import (
 
 __all__ = [
     "flash_attention",
+    "flash_attention_segmented",
     "flash_attention_with_lse",
     "mha_reference",
     "ring_attention",

@@ -103,8 +103,31 @@ def mha_reference(
 # ---------------------------------------------------------------------------
 
 
+def _segment_mask(qseg_ref, kseg_ref, block_q, block_k):
+    """[bq, bk] boolean mask from the lane-broadcast q ids ([bq, LANES])
+    and sublane-broadcast kv ids ([8, bk]) tiles; id 0 marks packing padding
+    and is blocked both ways (the data.packing convention)."""
+    qtile = qseg_ref[0]  # [bq, LANES], lanes all identical
+    if block_k <= LANES:  # interpreter-scale blocks
+        qs = qtile[:, :block_k]
+    else:
+        rep, rem = divmod(block_k, LANES)
+        if rem:
+            # only reachable when the sequence itself is not 128-divisible
+            # (the fitted block always lands on 512/256/128 otherwise)
+            raise ValueError(
+                f"segmented flash attention needs the sequence padded to a "
+                f"multiple of {LANES} (fitted kv block {block_k} is neither "
+                f"<= {LANES} nor a multiple of it)"
+            )
+        qs = jnp.tile(qtile, (1, rep))  # [bq, bk]
+    ks = kseg_ref[0, :1, :]  # [1, bk]
+    return jnp.logical_and(qs == ks, qs > 0)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_offset):
+                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_offset,
+                qseg_ref=None, kseg_ref=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -135,6 +158,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
+        if qseg_ref is not None:
+            s = jnp.where(_segment_mask(qseg_ref, kseg_ref, block_q, block_k), s, NEG_INF)
 
         m_prev = m_scr[:, :1]  # [bq, 1]
         l_prev = l_scr[:, :1]
@@ -158,7 +183,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0, 0] = (m_scr[...] + jnp.log(safe_l)).astype(lse_ref.dtype)
 
 
-def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+_SUBLANES = 8
+
+
+def _seg_operands(q_seg, kv_seg, B, S, T, bq, bk):
+    """Broadcast [B, S]/[B, T] ids into the TPU-tileable layouts (the
+    jax.experimental.pallas flash kernel's convention): q ids lane-broadcast
+    to [B, S, LANES] with (1, bq, LANES) blocks, kv ids sublane-broadcast to
+    [B, 8, T] with (1, 8, bk) blocks."""
+    qs = jax.lax.broadcast_in_dim(q_seg.astype(jnp.int32), (B, S, LANES), (0, 1))
+    ks = jax.lax.broadcast_in_dim(kv_seg.astype(jnp.int32), (B, _SUBLANES, T), (0, 2))
+    qs_spec = pl.BlockSpec((1, bq, LANES), lambda b, h, qi, ki: (b, qi, 0))
+    ks_spec = pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, qi, ki: (b, 0, ki))
+    return qs, ks, qs_spec, ks_spec
+
+
+def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+              q_seg=None, kv_seg=None):
     B, HQ, S, D = q.shape
     _, HKV, T, _ = k.shape
     G = HQ // HKV
@@ -170,27 +211,42 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     if pltpu is None:  # pragma: no cover - CPU builds always ship pltpu today
         raise RuntimeError("pallas TPU namespace unavailable")
     grid = (B, HQ, nq, nk)
-    kernel = functools.partial(
-        _fwd_kernel, sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
-        num_kv_blocks=nk, kv_offset=kv_offset,
-    )
+    segmented = q_seg is not None
+
+    def kernel(*refs):
+        if segmented:
+            q_r, k_r, v_r, qs_r, ks_r, o_r, lse_r, m_s, l_s, a_s = refs
+        else:
+            q_r, k_r, v_r, o_r, lse_r, m_s, l_s, a_s = refs
+            qs_r = ks_r = None
+        _fwd_kernel(q_r, k_r, v_r, o_r, lse_r, m_s, l_s, a_s,
+                    sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
+                    num_kv_blocks=nk, kv_offset=kv_offset,
+                    qseg_ref=qs_r, kseg_ref=ks_r)
+
     scratch = [
         # m / l lane-replicated, acc in fp32
         pltpu.VMEM((bq, LANES), jnp.float32),
         pltpu.VMEM((bq, LANES), jnp.float32),
         pltpu.VMEM((bq, D), jnp.float32),
     ]
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
+    ]
+    operands = [q, k, v]
+    if segmented:
+        qs, ks, qs_spec, ks_spec = _seg_operands(q_seg, kv_seg, B, S, T, bq, bk)
+        in_specs += [qs_spec, ks_spec]
+        operands += [qs, ks]
 
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         compiler_params=_compiler_params(("parallel", "parallel", "parallel", "arbitrary"),
                                          interpret),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -201,7 +257,7 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         scratch_shapes=scratch,
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return o, lse
 
 
@@ -211,7 +267,8 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
-               *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_offset):
+               *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_offset,
+               qseg_ref=None, kseg_ref=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -239,6 +296,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
+        if qseg_ref is not None:
+            s = jnp.where(_segment_mask(qseg_ref, kseg_ref, block_q, block_k), s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -255,7 +314,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                 dk_scr, dv_scr,
-                *, sm_scale, causal, block_q, block_k, num_q_blocks, kv_offset):
+                *, sm_scale, causal, block_q, block_k, num_q_blocks, kv_offset,
+                qseg_ref=None, kseg_ref=None):
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -284,6 +344,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
+        if qseg_ref is not None:
+            s = jnp.where(_segment_mask(qseg_ref, kseg_ref, block_q, block_k), s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk] fp32
         pb = p.astype(do.dtype)
         dv_scr[...] += jax.lax.dot_general(
@@ -303,7 +365,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, interpret):
+def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, interpret,
+              q_seg=None, kv_seg=None):
     """Backward kernels; ``delta_rows [B,HQ,S]`` is the softmax correction term
     (``rowsum(dO*O)``, minus the lse cotangent when one exists — see
     :func:`flash_attention_with_lse`)."""
@@ -314,48 +377,86 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
     scale = (D ** -0.5) if sm_scale is None else sm_scale
     nq, nk = S // bq, T // bk
     kv_offset = T - S
+    segmented = q_seg is not None
 
     delta = jnp.broadcast_to(delta_rows[..., None], (B, HQ, S, LANES))
 
+    if segmented:
+        qs, ks, _, _ = _seg_operands(q_seg, kv_seg, B, S, T, bq, bk)
+
+    def dq_kernel(*refs):
+        if segmented:
+            q_r, k_r, v_r, do_r, lse_r, d_r, qs_r, ks_r, dq_r, a_s = refs
+        else:
+            q_r, k_r, v_r, do_r, lse_r, d_r, dq_r, a_s = refs
+            qs_r = ks_r = None
+        _dq_kernel(q_r, k_r, v_r, do_r, lse_r, d_r, dq_r, a_s,
+                   sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
+                   num_kv_blocks=nk, kv_offset=kv_offset,
+                   qseg_ref=qs_r, kseg_ref=ks_r)
+
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+    ]
+    dq_operands = [q, k, v, do, lse, delta]
+    if segmented:
+        dq_in_specs += [
+            pl.BlockSpec((1, bq, LANES), lambda b, h, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, qi, ki: (b, 0, ki)),
+        ]
+        dq_operands += [qs, ks]
+
     dq = pl.pallas_call(
-        functools.partial(
-            _dq_kernel, sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
-            num_kv_blocks=nk, kv_offset=kv_offset,
-        ),
+        dq_kernel,
         grid=(B, HQ, nq, nk),
         compiler_params=_compiler_params(("parallel", "parallel", "parallel", "arbitrary"),
                                          interpret),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki, G=G: (b, h // G, ki, 0)),
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, HQ, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_operands)
 
     # dk/dv are accumulated per q-head then group-summed onto kv heads
+    def dkv_kernel(*refs):
+        if segmented:
+            q_r, k_r, v_r, do_r, lse_r, d_r, qs_r, ks_r, dk_r, dv_r, dks, dvs = refs
+        else:
+            q_r, k_r, v_r, do_r, lse_r, d_r, dk_r, dv_r, dks, dvs = refs
+            qs_r = ks_r = None
+        _dkv_kernel(q_r, k_r, v_r, do_r, lse_r, d_r, dk_r, dv_r, dks, dvs,
+                    sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
+                    num_q_blocks=nq, kv_offset=kv_offset,
+                    qseg_ref=qs_r, kseg_ref=ks_r)
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi, G=G: (b, h // G, ki, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi, G=G: (b, h // G, ki, 0)),
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
+    ]
+    dkv_operands = [q, k, v, do, lse, delta]
+    if segmented:
+        dkv_in_specs += [
+            pl.BlockSpec((1, bq, LANES), lambda b, h, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, ki, qi: (b, 0, ki)),
+        ]
+        dkv_operands += [qs, ks]
+
     dk_q, dv_q = pl.pallas_call(
-        functools.partial(
-            _dkv_kernel, sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
-            num_q_blocks=nq, kv_offset=kv_offset,
-        ),
+        dkv_kernel,
         grid=(B, HQ, nk, nq),
         compiler_params=_compiler_params(("parallel", "parallel", "parallel", "arbitrary"),
                                          interpret),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi, G=G: (b, h // G, ki, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi, G=G: (b, h // G, ki, 0)),
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi: (b, h, ki, 0)),
@@ -369,7 +470,7 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_operands)
 
     dk = jnp.sum(dk_q.reshape(B, HKV, G, T, D), axis=2).astype(k.dtype)
     dv = jnp.sum(dv_q.reshape(B, HKV, G, T, D), axis=2).astype(v.dtype)
@@ -463,3 +564,61 @@ def _fa_lse_bwd(causal, sm_scale, block_q, block_k, interpret, res, cts):
 
 
 flash_attention_with_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)
+
+
+# ---------------------------------------------------------------------------
+# segmented entry point (packed pretraining)
+# ---------------------------------------------------------------------------
+
+
+def _float0_like(x):
+    import numpy as _np
+
+    return _np.zeros(x.shape, jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_segmented(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_segment_ids: jax.Array,
+    kv_segment_ids: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """:func:`flash_attention` with document-segment masking — the packed-
+    pretraining hot path (``data.packing``): queries attend only keys of the
+    same nonzero segment id, so cross-document attention is blocked without
+    ever materializing the [S, T] mask the dense core pays for.  Segment ids
+    are ``[B, S]``/``[B, T]`` int arrays; id 0 marks padding (blocked both
+    ways; such rows produce garbage outputs whose loss/grads the packer's
+    IGNORE labels already drop — same confinement the dense path has).
+
+    A separate entry point (not a kwarg on :func:`flash_attention`) so the
+    unsegmented kernels' compiled artifacts stay byte-identical."""
+    o, _ = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                     _auto_interpret(interpret), q_segment_ids, kv_segment_ids)
+    return o
+
+
+def _fa_seg_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                       _auto_interpret(interpret), q_seg, kv_seg)
+    return o, (q, k, v, q_seg, kv_seg, o, lse)
+
+
+def _fa_seg_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    q, k, v, q_seg, kv_seg, o, lse = res
+    delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dq, dk, dv = _bwd_impl(
+        q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
+        _auto_interpret(interpret), q_seg, kv_seg,
+    )
+    return dq, dk, dv, _float0_like(q_seg), _float0_like(kv_seg)
+
+
+flash_attention_segmented.defvjp(_fa_seg_fwd, _fa_seg_bwd)

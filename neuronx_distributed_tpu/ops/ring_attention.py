@@ -43,6 +43,7 @@ from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_tpu.ops.flash_attention import (
     NEG_INF,
+    flash_attention_segmented,
     flash_attention_with_lse,
 )
 from neuronx_distributed_tpu.parallel.mesh import (
@@ -298,6 +299,7 @@ def ring_attention(
     interpret: Optional[bool] = None,
     layout: str = "contiguous",
     cp_impl: str = "ring",
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Context-parallel attention in model layout: ``q [B, S, NQ, D]``,
     ``k/v [B, S, NKV, D]`` (``NQ`` a multiple of ``NKV``), sequence dim
@@ -320,6 +322,11 @@ def ring_attention(
     ``"ulysses"`` — all-to-all re-shards seq→heads so each device runs plain
     full-sequence attention on a head subset (cp bounded by per-shard q-head
     count; contiguous layout only).
+
+    ``segment_ids [B, S]`` enables packed-pretraining document masking via
+    the segmented flash kernel (cp == 1 only: chunked/rotated segment
+    bookkeeping is not implemented — the model falls back to the dense core
+    for packed batches under cp > 1).
     """
     mesh = get_mesh()
     cp = mesh.shape[CONTEXT_AXIS]
@@ -357,6 +364,14 @@ def ring_attention(
         batch_axes = ()
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
+    if segment_ids is not None:
+        if cp != 1:
+            raise ValueError(
+                "segment_ids (packed attention) requires context_parallel_size"
+                " == 1; use the dense core for packed long-context batches"
+            )
+        if not causal or not use_flash:
+            raise ValueError("segment_ids requires causal=True and use_flash=True")
     if cp_impl not in ("ring", "ulysses"):
         raise ValueError(f"unknown cp_impl {cp_impl!r}")
     if cp_impl == "ulysses":
@@ -388,7 +403,17 @@ def ring_attention(
     q_spec = P(batch_axes or None, head_axes or None, seq_axes, None)
     kv_spec = P(batch_axes or None, kv_head_axes or None, seq_axes, None)
 
-    if cp_impl == "ulysses":
+    extra_operands = ()
+    extra_specs = ()
+    if segment_ids is not None:
+        def body(qs, ks, vs, segs):
+            return flash_attention_segmented(
+                qs, ks, vs, segs, segs, True, scale, block_q, block_k, interpret
+            )
+
+        extra_operands = (segment_ids,)
+        extra_specs = (P(batch_axes or None, None),)
+    elif cp_impl == "ulysses":
         def body(qs, ks, vs):
             return _ulysses_shard(
                 qs, ks, vs, cp=cp, causal=causal, sm_scale=scale,
@@ -415,11 +440,11 @@ def ring_attention(
     o = jax.shard_map(
         body,
         mesh=mesh_arg,
-        in_specs=(q_spec, kv_spec, kv_spec),
+        in_specs=(q_spec, kv_spec, kv_spec, *extra_specs),
         out_specs=q_spec,
         axis_names=new_manual,
         check_vma=False,
-    )(qt, kt, vt)
+    )(qt, kt, vt, *extra_operands)
     return o.transpose(0, 2, 1, 3)
 
 

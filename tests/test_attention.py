@@ -403,3 +403,131 @@ def test_llama_flash_ulysses_matches_dense(cp2_mesh):
         ),
         g_d, g_u,
     )
+
+
+# ---------------------------------------------------------------------------
+# segmented (packed) flash attention
+# ---------------------------------------------------------------------------
+
+
+def _seg_oracle(q, k, v, seg):
+    """Dense causal+segment-masked oracle (packing semantics: id 0 blocked)."""
+    G = q.shape[1] // k.shape[1]
+    D = q.shape[-1]
+    S = q.shape[2]
+    kk = jnp.repeat(k, G, axis=1)
+    vv = jnp.repeat(v, G, axis=1)
+    s = jnp.einsum("bhsd,bhtd->bhst", q, kk, preferred_element_type=jnp.float32) * (D ** -0.5)
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    same = (seg[:, :, None] == seg[:, None, :]) & (seg > 0)[:, :, None]
+    s = jnp.where((causal[None] & same)[:, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", p, vv)
+
+
+def _packed_segs(B, S):
+    seg = np.zeros((B, S), np.int32)
+    seg[0, : S // 3] = 1
+    seg[0, S // 3: S - 5] = 2
+    seg[1, : S // 2] = 1
+    seg[1, S // 2:] = 2
+    return jnp.asarray(seg)
+
+
+@pytest.mark.parametrize("gqa", [1, 2], ids=["mha", "gqa2"])
+def test_segmented_flash_matches_oracle(gqa):
+    from neuronx_distributed_tpu.ops import flash_attention_segmented
+
+    B, HKV, S, D = 2, 2, 64, 8
+    q, k, v = _qkv(jax.random.PRNGKey(20), B, HKV * gqa, HKV, S, S, D)
+    seg = _packed_segs(B, S)
+    live = jnp.asarray((np.asarray(seg) > 0)[:, None, :, None].astype(np.float32))
+    out = flash_attention_segmented(q, k, v, seg, seg, True, None, 16, 16)
+    ref = _seg_oracle(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out * live), np.asarray(ref * live),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_f(q, k, v):
+        o = flash_attention_segmented(q, k, v, seg, seg, True, None, 16, 16)
+        return jnp.sum((o * live) ** 2)
+
+    def loss_d(q, k, v):
+        return jnp.sum((_seg_oracle(q, k, v, seg) * live) ** 2)
+
+    g_f = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_f, g_d, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
+
+
+def test_llama_packed_flash_matches_dense(devices8):
+    """Packed batch through the FLASH path (segmented kernel) must match the
+    dense core's segment masking — the packed-pretraining hot path no longer
+    falls back to O(S^2) scores."""
+    from conftest import sharded_params
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    initialize_model_parallel(tensor_parallel_size=2, devices=devices8)
+    base = dict(sequence_parallel=False, dtype=jnp.float32, param_dtype=jnp.float32,
+                max_seq_len=64, remat="none")
+    cfg_d = LlamaConfig.tiny(attention_impl="dense", **base)
+    cfg_f = LlamaConfig.tiny(attention_impl="flash", **base)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 64), 0, cfg_d.vocab_size)
+    seg = _packed_segs(2, 64)
+    positions = jnp.broadcast_to(jnp.arange(64), ids.shape)
+
+    model_d = LlamaForCausalLM(cfg_d)
+    model_f = LlamaForCausalLM(cfg_f)
+    params = sharded_params(model_d.init(jax.random.PRNGKey(1), ids))
+
+    lg_d = jax.jit(lambda p, i: model_d.apply(p, i, positions, segment_ids=seg))(params, ids)
+    lg_f = jax.jit(lambda p, i: model_f.apply(p, i, positions, segment_ids=seg))(params, ids)
+    live = np.asarray(seg)[:, :, None] > 0
+    np.testing.assert_allclose(np.asarray(lg_f) * live, np.asarray(lg_d) * live,
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(m):
+        def f(p):
+            lg = m.apply(p, ids, positions, segment_ids=seg)
+            mask = (seg > 0).astype(jnp.float32)[:, :, None]
+            return jnp.mean((lg.astype(jnp.float32) * mask) ** 2)
+        return f
+
+    g_d = jax.jit(jax.grad(loss(model_d)))(params)
+    g_f = jax.jit(jax.grad(loss(model_f)))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5),
+        g_d, g_f,
+    )
+
+
+def test_segmented_flash_rejects_cp(cp_mesh):
+    from neuronx_distributed_tpu.ops import ring_attention
+
+    q, k, v = _qkv(jax.random.PRNGKey(21), 1, 4, 4, 64, 64, 8)
+    seg = jnp.ones((1, 64), jnp.int32)
+    with pytest.raises(ValueError, match="context_parallel_size"):
+        ring_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                       v.transpose(0, 2, 1, 3), segment_ids=seg)
+
+
+def test_packed_flash_odd_seq_falls_back_to_dense(devices8):
+    """A packed batch with a non-128-divisible sequence must keep working
+    (dense-core fallback), not crash at trace time."""
+    from conftest import sharded_params
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    initialize_model_parallel(tensor_parallel_size=2, devices=devices8)
+    cfg = LlamaConfig.tiny(attention_impl="flash", sequence_parallel=False,
+                           dtype=jnp.float32, param_dtype=jnp.float32,
+                           max_seq_len=96, remat="none")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 96), 0, cfg.vocab_size)
+    seg = jnp.concatenate([jnp.ones((2, 40), jnp.int32),
+                           2 * jnp.ones((2, 56), jnp.int32)], axis=1)
+    positions = jnp.broadcast_to(jnp.arange(96), ids.shape)
+    model = LlamaForCausalLM(cfg)
+    params = sharded_params(model.init(jax.random.PRNGKey(1), ids))
+    lg = jax.jit(lambda p, i: model.apply(p, i, positions, segment_ids=seg))(params, ids)
+    assert np.isfinite(np.asarray(lg)).all()

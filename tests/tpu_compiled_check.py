@@ -76,6 +76,50 @@ def main() -> int:
         if bad:
             failures.append((name, bad))
 
+    # segmented (packed-pretraining) kernel, compiled — lane/sublane segment
+    # tile layouts are TPU-specific and must be exercised on hardware
+    import numpy as np
+
+    from neuronx_distributed_tpu.ops.flash_attention import flash_attention_segmented
+
+    B, H, S, D = 2, 8, 512, 128
+    kq, kk2_, kv3, kd = jax.random.split(jax.random.PRNGKey(44), 4)
+    q = jax.random.normal(kq, (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(kk2_, (B, H, S, D), jnp.bfloat16)
+    v = jax.random.normal(kv3, (B, H, S, D), jnp.bfloat16)
+    do = jax.random.normal(kd, (B, H, S, D), jnp.bfloat16)
+    seg_np = np.zeros((B, S), np.int32)
+    seg_np[0, :200] = 1; seg_np[0, 200:480] = 2
+    seg_np[1, :256] = 1; seg_np[1, 256:] = 2
+    seg = jnp.asarray(seg_np)
+    live = jnp.asarray((seg_np > 0)[:, None, :, None].astype(np.float32))
+
+    def seg_loss(q, k, v):
+        o = flash_attention_segmented(q, k, v, seg, seg, True, None, 512, 512, False)
+        return jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32) * live)
+
+    def seg_loss_ref(q, k, v):
+        qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+        s = jnp.einsum("bhsd,bhtd->bhst", qf, kf) * (D ** -0.5)
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        same = (seg[:, :, None] == seg[:, None, :]) & (seg > 0)[:, :, None]
+        s = jnp.where((causal[None] & same)[:, None], s, -1e30)
+        o = jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(s, -1), vf)
+        return jnp.sum(o * do.astype(jnp.float32) * live)
+
+    l, g = jax.jit(jax.value_and_grad(seg_loss, argnums=(0, 1, 2)))(q, k, v)
+    lr, gr = jax.jit(jax.value_and_grad(seg_loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(g)
+    errs = {"loss": abs(float(l) - float(lr)) / (abs(float(lr)) + 1e-9)}
+    for nm, a, b in zip(("dq", "dk", "dv"), g, gr):
+        num = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        errs[nm] = num / (float(jnp.max(jnp.abs(b))) + 1e-9)
+    bad = {kk3: vv for kk3, vv in errs.items() if vv > 3e-2}
+    print(f"segmented: {'FAIL' if bad else 'ok'} "
+          + " ".join(f"{kk3}={vv:.4f}" for kk3, vv in errs.items()))
+    if bad:
+        failures.append(("segmented", bad))
+
     return 1 if failures else 0
 
 
