@@ -57,6 +57,12 @@ def parse_args():
     p.add_argument("--warmup-steps", type=int, default=10)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--data", default=None, help="NXDT token file (synthetic data if unset)")
+    p.add_argument("--packed", action="store_true",
+                   help="treat --data as an eos-joined document stream: split, "
+                        "first-fit pack with segment masking and per-document "
+                        "RoPE positions (data.packing) instead of flat chunking")
+    p.add_argument("--packed-eos-id", type=int, default=None,
+                   help="eos id separating documents in --data (required with --packed)")
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=50)
     p.add_argument("--keep-ckpts", type=int, default=3)
@@ -68,7 +74,10 @@ def parse_args():
     p.add_argument("--bf16", action="store_true", help="bf16 compute (default fp32 off-TPU)")
     p.add_argument("--virtual-devices", type=int, default=None,
                    help="force an N-device virtual CPU mesh (dev/test runs)")
-    return p.parse_args()
+    args = p.parse_args()
+    if args.packed and not args.data:
+        p.error("--packed requires --data (an eos-joined NXDT document stream)")
+    return args
 
 
 def main():
@@ -139,10 +148,11 @@ def main():
     )
     # warmup-cosine comes from the config contract (OptimizerConfig.lr_schedule)
     opt = initialize_parallel_optimizer(config, model)
-    step_fn = make_train_step(
-        config, model, opt, causal_lm_loss,
-        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()},
-    )
+    bspec = {"ids": default_batch_spec(), "labels": default_batch_spec()}
+    if args.packed:
+        bspec.update({"positions": default_batch_spec(),
+                      "segment_ids": default_batch_spec()})
+    step_fn = make_train_step(config, model, opt, causal_lm_loss, batch_spec=bspec)
     params, opt_state = model.params, opt.state
 
     start_step = 0
@@ -154,7 +164,52 @@ def main():
 
     # data: NXDT corpus through the native loader, or synthetic
     dp = nxd.get_data_parallel_size()
-    if args.data:
+    if args.data and args.packed:
+        import numpy as np
+
+        from neuronx_distributed_tpu.data import TokenDataset
+        from neuronx_distributed_tpu.data.loader import read_token_file
+        from neuronx_distributed_tpu.data.packing import pack_documents
+
+        if args.packed_eos_id is None:
+            raise SystemExit("--packed requires --packed-eos-id")
+        TokenDataset(args.data).validate_vocab(cfg.vocab_size)
+        toks = np.asarray(read_token_file(args.data))
+        cuts = np.where(toks == args.packed_eos_id)[0]
+        docs = [d[d != args.packed_eos_id] for d in np.split(toks, cuts + 1)]
+        docs = [d for d in docs if d.size]
+        ids_all, labels_all, segs_all = pack_documents(
+            docs, seq_len=args.seq_len, eos_id=args.packed_eos_id)
+        # per-document RoPE phases: position = offset within the segment run
+        S = args.seq_len
+        start = np.zeros_like(segs_all)
+        changes = segs_all[:, 1:] != segs_all[:, :-1]
+        start[:, 1:] = np.where(changes, np.arange(1, S)[None, :], 0)
+        start = np.maximum.accumulate(start, axis=1)
+        pos_all = (np.arange(S)[None, :] - start).astype(np.int32)
+        n_rows = ids_all.shape[0]
+        if n_rows < args.batch_size:
+            raise SystemExit(
+                f"packing produced {n_rows} rows < batch size {args.batch_size}")
+        print(f"packed {len(docs)} documents into {n_rows} rows of {S}")
+
+        def next_batch(step):
+            # exact one-pass-per-epoch shuffle: element i of the batch is
+            # global sample step*B+i, mapped through its OWN epoch's
+            # permutation — no duplicated/skipped rows at epoch boundaries
+            B = args.batch_size
+            idxs = np.arange(step * B, (step + 1) * B)
+            epochs = idxs // n_rows
+            sel = np.empty(B, np.int64)
+            for e in np.unique(epochs):
+                perm = np.random.RandomState(args.seed + int(e)).permutation(n_rows)
+                m = epochs == e
+                sel[m] = perm[idxs[m] % n_rows]
+            return {"ids": jnp.asarray(ids_all[sel]),
+                    "labels": jnp.asarray(labels_all[sel]),
+                    "positions": jnp.asarray(pos_all[sel]),
+                    "segment_ids": jnp.asarray(segs_all[sel])}
+    elif args.data:
         from neuronx_distributed_tpu.data import TokenDataLoader, TokenDataset
 
         ds = TokenDataset(args.data)

@@ -382,7 +382,9 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
     delta = jnp.broadcast_to(delta_rows[..., None], (B, HQ, S, LANES))
 
     if segmented:
-        qs, ks, _, _ = _seg_operands(q_seg, kv_seg, B, S, T, bq, bk)
+        # the returned specs' (b, h, qi, ki) index maps match the dq grid;
+        # the dkv kernel's transposed (b, h, ki, qi) grid declares its own
+        qs, ks, qs_spec, ks_spec = _seg_operands(q_seg, kv_seg, B, S, T, bq, bk)
 
     def dq_kernel(*refs):
         if segmented:
@@ -405,10 +407,7 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
     ]
     dq_operands = [q, k, v, do, lse, delta]
     if segmented:
-        dq_in_specs += [
-            pl.BlockSpec((1, bq, LANES), lambda b, h, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, _SUBLANES, bk), lambda b, h, qi, ki: (b, 0, ki)),
-        ]
+        dq_in_specs += [qs_spec, ks_spec]
         dq_operands += [qs, ks]
 
     dq = pl.pallas_call(

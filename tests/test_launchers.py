@@ -84,3 +84,27 @@ def test_bert_launcher(tmp_path):
     )
     rec = json.loads(metrics.read_text())
     assert rec["completed_steps"] == 3
+
+
+def test_llama_launcher_packed_mode(tmp_path):
+    """--packed: corpus -> packer -> segment-masked training, loss finite and
+    the flash path engaged (128-divisible sequence)."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.data.loader import write_token_file
+
+    rng = np.random.RandomState(0)
+    docs = []
+    for _ in range(50):
+        docs.extend(rng.randint(1, 250, size=rng.randint(10, 60)).tolist() + [255])
+    data = tmp_path / "docs.nxdt"
+    write_token_file(str(data), np.asarray(docs, np.int64))
+
+    proc = _run(
+        "llama_pretrain.py", "--preset", "tiny", "--tp", "2", "--batch-size", "4",
+        "--seq-len", "128", "--steps", "4", "--lr", "3e-3",
+        "--data", str(data), "--packed", "--packed-eos-id", "255",
+    )
+    assert "packed" in proc.stdout
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    assert json.loads(last)["loss"] > 0
