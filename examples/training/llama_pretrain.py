@@ -77,6 +77,12 @@ def parse_args():
     args = p.parse_args()
     if args.packed and not args.data:
         p.error("--packed requires --data (an eos-joined NXDT document stream)")
+    if args.packed and args.packed_eos_id is None:
+        p.error("--packed requires --packed-eos-id")
+    if args.packed and args.pp > 1:
+        p.error("--packed requires --pp 1: the pipeline engine's schedule "
+                "loss carries no positions/segment_ids channel, so packing "
+                "would silently degrade to cross-document attention")
     return args
 
 
@@ -171,8 +177,6 @@ def main():
         from neuronx_distributed_tpu.data.loader import read_token_file
         from neuronx_distributed_tpu.data.packing import pack_documents
 
-        if args.packed_eos_id is None:
-            raise SystemExit("--packed requires --packed-eos-id")
         TokenDataset(args.data).validate_vocab(cfg.vocab_size)
         toks = np.asarray(read_token_file(args.data))
         cuts = np.where(toks == args.packed_eos_id)[0]
@@ -193,6 +197,14 @@ def main():
                 f"packing produced {n_rows} rows < batch size {args.batch_size}")
         print(f"packed {len(docs)} documents into {n_rows} rows of {S}")
 
+        perm_cache = {}
+
+        def epoch_perm(e):
+            if e not in perm_cache:
+                perm_cache.clear() if len(perm_cache) > 2 else None
+                perm_cache[e] = np.random.RandomState(args.seed + int(e)).permutation(n_rows)
+            return perm_cache[e]
+
         def next_batch(step):
             # exact one-pass-per-epoch shuffle: element i of the batch is
             # global sample step*B+i, mapped through its OWN epoch's
@@ -202,9 +214,8 @@ def main():
             epochs = idxs // n_rows
             sel = np.empty(B, np.int64)
             for e in np.unique(epochs):
-                perm = np.random.RandomState(args.seed + int(e)).permutation(n_rows)
                 m = epochs == e
-                sel[m] = perm[idxs[m] % n_rows]
+                sel[m] = epoch_perm(e)[idxs[m] % n_rows]
             return {"ids": jnp.asarray(ids_all[sel]),
                     "labels": jnp.asarray(labels_all[sel]),
                     "positions": jnp.asarray(pos_all[sel]),

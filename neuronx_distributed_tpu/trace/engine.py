@@ -57,21 +57,11 @@ from neuronx_distributed_tpu.utils.logger import get_logger
 logger = get_logger(__name__)
 
 
-def _sample_logits(logits, rng, temperature, top_k=0, top_p=1.0):
-    """Greedy / temperature / top-k / nucleus sampling.
-
-    ``top_k > 0`` keeps only the k most likely tokens; ``top_p < 1`` keeps
-    the smallest prefix of the sorted distribution whose mass reaches p
-    (applied after top-k).  All three knobs may be TRACED scalars — one
-    compiled program serves every sampler setting (per-request settings must
-    not each pay an XLA compile) — with the pure-greedy Python-float
-    ``temperature == 0.0`` short-circuit kept so greedy callers need no rng.
-    Serving parity with HF ``generate``'s standard sampler knobs (the
-    reference drives its compiled pair through HF generate,
-    ``neuron_modeling_llama.py:437-465``)."""
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if isinstance(temperature, (int, float)) and float(temperature) == 0.0:
-        return greedy
+def _filtered_logits(logits, temperature, top_k=0, top_p=1.0):
+    """Temperature/top-k/nucleus-filtered fp32 logits — the distribution the
+    sampler actually draws from (dropped tokens at -inf-equivalent).  Shared
+    by :func:`_sample_logits` and the sampled speculative-decoding accept
+    test, which needs the filtered p/q distributions themselves."""
     logits = logits.astype(jnp.float32) / jnp.maximum(
         jnp.asarray(temperature, jnp.float32), 1e-6
     )
@@ -91,8 +81,26 @@ def _sample_logits(logits, rng, temperature, top_k=0, top_p=1.0):
     # nucleus (a max here would keep only the argmax — greedy in disguise)
     cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
                      keepdims=True)
-    logits = jnp.where((top_p < 1.0) & (logits < cutoff), neg, logits)
-    sampled = jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return jnp.where((top_p < 1.0) & (logits < cutoff), neg, logits)
+
+
+def _sample_logits(logits, rng, temperature, top_k=0, top_p=1.0):
+    """Greedy / temperature / top-k / nucleus sampling.
+
+    ``top_k > 0`` keeps only the k most likely tokens; ``top_p < 1`` keeps
+    the smallest prefix of the sorted distribution whose mass reaches p
+    (applied after top-k).  All three knobs may be TRACED scalars — one
+    compiled program serves every sampler setting (per-request settings must
+    not each pay an XLA compile) — with the pure-greedy Python-float
+    ``temperature == 0.0`` short-circuit kept so greedy callers need no rng.
+    Serving parity with HF ``generate``'s standard sampler knobs (the
+    reference drives its compiled pair through HF generate,
+    ``neuron_modeling_llama.py:437-465``)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if isinstance(temperature, (int, float)) and float(temperature) == 0.0:
+        return greedy
+    filtered = _filtered_logits(logits, temperature, top_k, top_p)
+    sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
     return jnp.where(jnp.asarray(temperature, jnp.float32) > 0.0, sampled, greedy)
 
 
@@ -636,15 +644,30 @@ def speculative_generate(
     k: int = 4,
     prompt_lens: Optional[jax.Array] = None,
     return_stats: bool = False,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    rng: Optional[jax.Array] = None,
 ):
-    """Greedy speculative decoding: a small draft model proposes ``k`` tokens
-    per round, the target verifies them in ONE chunked forward, and the
-    output is PROVABLY identical to the target's own greedy decode (accept
-    while the target's argmax agrees; the first disagreement is replaced by
-    the target's token, and a fully-accepted round yields the target's bonus
-    token).  Per-round host sync replaces per-token host sync, and the
-    target runs ``ceil(n / (accepted+1))`` chunk forwards instead of ``n``
-    single-token steps — the serving win when the draft is much smaller.
+    """Speculative decoding: a small draft model proposes ``k`` tokens per
+    round and the target verifies them in ONE chunked forward.  Per-round
+    host sync replaces per-token host sync, and the target runs
+    ``ceil(n / (accepted+1))`` chunk forwards instead of ``n`` single-token
+    steps — the serving win when the draft is much smaller.
+
+    ``temperature == 0`` (default): greedy — accept while the target's
+    argmax agrees; the first disagreement is replaced by the target's token,
+    and a fully-accepted round yields the target's bonus token.  The output
+    is PROVABLY identical to the target's own greedy decode.
+
+    ``temperature > 0`` (with the same ``top_k``/``top_p`` knobs as
+    ``generate``): the standard accept/reject sampler (Leviathan et al.) —
+    proposals accepted with prob ``min(1, p/q)``, rejections resampled from
+    the residual ``norm(max(p - q, 0))`` — whose outputs are distributed
+    EXACTLY as the target's own sampler.  Token-index rng keys match
+    ``generate``'s stream, so with ``draft == target`` the sampled output is
+    bit-identical to plain sampled generation (the positive control the
+    tests pin).
 
     ``target``/``draft`` must share the tokenizer and serving shapes
     (``batch_size``, ``context_len``, ``max_total_len``).  Rejected cache
@@ -687,6 +710,13 @@ def speculative_generate(
         raise ValueError(f"k must be >= 1, got {k}")
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    sampling = not (isinstance(temperature, (int, float)) and float(temperature) == 0.0)
+    if sampling and rng is None:
+        raise ValueError("temperature sampling requires an rng key")
+    # token-index keys match generate()'s fold_in(rng, i) stream, so with
+    # draft == target the sampled output is bit-identical to plain sampling;
+    # accept coins and residual resampling use salted sub-streams
+    _ACC, _RES = 7919, 104729
 
     valid_ctx = target._valid_ctx(prompt_lens)
     tail = jnp.zeros((B, T - C), jnp.int32)
@@ -696,7 +726,12 @@ def speculative_generate(
     logits_t, caches_t = target.context(target.params, prompt_ids.astype(jnp.int32), valid_ctx)
     _, caches_d = draft.context(draft.params, prompt_ids.astype(jnp.int32), valid_ctx)
 
-    committed = [jnp.argmax(logits_t, axis=-1).astype(jnp.int32)[:, None]]
+    if sampling:
+        first = _sample_logits(logits_t, jax.random.fold_in(rng, 0),
+                               temperature, top_k, top_p)
+    else:
+        first = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+    committed = [first[:, None]]
     n_done = 1
     offset = C  # cache index of the next write; committed[-1] not yet written
     rounds = proposed_total = accepted_total = 0
@@ -705,13 +740,22 @@ def speculative_generate(
         kk = min(k, max_new_tokens - n_done)
         # --- draft proposes kk tokens (its decode also ingests committed[-1])
         proposals = []
+        q_filtered = []
         tok = committed[-1]
         vd = valid_d
         for j in range(kk):
             dlogits, caches_d, vd = draft.decode(
                 draft.params, tok, jnp.int32(offset + j), caches_d, vd
             )
-            tok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)[:, None]
+            if sampling:
+                qf = _filtered_logits(dlogits, temperature, top_k, top_p)
+                q_filtered.append(qf)
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(rng, n_done + j), qf, axis=-1
+                ).astype(jnp.int32)
+            else:
+                nxt = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            tok = nxt[:, None]
             proposals.append(tok)
         props = jnp.concatenate(proposals, axis=1)  # [B, kk]
 
@@ -720,20 +764,64 @@ def speculative_generate(
         logits_full, caches_t, valid_t = target.score_chunk(
             chunk, offset, caches_t, valid_t
         )
-        tgt = jnp.argmax(logits_full, axis=-1).astype(jnp.int32)  # [B, kk+1]
 
-        # leading agreement across the batch (lockstep: the whole batch
-        # advances by the minimum acceptance, keeping one shared offset)
-        agree = np.asarray(tgt[:, :kk] == props)  # host sync, once per round
-        lead = np.minimum.accumulate(agree, axis=1)
-        j = int(lead.all(axis=0).sum())  # tokens accepted this round
+        if sampling:
+            # Leviathan et al. accept/reject: accept x ~ q with prob
+            # min(1, p(x)/q(x)); the first rejection resamples from the
+            # residual norm(max(p - q, 0)).  Lockstep: the batch advances by
+            # the MINIMUM acceptance; rows cut before their own rejection
+            # discard their coin and resample that position directly from p
+            # (both are exact draws from p).
+            pf = _filtered_logits(logits_full, temperature, top_k, top_p)
+            p_probs = jax.nn.softmax(pf[:, :kk], axis=-1)  # [B, kk, V]
+            q_probs = jax.nn.softmax(jnp.stack(q_filtered, axis=1), axis=-1)
+            px = jnp.take_along_axis(p_probs, props[..., None], axis=-1)[..., 0]
+            qx = jnp.take_along_axis(q_probs, props[..., None], axis=-1)[..., 0]
+            u = jax.random.uniform(
+                jax.random.fold_in(jax.random.fold_in(rng, _ACC), n_done), (B, kk)
+            )
+            accept = np.asarray(u < jnp.minimum(1.0, px / jnp.maximum(qx, 1e-20)))
+            lead = np.minimum.accumulate(accept, axis=1)
+            j = int(lead.all(axis=0).sum())
+            take = min(j + 1, max_new_tokens - n_done)
+            for i in range(min(take, j)):
+                committed.append(props[:, i:i + 1])
+            if take == j + 1:  # corrective / bonus position
+                if j == kk:  # full accept: bonus straight from p_{kk}
+                    nxt = jax.random.categorical(
+                        jax.random.fold_in(rng, n_done + kk), pf[:, kk], axis=-1
+                    ).astype(jnp.int32)
+                else:
+                    res = jnp.maximum(p_probs[:, j] - q_probs[:, j], 0.0)
+                    res_sum = jnp.sum(res, axis=-1, keepdims=True)
+                    # rows whose own coin chain was still accepting at j draw
+                    # from p directly; degenerate all-zero residuals (p <= q
+                    # everywhere off the sample) also fall back to p
+                    rejected = jnp.asarray(~lead[:, j])[:, None]
+                    use_res = jnp.logical_and(rejected, res_sum > 0)
+                    dist = jnp.where(use_res, res / jnp.maximum(res_sum, 1e-20),
+                                     p_probs[:, j])
+                    nxt = jax.random.categorical(
+                        jax.random.fold_in(
+                            jax.random.fold_in(rng, _RES), n_done + j),
+                        jnp.log(jnp.maximum(dist, 1e-20)), axis=-1,
+                    ).astype(jnp.int32)
+                committed.append(nxt[:, None])
+        else:
+            tgt = jnp.argmax(logits_full, axis=-1).astype(jnp.int32)  # [B, kk+1]
 
-        take = min(j + 1, max_new_tokens - n_done)  # proposals then a target token
-        for i in range(take - 1):
-            committed.append(props[:, i:i + 1])
-        # tgt[:, take-1] is t_{take}: the corrective/bonus token when
-        # take == j+1, and (== p_take) the clipped final token otherwise
-        committed.append(tgt[:, take - 1:take])
+            # leading agreement across the batch (lockstep: the whole batch
+            # advances by the minimum acceptance, keeping one shared offset)
+            agree = np.asarray(tgt[:, :kk] == props)  # host sync, once per round
+            lead = np.minimum.accumulate(agree, axis=1)
+            j = int(lead.all(axis=0).sum())  # tokens accepted this round
+
+            take = min(j + 1, max_new_tokens - n_done)  # proposals then a target token
+            for i in range(take - 1):
+                committed.append(props[:, i:i + 1])
+            # tgt[:, take-1] is t_{take}: the corrective/bonus token when
+            # take == j+1, and (== p_take) the clipped final token otherwise
+            committed.append(tgt[:, take - 1:take])
         if take == kk + 1:
             # full accept: the draft proposed p_kk but never WROTE it (its
             # last decode produced it); the slot now lies inside the

@@ -87,8 +87,9 @@ def test_bert_launcher(tmp_path):
 
 
 def test_llama_launcher_packed_mode(tmp_path):
-    """--packed: corpus -> packer -> segment-masked training, loss finite and
-    the flash path engaged (128-divisible sequence)."""
+    """--packed: corpus -> packer -> segment-masked training through the
+    FLASH path (--attention flash, 128-divisible sequence: the segmented
+    kernel runs in the pallas interpreter on the CPU mesh)."""
     import numpy as np
 
     from neuronx_distributed_tpu.data.loader import write_token_file
@@ -102,7 +103,7 @@ def test_llama_launcher_packed_mode(tmp_path):
 
     proc = _run(
         "llama_pretrain.py", "--preset", "tiny", "--tp", "2", "--batch-size", "4",
-        "--seq-len", "128", "--steps", "4", "--lr", "3e-3",
+        "--seq-len", "128", "--steps", "4", "--lr", "3e-3", "--attention", "flash",
         "--data", str(data), "--packed", "--packed-eos-id", "255",
     )
     assert "packed" in proc.stdout

@@ -367,3 +367,40 @@ def test_speculative_vocab_mismatch_raises(devices8):
         d_mod, sharded_params(d_mod.init(jax.random.PRNGKey(1), jnp.zeros((2, 8), jnp.int32))), icfg)
     with pytest.raises(ValueError, match="vocab_size"):
         speculative_generate(tgt, drf, jnp.zeros((2, 8), jnp.int32), max_new_tokens=4)
+
+
+def test_speculative_sampling_self_draft_bit_identical(devices8):
+    """Sampled spec decode with draft == target must reproduce plain sampled
+    generate BIT-identically (shared token-index rng stream; acceptance prob
+    min(1, p/q) == 1) — the exactness control for the accept/reject path."""
+    from neuronx_distributed_tpu.trace import speculative_generate
+
+    tgt, _, cfg = _spec_pair(devices8)
+    prompts = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0, cfg.vocab_size)
+    rng = jax.random.PRNGKey(42)
+    want = tgt.generate(prompts, max_new_tokens=14, temperature=0.8,
+                        top_k=20, top_p=0.95, rng=rng)
+    got, stats = speculative_generate(
+        tgt, tgt, prompts, max_new_tokens=14, k=3, temperature=0.8,
+        top_k=20, top_p=0.95, rng=rng, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert stats["acceptance_rate"] == 1.0
+
+
+def test_speculative_sampling_mixed_draft_runs(devices8):
+    """Real (different) draft: outputs are valid tokens, deterministic for a
+    fixed rng, and the greedy short-circuit still matches target greedy."""
+    from neuronx_distributed_tpu.trace import speculative_generate
+
+    tgt, drf, cfg = _spec_pair(devices8, seed=3)
+    prompts = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0, cfg.vocab_size)
+    rng = jax.random.PRNGKey(1)
+    out1 = speculative_generate(tgt, drf, prompts, max_new_tokens=10, k=3,
+                                temperature=0.7, rng=rng)
+    out2 = speculative_generate(tgt, drf, prompts, max_new_tokens=10, k=3,
+                                temperature=0.7, rng=rng)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert out1.shape == (2, 18)
+    assert (np.asarray(out1) >= 0).all() and (np.asarray(out1) < cfg.vocab_size).all()
+    with pytest.raises(ValueError, match="rng"):
+        speculative_generate(tgt, drf, prompts, max_new_tokens=4, temperature=0.5)
