@@ -79,10 +79,6 @@ def parse_args():
         p.error("--packed requires --data (an eos-joined NXDT document stream)")
     if args.packed and args.packed_eos_id is None:
         p.error("--packed requires --packed-eos-id")
-    if args.packed and args.pp > 1:
-        p.error("--packed requires --pp 1: the pipeline engine's schedule "
-                "loss carries no positions/segment_ids channel, so packing "
-                "would silently degrade to cross-document attention")
     return args
 
 
@@ -129,6 +125,7 @@ def main():
         kv_size_multiplier=args.kv_multiplier,
         num_microbatches=args.microbatches,
         schedule=args.pp_schedule,
+        packed_inputs=args.packed and args.pp > 1,
         learning_rate=args.lr,
         lr_schedule="cosine",
         warmup_steps=args.warmup_steps,
@@ -175,7 +172,7 @@ def main():
 
         from neuronx_distributed_tpu.data import TokenDataset
         from neuronx_distributed_tpu.data.loader import read_token_file
-        from neuronx_distributed_tpu.data.packing import pack_documents
+        from neuronx_distributed_tpu.data.packing import pack_documents, segment_positions
 
         TokenDataset(args.data).validate_vocab(cfg.vocab_size)
         toks = np.asarray(read_token_file(args.data))
@@ -184,18 +181,12 @@ def main():
         docs = [d for d in docs if d.size]
         ids_all, labels_all, segs_all = pack_documents(
             docs, seq_len=args.seq_len, eos_id=args.packed_eos_id)
-        # per-document RoPE phases: position = offset within the segment run
-        S = args.seq_len
-        start = np.zeros_like(segs_all)
-        changes = segs_all[:, 1:] != segs_all[:, :-1]
-        start[:, 1:] = np.where(changes, np.arange(1, S)[None, :], 0)
-        start = np.maximum.accumulate(start, axis=1)
-        pos_all = (np.arange(S)[None, :] - start).astype(np.int32)
+        pos_all = segment_positions(segs_all)
         n_rows = ids_all.shape[0]
         if n_rows < args.batch_size:
             raise SystemExit(
                 f"packing produced {n_rows} rows < batch size {args.batch_size}")
-        print(f"packed {len(docs)} documents into {n_rows} rows of {S}")
+        print(f"packed {len(docs)} documents into {n_rows} rows of {args.seq_len}")
 
         perm_cache = {}
 

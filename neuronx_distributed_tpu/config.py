@@ -51,6 +51,10 @@ class PipelineConfig:
     # stage, the reference's pipeline_cuts).  Give the last stage fewer
     # layers to offset its cond-gated head+loss work.  None = balanced.
     pipeline_cuts: Optional[tuple] = None
+    # packed pretraining under PP: the engine threads per-token
+    # positions/segment_ids extras through the schedule (the builder must
+    # support it — the Llama family does); batches must carry both keys
+    packed_inputs: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

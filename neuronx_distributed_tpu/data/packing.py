@@ -108,3 +108,16 @@ def pack_documents(
             segs[r, pos : pos + L] = si
             pos += L
     return ids, labels, segs
+
+
+def segment_positions(segment_ids: np.ndarray) -> np.ndarray:
+    """Per-document RoPE positions from ``[N, S]`` segment ids: position =
+    offset within the segment's contiguous run (0 on padding too).  The
+    companion of :func:`pack_documents` every packed consumer needs."""
+    segment_ids = np.asarray(segment_ids)
+    S = segment_ids.shape[-1]
+    start = np.zeros_like(segment_ids)
+    changes = segment_ids[..., 1:] != segment_ids[..., :-1]
+    start[..., 1:] = np.where(changes, np.arange(1, S), 0)
+    start = np.maximum.accumulate(start, axis=-1)
+    return (np.arange(S) - start).astype(np.int32)

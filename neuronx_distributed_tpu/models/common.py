@@ -123,6 +123,7 @@ def build_pipelined_causal_lm(
     schedule: str = "1f1b",
     pipeline_cuts=None,
     block_aux: bool = False,
+    extra_keys=(),
 ):
     """Shared engine wiring for pipeline-parallel causal-LM families.
 
@@ -182,4 +183,5 @@ def build_pipelined_causal_lm(
         ),
         block_aux=block_aux,
         pipeline_cuts=pipeline_cuts,
+        extra_keys=extra_keys,
     )

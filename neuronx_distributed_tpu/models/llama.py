@@ -443,12 +443,12 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.nowrap
     def build_pipelined(self, num_microbatches: int, schedule: str = "1f1b", seed: int = 0,
-                        pipeline_cuts=None):
+                        pipeline_cuts=None, packed=False):
         """Pipeline-capable-model protocol consumed by
         ``initialize_parallel_model`` when ``pipeline_parallel_size > 1``."""
         return build_pipelined_llama(
             self.config, num_microbatches=num_microbatches, seed=seed, schedule=schedule,
-            pipeline_cuts=pipeline_cuts,
+            pipeline_cuts=pipeline_cuts, packed=packed,
         )
 
     @nn.compact
@@ -499,7 +499,7 @@ class LlamaHead(nn.Module):
 
 def build_pipelined_llama(
     cfg: LlamaConfig, num_microbatches: int, seed: int = 0, schedule: str = "1f1b",
-    pipeline_cuts=None,
+    pipeline_cuts=None, packed: bool = False,
 ):
     """Construct a :class:`~neuronx_distributed_tpu.pipeline.engine.PipelinedModel`
     for pipeline-parallel Llama training.
@@ -521,6 +521,24 @@ def build_pipelined_llama(
     head_mod = LlamaHead(cfg)
     moe = cfg.num_experts > 1
 
+    # packed pretraining under PP: the engine threads per-token extras
+    # (positions, segment_ids) through the schedule to every block call —
+    # segment masking and per-document RoPE work exactly as at pp == 1
+    def _block_args(x, extras):
+        if packed:
+            if len(extras) != 2:
+                raise TypeError(
+                    "packed pipelined model: the schedule functions take "
+                    "(params, ids, labels, positions, segment_ids) — call "
+                    "loss_fn/loss_and_grad_fn/forward_fn with both extras "
+                    "(the trainer's make_train_step does this from the "
+                    "batch's 'positions'/'segment_ids' keys)"
+                )
+            positions, segment_ids = extras
+            return (x, positions, None, 0, None, segment_ids)
+        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+        return (x, positions)
+
     if moe:
         # MoE block: hand the sown load-balancing term to the engine's aux
         # channel (coefficient folded here so the engine's layer-mean
@@ -531,18 +549,16 @@ def build_pipelined_llama(
         # is per-rank-local (parallel/moe._auto_spec).
         from neuronx_distributed_tpu.models.common import MOE_AUX_COEF
 
-        def block_fn(lp, x):
-            positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+        def block_fn(lp, x, *extras):
             (y, _), variables = block_mod.apply(
-                {"params": lp}, x, positions, mutable=["losses"]
+                {"params": lp}, *_block_args(x, extras), mutable=["losses"]
             )
             terms = jax.tree.leaves(variables.get("losses", {}))
             aux = MOE_AUX_COEF * jnp.sum(jnp.stack(terms)) if terms else jnp.zeros(())
             return y, aux
     else:
-        def block_fn(lp, x):
-            positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
-            y, _ = block_mod.apply({"params": lp}, x, positions)
+        def block_fn(lp, x, *extras):
+            y, _ = block_mod.apply({"params": lp}, *_block_args(x, extras))
             return y
 
     return build_pipelined_causal_lm(
@@ -561,6 +577,7 @@ def build_pipelined_llama(
         schedule=schedule,
         pipeline_cuts=pipeline_cuts,
         block_aux=moe,
+        extra_keys=("positions", "segment_ids") if packed else (),
     )
 
 

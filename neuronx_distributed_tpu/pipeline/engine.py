@@ -107,17 +107,17 @@ def _make_stage_fn(blk, layer_mask, block_aux: bool = False, act_spec: Optional[
 
     cact = _make_cact(act_spec)
 
-    def call(layer_params, h):
+    def call(layer_params, h, extras):
         if block_aux:
-            y, a = blk(layer_params, h)
+            y, a = blk(layer_params, h, *extras)
             return y, a.astype(jnp.float32)
-        return blk(layer_params, h), jnp.zeros((), jnp.float32)
+        return blk(layer_params, h, *extras), jnp.zeros((), jnp.float32)
 
     if layer_mask is None:
-        def stage_fn(stage_params, x):
+        def stage_fn(stage_params, x, extras=()):
             def body(carry, layer_params):
                 h, aux = carry
-                y, a = call(layer_params, h)
+                y, a = call(layer_params, h, extras)
                 return (y, aux + a), None
 
             (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), stage_params)
@@ -127,7 +127,7 @@ def _make_stage_fn(blk, layer_mask, block_aux: bool = False, act_spec: Optional[
 
     mask_const = jnp.asarray(layer_mask, jnp.float32)
 
-    def stage_fn(stage_params, x):
+    def stage_fn(stage_params, x, extras=()):
         L_local = jax.tree.leaves(stage_params)[0].shape[0]
         if mask_const.shape[0] == L_local:
             local = mask_const  # pp == 1: the whole stack is local
@@ -140,7 +140,7 @@ def _make_stage_fn(blk, layer_mask, block_aux: bool = False, act_spec: Optional[
             layer_params, a = xs
             y, aux_l = lax.cond(
                 a > 0,
-                lambda lp, hh: (lambda o: (cact(o[0]), o[1]))(call(lp, hh)),
+                lambda lp, hh: (lambda o: (cact(o[0]), o[1]))(call(lp, hh, extras)),
                 lambda lp, hh: (cact(hh), jnp.zeros((), jnp.float32)),
                 layer_params, h,
             )
@@ -228,12 +228,16 @@ def make_pipelined_loss_fn(
         int(sum(layer_mask)) if layer_mask is not None else None  # else runtime L
     )
 
-    def loss_fn(params, ids: jax.Array, labels: jax.Array):
-        """ids/labels: [B, S] global batch."""
+    def loss_fn(params, ids: jax.Array, labels: jax.Array, *extras):
+        """ids/labels (+ per-token ``extras`` like positions/segment_ids,
+        each [B, S], microbatched identically): global batch."""
         # dp divisibility only binds on the pp>1 shard_map path (manual dp
         # batch split); pp==1 runs under GSPMD auto sharding
         ids_mb = microbatch(ids, num_microbatches, mesh if pp > 1 else None)
         labels_mb = microbatch(labels, num_microbatches, mesh if pp > 1 else None)
+        extras_mb = tuple(
+            microbatch(e, num_microbatches, mesh if pp > 1 else None) for e in extras
+        )
         L = jax.tree.leaves(params[LAYERS])[0].shape[0]
         layers_per_stage(L, pp)  # validate divisibility
         L_real = n_real_layers if n_real_layers is not None else L
@@ -244,8 +248,8 @@ def make_pipelined_loss_fn(
             tok_total = jnp.sum((labels >= 0).astype(jnp.float32))
 
             def one_mb(carry, mb):
-                i, l = mb
-                x, aux = stage_fn(params[LAYERS], embed_fn(params[EMBED], i))
+                i, l, *ex = mb
+                x, aux = stage_fn(params[LAYERS], embed_fn(params[EMBED], i), tuple(ex))
                 ls, n = head_loss_fn(params[HEAD], x, l)
                 s, c = carry
                 # aux: sum over layers for this microbatch; normalize to the
@@ -256,14 +260,14 @@ def make_pipelined_loss_fn(
 
             (loss_sum, tok), _ = lax.scan(
                 one_mb, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
-                (ids_mb, labels_mb),
+                (ids_mb, labels_mb, *extras_mb),
             )
             return loss_sum, tok
 
         T = M + pp - 1
         dpsz = mesh.shape[DATA_AXIS] * mesh.shape[EXPERT_AXIS]
 
-        def f(layer_stack, embed_params, head_params, ids_mb, labels_mb):
+        def f(layer_stack, embed_params, head_params, ids_mb, labels_mb, *extras_mb):
             # layer_stack leaves are the local [L/pp, ...] slice.
             rank = lax.axis_index(PIPELINE_AXIS)
             is_first = rank == 0
@@ -298,9 +302,15 @@ def make_pipelined_loss_fn(
                 )
                 x_in = jnp.where(is_first, x0, buf)
 
-                y, aux = stage_fn(layer_stack, x_in)
-                # this stage computes microbatch t - rank at tick t; bubble
-                # ticks run on garbage and their aux must not count
+                # this stage computes microbatch t - rank; extras must come
+                # from THAT microbatch (clipped on bubble ticks, masked out)
+                my_t = jnp.clip(t - rank, 0, M - 1)
+                ex_t = tuple(
+                    lax.dynamic_index_in_dim(e, my_t, axis=0, keepdims=False)
+                    for e in extras_mb
+                )
+                y, aux = stage_fn(layer_stack, x_in, ex_t)
+                # bubble ticks run on garbage and their aux must not count
                 fwd_valid = jnp.logical_and(t >= rank, t - rank < M)
                 loss_sum = loss_sum + jnp.where(fwd_valid, aux, 0.0) * aux_w
 
@@ -348,12 +358,14 @@ def make_pipelined_loss_fn(
         shmap = jax.shard_map(
             f,
             mesh=mesh,
-            in_specs=(P(PIPELINE_AXIS), P(), P(), P(None, BATCH_AXES), P(None, BATCH_AXES)),
+            in_specs=(P(PIPELINE_AXIS), P(), P(), P(None, BATCH_AXES), P(None, BATCH_AXES),
+                      *[P(None, BATCH_AXES)] * len(extras)),
             out_specs=(P(), P()),
             axis_names=frozenset({DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS}),
             check_vma=False,
         )
-        return shmap(params[LAYERS], params[EMBED], params[HEAD], ids_mb, labels_mb)
+        return shmap(params[LAYERS], params[EMBED], params[HEAD], ids_mb, labels_mb,
+                     *extras_mb)
 
     return loss_fn
 
@@ -439,9 +451,9 @@ def make_1f1b_loss_and_grad_fn(
             layer_mask=layer_mask, block_aux=block_aux, act_spec=act_spec,
         )
 
-        def loss_and_grad_pp1(params, ids, labels):
+        def loss_and_grad_pp1(params, ids, labels, *extras):
             (loss_sum, tok), grads = jax.value_and_grad(plain, has_aux=True)(
-                params, ids, labels
+                params, ids, labels, *extras
             )
             return (loss_sum, tok), grads
 
@@ -463,16 +475,17 @@ def make_1f1b_loss_and_grad_fn(
     fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
     bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
 
-    def loss_and_grad(params, ids: jax.Array, labels: jax.Array):
+    def loss_and_grad(params, ids: jax.Array, labels: jax.Array, *extras):
         ids_mb = microbatch(ids, M, mesh if pp > 1 else None)
         labels_mb = microbatch(labels, M, mesh if pp > 1 else None)
+        extras_mb = tuple(microbatch(e, M, mesh if pp > 1 else None) for e in extras)
         L = jax.tree.leaves(params[LAYERS])[0].shape[0]
         layers_per_stage(L, pp)  # validate divisibility
 
         L_real = n_real_layers if n_real_layers is not None else L
         dpsz = mesh.shape[DATA_AXIS] * mesh.shape[EXPERT_AXIS]
 
-        def f(layer_stack, embed_params, head_params, ids_mb, labels_mb):
+        def f(layer_stack, embed_params, head_params, ids_mb, labels_mb, *extras_mb):
             rank = lax.axis_index(PIPELINE_AXIS)
             is_first = rank == 0
             is_last = rank == pp - 1
@@ -533,7 +546,11 @@ def make_1f1b_loss_and_grad_fn(
                 stash = lax.dynamic_update_index_in_dim(
                     stash, jnp.where(do_f, x_in, x_stash), mf % Kf, 0
                 )
-                y, _ = stage_fn(layer_stack, x_in)  # aux counted in the bwd pass
+                ex_f = tuple(
+                    lax.dynamic_index_in_dim(e, jnp.maximum(mf, 0), 0, keepdims=False)
+                    for e in extras_mb
+                )
+                y, _ = stage_fn(layer_stack, x_in, ex_f)  # aux counted in the bwd
                 y = cact(y)
 
                 # ---------- backward part ----------
@@ -541,6 +558,10 @@ def make_1f1b_loss_and_grad_fn(
                 g_in = lax.dynamic_index_in_dim(gstash, mb % Kb, 0, keepdims=False)
                 lbl = lax.dynamic_index_in_dim(labels_mb, mb, 0, keepdims=False)
                 ids_b = lax.dynamic_index_in_dim(ids_mb, mb, 0, keepdims=False)
+                ex_b = tuple(
+                    lax.dynamic_index_in_dim(e, jnp.maximum(mb, 0), 0, keepdims=False)
+                    for e in extras_mb
+                )
 
                 def objective(lp, hp, xx):
                     """Last stage: the real loss.  Middle stages: <y, g_in>,
@@ -560,7 +581,7 @@ def make_1f1b_loss_and_grad_fn(
                     combine with ``pipeline_cuts`` giving the last stage
                     fewer layers to rebalance the tick critical path.  The
                     cond's vjp zeroes head grads on non-last ranks."""
-                    yy, aux = stage_fn(lp, xx)
+                    yy, aux = stage_fn(lp, xx, ex_b)
                     ls, n = lax.cond(
                         is_last,
                         lambda hp_, yy_: tuple(
@@ -647,12 +668,14 @@ def make_1f1b_loss_and_grad_fn(
         shmap = jax.shard_map(
             f,
             mesh=mesh,
-            in_specs=(P(PIPELINE_AXIS), P(), P(), P(None, BATCH_AXES), P(None, BATCH_AXES)),
+            in_specs=(P(PIPELINE_AXIS), P(), P(), P(None, BATCH_AXES), P(None, BATCH_AXES),
+                      *[P(None, BATCH_AXES)] * len(extras)),
             out_specs=((P(), P()), {LAYERS: P(PIPELINE_AXIS), EMBED: P(), HEAD: P()}),
             axis_names=frozenset({DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS}),
             check_vma=False,
         )
-        return shmap(params[LAYERS], params[EMBED], params[HEAD], ids_mb, labels_mb)
+        return shmap(params[LAYERS], params[EMBED], params[HEAD], ids_mb, labels_mb,
+                     *extras_mb)
 
     return loss_and_grad
 
@@ -681,6 +704,11 @@ class PipelinedModel:
     # padded layout from partition.padded_layer_layout otherwise) — consumers
     # like checkpoint converters index the [L', ...] stack through this
     layer_rows: Optional[Tuple[int, ...]] = None
+    # batch keys (beyond ids/labels) the schedule functions expect as extra
+    # positional per-token arrays — e.g. ("positions", "segment_ids") for
+    # packed pretraining; the trainer's pipelined step reads them from the
+    # batch dict in this order
+    extra_keys: Tuple[str, ...] = ()
 
     @property
     def param_shardings(self):
@@ -712,6 +740,7 @@ def build_pipelined_model(
     act_spec: Optional[P] = None,
     block_aux: bool = False,
     pipeline_cuts: Optional[Tuple[int, ...]] = None,
+    extra_keys: Tuple[str, ...] = (),
 ) -> PipelinedModel:
     """Initialize a pipelined model with stage parameters born sharded.
 
@@ -833,13 +862,41 @@ def build_pipelined_model(
             block_aux=block_aux,
         )
     elif schedule == "gpipe":
-        def loss_and_grad_fn(params, ids, labels):
+        def loss_and_grad_fn(params, ids, labels, *extras):
             (loss_sum, tok), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, ids, labels
+                params, ids, labels, *extras
             )
             return (loss_sum, tok), grads
     else:
         raise ValueError(f"unknown pipeline schedule {schedule!r} (1f1b | gpipe)")
+    if extra_keys:
+        # fail at the call boundary with the key names, not mid-trace with
+        # whatever unrelated error the missing operands trip first
+        n_extra = len(extra_keys)
+
+        def _check(got, fname):
+            if got != n_extra:
+                raise TypeError(
+                    f"{fname} of this pipelined model takes {n_extra} extra "
+                    f"per-token arrays ({', '.join(extra_keys)}) after its "
+                    f"ids/labels arguments; got {got} — the trainer's "
+                    "make_train_step supplies them from the batch dict"
+                )
+
+        _lf, _lg, _ff = loss_fn, loss_and_grad_fn, forward_fn
+
+        def loss_fn(params, ids, labels, *ex):
+            _check(len(ex), "loss_fn")
+            return _lf(params, ids, labels, *ex)
+
+        def loss_and_grad_fn(params, ids, labels, *ex):
+            _check(len(ex), "loss_and_grad_fn")
+            return _lg(params, ids, labels, *ex)
+
+        def forward_fn(params, ids, *ex):
+            _check(len(ex), "forward_fn")
+            return _ff(params, ids, *ex)
+
     return PipelinedModel(
         params=params,
         param_specs=specs,
@@ -850,6 +907,7 @@ def build_pipelined_model(
         loss_and_grad_fn=loss_and_grad_fn,
         schedule=schedule,
         layer_rows=tuple(row_of_layer),
+        extra_keys=tuple(extra_keys),
     )
 
 
@@ -876,21 +934,25 @@ def make_pipelined_forward_fn(
 
     stage_fn = _make_stage_fn(block_fn, layer_mask, block_aux, act_spec)
 
-    def forward_fn(params, ids: jax.Array):
+    def forward_fn(params, ids: jax.Array, *extras):
         ids_mb = microbatch(ids, num_microbatches, mesh if pp > 1 else None)
+        extras_mb = tuple(
+            microbatch(e, num_microbatches, mesh if pp > 1 else None) for e in extras
+        )
         M = num_microbatches
 
         if pp == 1:
-            def one_mb(_, i):
-                x, _ = stage_fn(params[LAYERS], embed_fn(params[EMBED], i))
+            def one_mb(_, mb):
+                i, *ex = mb
+                x, _ = stage_fn(params[LAYERS], embed_fn(params[EMBED], i), tuple(ex))
                 return None, head_fn(params[HEAD], x)
 
-            _, outs = lax.scan(one_mb, None, ids_mb)
+            _, outs = lax.scan(one_mb, None, (ids_mb, *extras_mb))
             return outs.reshape(ids.shape[0], *outs.shape[2:])
 
         T = M + pp - 1
 
-        def f(layer_stack, embed_params, ids_mb):
+        def f(layer_stack, embed_params, ids_mb, *extras_mb):
             rank = lax.axis_index(PIPELINE_AXIS)
             is_first = rank == 0
             is_last = rank == pp - 1
@@ -902,7 +964,12 @@ def make_pipelined_forward_fn(
                 feed_t = jnp.clip(t, 0, M - 1)
                 ids_t = lax.dynamic_index_in_dim(ids_mb, feed_t, axis=0, keepdims=False)
                 x_in = jnp.where(is_first, embed_fn(embed_params, ids_t), buf)
-                y, _ = stage_fn(layer_stack, x_in)
+                my_t = jnp.clip(t - rank, 0, M - 1)
+                ex_t = tuple(
+                    lax.dynamic_index_in_dim(e, my_t, axis=0, keepdims=False)
+                    for e in extras_mb
+                )
+                y, _ = stage_fn(layer_stack, x_in, ex_t)
                 out_t = t - (pp - 1)
                 write = jnp.where(jnp.logical_and(is_last, out_t >= 0), y, 0.0).astype(y.dtype)
                 outs = lax.dynamic_update_index_in_dim(
@@ -924,12 +991,13 @@ def make_pipelined_forward_fn(
         shmap = jax.shard_map(
             f,
             mesh=mesh,
-            in_specs=(P(PIPELINE_AXIS), P(), P(None, BATCH_AXES)),
+            in_specs=(P(PIPELINE_AXIS), P(), P(None, BATCH_AXES),
+                      *[P(None, BATCH_AXES)] * len(extras)),
             out_specs=P(None, BATCH_AXES),
             axis_names=frozenset({DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS}),
             check_vma=False,
         )
-        hidden = shmap(params[LAYERS], params[EMBED], ids_mb)
+        hidden = shmap(params[LAYERS], params[EMBED], ids_mb, *extras_mb)
         logits = head_fn(params[HEAD], hidden.reshape(ids.shape[0], *hidden.shape[2:]))
         return logits
 

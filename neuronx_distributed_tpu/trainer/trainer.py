@@ -190,6 +190,8 @@ def initialize_parallel_model(
             )
         pc = config.pipeline
         extra = {} if pc.pipeline_cuts is None else {"pipeline_cuts": pc.pipeline_cuts}
+        if pc.packed_inputs:
+            extra["packed"] = True
         pmodel = builder(
             num_microbatches=pc.num_microbatches,
             schedule=pc.schedule,
@@ -450,8 +452,11 @@ def make_pipelined_train_step(
 
     mask = optimizer.update_mask
 
+    extra_keys = tuple(getattr(model, "extra_keys", ()) or ())
+
     def _step(params, opt_state, batch, rng):
-        (loss_sum, tok), grads = loss_and_grad(params, batch["ids"], batch["labels"])
+        ex = tuple(batch[k] for k in extra_keys)
+        (loss_sum, tok), grads = loss_and_grad(params, batch["ids"], batch["labels"], *ex)
         tok = jnp.maximum(tok, 1.0)
         loss = loss_sum / tok
         # d(mean)/dp = d(sum)/dp / tok — tok depends only on the labels
@@ -470,8 +475,8 @@ def make_pipelined_train_step(
         return params, opt_state, {"loss": loss, "grad_norm": grad_norm}
 
     batch_shardings = {
-        "ids": NamedSharding(mesh, P(BATCH_AXES)),
-        "labels": NamedSharding(mesh, P(BATCH_AXES)),
+        k: NamedSharding(mesh, P(BATCH_AXES))
+        for k in ("ids", "labels", *extra_keys)
     }
     return jax.jit(
         _step,
@@ -494,13 +499,16 @@ def make_eval_step(
 
     mesh = model.mesh
     if isinstance(model, PipelinedModel):
+        eval_extra_keys = tuple(getattr(model, "extra_keys", ()) or ())
+
         def _eval(params, batch):
-            loss_sum, tok = model.loss_fn(params, batch["ids"], batch["labels"])
+            ex = tuple(batch[k] for k in eval_extra_keys)
+            loss_sum, tok = model.loss_fn(params, batch["ids"], batch["labels"], *ex)
             return {"loss": loss_sum / jnp.maximum(tok, 1.0)}
 
         batch_shardings = {
-            "ids": NamedSharding(mesh, P(BATCH_AXES)),
-            "labels": NamedSharding(mesh, P(BATCH_AXES)),
+            k: NamedSharding(mesh, P(BATCH_AXES))
+            for k in ("ids", "labels", *eval_extra_keys)
         }
         return jax.jit(_eval, in_shardings=(model.param_shardings, batch_shardings),
                        out_shardings=None)
