@@ -1,6 +1,10 @@
 """Model families (capability evidence mirroring the reference's example
 ports, SURVEY §2.16: Llama-2/3 training+inference, GPT-NeoX, BERT)."""
 
+from neuronx_distributed_tpu.models.common import (
+    causal_lm_loss,
+    causal_lm_loss_sum,
+)
 from neuronx_distributed_tpu.models.bert import (
     BertConfig,
     BertForPreTraining,
@@ -17,6 +21,8 @@ from neuronx_distributed_tpu.models.llama import (
 )
 
 __all__ = [
+    "causal_lm_loss",
+    "causal_lm_loss_sum",
     "BertConfig",
     "BertForPreTraining",
     "BertModel",

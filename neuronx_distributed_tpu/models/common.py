@@ -38,18 +38,9 @@ def maybe_remat(block_cls, remat: str, static_argnums: Tuple[int, ...] = ()):
 MOE_AUX_COEF = 0.01  # Switch-Transformer load-balancing coefficient
 
 
-def causal_lm_loss(module, params, batch, rng=None) -> jax.Array:
-    """Next-token loss over vocab-sharded logits; ``batch = {ids, labels[,
-    mask]}``, labels < 0 (ignore convention) drop out of the mean.  Works for
-    any causal-LM module whose ``apply(params, ids)`` returns logits.
-
-    MoE models (``num_experts > 1``) sow per-layer load-balancing terms into
-    the ``losses`` collection; they are averaged and added here with
-    ``MOE_AUX_COEF`` (dense models sow nothing — zero overhead).
-
-    Packed batches (``data.packing``) may carry ``positions`` (per-document
-    RoPE phases) and ``segment_ids`` (cross-document attention blocking);
-    both are forwarded when the module accepts them (the Llama family does)."""
+def _causal_lm_loss_parts(module, params, batch, rng=None):
+    """Shared body of the two loss entry points: returns
+    ``(masked_loss_sum, unmasked_token_count, aux_mean_or_None)``."""
     import inspect
 
     accepted = inspect.signature(type(module).__call__).parameters
@@ -70,11 +61,51 @@ def causal_lm_loss(module, params, batch, rng=None) -> jax.Array:
         mask = (labels >= 0).astype(jnp.float32)
     else:
         mask = mask.astype(jnp.float32) * (labels >= 0)
-    loss = jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    loss_sum = jnp.sum(per_tok * mask)
+    tok = jnp.sum(mask)
     aux_terms = jax.tree.leaves(variables.get("losses", {}))
-    if aux_terms:
-        loss = loss + MOE_AUX_COEF * jnp.mean(jnp.stack(aux_terms))
+    aux = jnp.mean(jnp.stack(aux_terms)) if aux_terms else None
+    return loss_sum, tok, aux
+
+
+def causal_lm_loss(module, params, batch, rng=None) -> jax.Array:
+    """Next-token loss over vocab-sharded logits; ``batch = {ids, labels[,
+    mask]}``, labels < 0 (ignore convention) drop out of the mean.  Works for
+    any causal-LM module whose ``apply(params, ids)`` returns logits.
+
+    MoE models (``num_experts > 1``) sow per-layer load-balancing terms into
+    the ``losses`` collection; they are averaged and added here with
+    ``MOE_AUX_COEF`` (dense models sow nothing — zero overhead).
+
+    Packed batches (``data.packing``) may carry ``positions`` (per-document
+    RoPE phases) and ``segment_ids`` (cross-document attention blocking);
+    both are forwarded when the module accepts them (the Llama family does)."""
+    loss_sum, tok, aux = _causal_lm_loss_parts(module, params, batch, rng)
+    loss = loss_sum / jnp.maximum(tok, 1.0)
+    if aux is not None:
+        loss = loss + MOE_AUX_COEF * aux
     return loss
+
+
+def causal_lm_loss_sum(module, params, batch, rng=None):
+    """Token-sum form of :func:`causal_lm_loss`: returns ``(loss_sum, tok)``
+    so callers can normalize by the *global* unmasked-token count.
+
+    ``make_train_step`` recognizes the 2-tuple return and accumulates
+    ``(sum, tok)`` across grad-accum microbatches, making the optimizer
+    update the exact token-masked global mean even when microbatches carry
+    unequal numbers of unmasked tokens — the caveat the plain mean-of-means
+    path documents (the PP engine already normalizes this way).
+
+    MoE aux terms are folded in as ``aux_mean * tok`` so that
+    ``loss_sum / tok`` equals :func:`causal_lm_loss` exactly on a single
+    batch; under accumulation the aux becomes the token-weighted mean of
+    per-microbatch aux means (vs. the unweighted mean of the mean-of-means
+    path — both are estimators of the same per-batch balance statistic)."""
+    loss_sum, tok, aux = _causal_lm_loss_parts(module, params, batch, rng)
+    if aux is not None:
+        loss_sum = loss_sum + MOE_AUX_COEF * aux * tok
+    return loss_sum, tok
 
 
 def dense_mha(

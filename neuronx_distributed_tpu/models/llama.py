@@ -34,7 +34,11 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
-from neuronx_distributed_tpu.models.common import causal_lm_loss, maybe_remat  # noqa: F401
+from neuronx_distributed_tpu.models.common import (  # noqa: F401
+    causal_lm_loss,
+    causal_lm_loss_sum,
+    maybe_remat,
+)
 from neuronx_distributed_tpu.parallel.layers import (
     ColumnParallelLinear,
     ParallelEmbedding,

@@ -312,19 +312,28 @@ def make_train_step(
     per-iteration lazy-tensor graph + ``bucket_allreduce`` +
     ``optimizer.step`` pipeline, ``trainer/optimizer.py:72-85``).
 
-    ``loss_fn(module, params, batch, rng) -> loss`` must return a scalar mean
-    loss over the *global* batch; the DP gradient mean is then implicit in
-    autodiff over the dp-sharded batch.
+    ``loss_fn(module, params, batch, rng)`` returns either a scalar mean loss
+    over the *global* batch or a ``(loss_sum, token_count)`` pair (see the
+    two-contract section below); the DP gradient mean is implicit in autodiff
+    over the dp-sharded batch either way.
 
     ``grad_accum_steps > 1`` splits the leading batch dim into that many
     microbatches inside the jit (a ``lax.scan``), averaging gradients before
     one optimizer update — the reference's accumulated global batch
     (GBS = microbatch x accum x dp, ``tp_zero1_llama2_7b_hf_pretrain.py``
     gradient_accumulation loop) with activation memory bounded by one
-    microbatch.  The accumulated loss/grad is the mean of per-microbatch
-    means — exactly the global mean when every microbatch carries the same
-    number of unmasked tokens (the usual packed-pretraining case, and the
-    reference's semantics too).
+    microbatch.
+
+    Two loss contracts are accepted, distinguished by return structure:
+
+    - scalar mean loss: the accumulated loss/grad is the mean of
+      per-microbatch means — exactly the global mean only when every
+      microbatch carries the same number of unmasked tokens (the usual
+      packed-pretraining case, and the reference's semantics too);
+    - ``(loss_sum, token_count)`` (e.g. ``causal_lm_loss_sum``): the step
+      accumulates both and normalizes once, yielding the exact token-masked
+      global-batch mean regardless of how masking is distributed across
+      microbatches — the same normalization the PP engine uses.
 
     A :class:`~..pipeline.engine.PipelinedModel` (from
     ``initialize_parallel_model`` with pp>1) is dispatched to
@@ -353,7 +362,29 @@ def make_train_step(
     state_shardings = optimizer.state_shardings
 
     def _loss_and_grad(params, batch, rng):
+        # The loss contract is detected from the return *structure* (a
+        # costless abstract evaluation — nothing is computed): a 2-tuple
+        # means (loss_sum, token_count) and selects exact token-weighted
+        # normalization; a scalar keeps the legacy mean semantics.
+        out_sd = jax.eval_shape(
+            lambda p, b: loss_fn(model.module, p, b, None), params, batch
+        )
+        token_weighted = isinstance(out_sd, tuple)
+        if token_weighted and len(out_sd) != 2:
+            raise ValueError(
+                "a tuple-returning loss_fn must return exactly "
+                f"(loss_sum, token_count); got a {len(out_sd)}-tuple"
+            )
+
         if grad_accum_steps == 1:
+            if token_weighted:
+                (loss_sum, tok), grads = jax.value_and_grad(
+                    loss_fn, argnums=1, has_aux=True
+                )(model.module, params, batch, rng)
+                tok = jnp.maximum(tok, 1.0)
+                # d(sum/tok)/dp = d(sum)/dp / tok — tok depends only on labels
+                return loss_sum / tok, jax.tree.map(
+                    lambda g: (g / tok).astype(g.dtype), grads)
             return jax.value_and_grad(loss_fn, argnums=1)(
                 model.module, params, batch, rng
             )
@@ -376,20 +407,30 @@ def make_train_step(
                 mb, r = xs, None
             else:
                 mb, r = xs
-            l, g = jax.value_and_grad(loss_fn, argnums=1)(model.module, params, mb, r)
-            loss_acc, grad_acc = acc
+            loss_acc, tok_acc, grad_acc = acc
+            if token_weighted:
+                (l, t), g = jax.value_and_grad(loss_fn, argnums=1, has_aux=True)(
+                    model.module, params, mb, r)
+                tok_acc = tok_acc + t.astype(jnp.float32)
+            else:
+                l, g = jax.value_and_grad(loss_fn, argnums=1)(model.module, params, mb, r)
             # fp32 accumulator: summing many bf16 gradients in bf16 rounds
             # away low-order contributions; one downcast after scaling
             return (
                 loss_acc + l.astype(jnp.float32),
+                tok_acc,
                 jax.tree.map(lambda a, gg: a + gg.astype(jnp.float32), grad_acc, g),
             ), None
 
         xs = micro if rng is None else (micro, jax.random.split(rng, grad_accum_steps))
-        zero = (jnp.zeros((), jnp.float32),
+        zero = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
                 jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
-        (loss_sum, grads), _ = jax.lax.scan(body, zero, xs)
-        scale = 1.0 / grad_accum_steps
+        (loss_sum, tok, grads), _ = jax.lax.scan(body, zero, xs)
+        # token_weighted: normalize by the GLOBAL unmasked-token count so the
+        # update equals the single-shot whole-batch gradient exactly even
+        # under uneven masking; legacy: mean of per-microbatch means.
+        scale = 1.0 / jnp.maximum(tok, 1.0) if token_weighted \
+            else jnp.float32(1.0 / grad_accum_steps)
         return loss_sum * scale, jax.tree.map(
             lambda g, p: (g * scale).astype(p.dtype), grads, params)
 
@@ -517,7 +558,11 @@ def make_eval_step(
         raise ValueError("loss_fn is required for non-pipelined models")
 
     def _eval(params, batch):
-        return {"loss": loss_fn(model.module, params, batch, None)}
+        out = loss_fn(model.module, params, batch, None)
+        if isinstance(out, tuple):  # (loss_sum, tok) contract, as in train
+            loss_sum, tok = out
+            return {"loss": loss_sum / jnp.maximum(tok, 1.0)}
+        return {"loss": out}
 
     return jax.jit(_eval, in_shardings=(model.param_shardings,
                                         _batch_shardings(mesh, batch_spec)),
