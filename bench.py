@@ -41,21 +41,28 @@ PEAK_FLOPS = {
     "cpu": 1e12,  # nominal, for smoke runs
 }
 
-# (platform, attention_impl, batch, remat) tried in order; first success wins.
-# flash-without-remat leads: flash attention never materializes the [S,S]
-# score matrix, so the 438M bench model's activations fit HBM un-remated and
-# the recompute FLOPs remat would add (not counted by the MFU formula's
-# 6*params accounting) are simply not spent.  A batch-16 rung tops the
-# ladder (selective remat to be HBM-safe): the measured 0.33-MFU b8 number
-# left MXU headroom, and bigger batches amortize per-step overheads.
+# (platform, attention_impl, batch, remat, loss) tried in order; first
+# success wins.  flash-without-remat leads: flash attention never
+# materializes the [S,S] score matrix, so the 438M bench model's activations
+# fit HBM un-remated and the recompute FLOPs remat would add (not counted by
+# the MFU formula's 6*params accounting) are simply not spent.  A batch-16
+# rung tops the ladder (selective remat to be HBM-safe): the measured
+# 0.33-MFU b8 number left MXU headroom, and bigger batches amortize per-step
+# overheads.  loss="chunked:N" computes the lm-head + CE per N-token chunk
+# under remat — the [B,S,V] logits (the step's biggest activation, ~1 GB
+# bf16 at b16/s2048/v32k, plus fp32 softmax residuals) never reach HBM,
+# freeing the memory that gates the big-batch rungs (VERDICT r3 #1c).
 LADDER = [
-    ("tpu", "flash", 16, "selective"),
-    ("tpu", "flash", 8, "none"),
-    ("tpu", "flash", 8, "selective"),
-    ("tpu", "flash", 4, "selective"),
-    ("tpu", "dense", 4, "selective"),
-    ("tpu", "dense", 2, "selective"),
-    ("cpu", "dense", 2, "none"),
+    ("tpu", "flash", 16, "none", "chunked:512"),
+    ("tpu", "flash", 16, "selective", "chunked:512"),
+    ("tpu", "flash", 16, "selective", "mean"),
+    ("tpu", "flash", 8, "none", "chunked:512"),
+    ("tpu", "flash", 8, "none", "mean"),
+    ("tpu", "flash", 8, "selective", "mean"),
+    ("tpu", "flash", 4, "selective", "mean"),
+    ("tpu", "dense", 4, "selective", "mean"),
+    ("tpu", "dense", 2, "selective", "mean"),
+    ("cpu", "dense", 2, "none", "mean"),
 ]
 ATTEMPT_TIMEOUT_S = 900
 PROBE_TIMEOUT_S = 420
@@ -70,7 +77,8 @@ def peak_flops_for(device) -> float:
     return 197e12
 
 
-def run_measurement(platform: str, attn: str, batch: int, remat: str) -> dict:
+def run_measurement(platform: str, attn: str, batch: int, remat: str,
+                    loss: str = "mean") -> dict:
     """Child-process body: build the model, time steps, return the result.
 
     Raises on any failure; the parent ladder decides what to try next."""
@@ -116,12 +124,20 @@ def run_measurement(platform: str, attn: str, batch: int, remat: str) -> dict:
     nxd.initialize_model_parallel(tensor_parallel_size=tp, devices=devices)
     config = nxd.training_config(tensor_parallel_size=tp, learning_rate=1e-4)
 
+    if loss.startswith("chunked"):
+        from neuronx_distributed_tpu.models import make_causal_lm_loss_sum
+
+        chunk = int(loss.split(":", 1)[1]) if ":" in loss else 512
+        loss_fn = make_causal_lm_loss_sum(chunk_size=chunk)
+    else:
+        loss_fn = causal_lm_loss
+
     model = initialize_parallel_model(
         config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, seq), jnp.int32),)
     )
     opt = initialize_parallel_optimizer(config, model)
     step = make_train_step(
-        config, model, opt, causal_lm_loss,
+        config, model, opt, loss_fn,
         batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()},
     )
 
@@ -180,7 +196,7 @@ def run_measurement(platform: str, attn: str, batch: int, remat: str) -> dict:
         "value": round(tokens_per_sec_per_chip, 2),
         "unit": (
             f"tokens/s/chip (mfu={achieved_mfu:.3f}, attn={attn}, batch={batch},"
-            f" remat={remat},"
+            f" remat={remat}, loss={loss},"
             f" model={model.num_parameters()/1e6:.0f}M, seq={seq},"
             f" device={devices[0].device_kind})"
         ),
@@ -227,7 +243,8 @@ def child_main(args) -> int:
         print(f"probe ok: {len(devs)}x {devs[0].device_kind}", file=sys.stderr)
         return 0
     try:
-        result = run_measurement(args.platform, args.attn, args.batch, args.remat)
+        result = run_measurement(args.platform, args.attn, args.batch, args.remat,
+                                 args.loss)
     except Exception as e:  # noqa: BLE001 — report, parent decides
         print(f"bench attempt failed: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
@@ -269,7 +286,7 @@ def parent_main() -> int:
     # Step 2: measurement ladder, first success wins.  Two timed-out TPU
     # attempts disqualify the remaining TPU rungs (a hang, not an OOM).
     tpu_timeouts = 0
-    for platform, attn, batch, remat in LADDER:
+    for platform, attn, batch, remat, loss in LADDER:
         if platform == "tpu" and (not tpu_ok or tpu_timeouts >= 2):
             continue
         env = dict(os.environ)
@@ -277,7 +294,7 @@ def parent_main() -> int:
             env["JAX_PLATFORMS"] = "cpu"
         proc = _run_child(
             [f"--platform={platform}", f"--attn={attn}", f"--batch={batch}",
-             f"--remat={remat}"],
+             f"--remat={remat}", f"--loss={loss}"],
             ATTEMPT_TIMEOUT_S, env,
         )
         if proc is None:
@@ -317,6 +334,7 @@ def main():
     p.add_argument("--attn", default="dense")
     p.add_argument("--batch", type=int, default=2)
     p.add_argument("--remat", default="selective")
+    p.add_argument("--loss", default="mean")
     args = p.parse_args()
     sys.exit(child_main(args) if args.run else parent_main())
 

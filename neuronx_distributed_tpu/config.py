@@ -46,7 +46,23 @@ class PipelineConfig:
     functional)."""
 
     num_microbatches: int = 1
-    schedule: str = "1f1b"  # "1f1b" | "gpipe" | "inference"
+    schedule: str = "1f1b"  # "1f1b" | "gpipe" | "interleaved" | "inference"
+    # interleaved virtual stages per pp rank (schedule="interleaved"):
+    # V model chunks per rank, chunk-granular ticks + phase-split scans
+    # divide the pipeline bubble by ~V (engine.make_interleaved_1f1b_...);
+    # requires num_microbatches % pp == 0 and num_layers % (pp*V) == 0,
+    # and does not compose with pipeline_cuts
+    virtual_stages: int = 1
+
+    def __post_init__(self):
+        if self.virtual_stages > 1 and self.schedule != "interleaved":
+            raise ValueError(
+                f"virtual_stages={self.virtual_stages} requires "
+                f"schedule='interleaved' (got {self.schedule!r}) — other "
+                "schedules would silently ignore the chunking"
+            )
+        if self.virtual_stages < 1:
+            raise ValueError(f"virtual_stages must be >= 1, got {self.virtual_stages}")
     # explicit uneven stage partition (layer indices beginning each new
     # stage, the reference's pipeline_cuts).  Give the last stage fewer
     # layers to offset its cond-gated head+loss work.  None = balanced.

@@ -4,6 +4,7 @@ ports, SURVEY §2.16: Llama-2/3 training+inference, GPT-NeoX, BERT)."""
 from neuronx_distributed_tpu.models.common import (
     causal_lm_loss,
     causal_lm_loss_sum,
+    make_causal_lm_loss_sum,
 )
 from neuronx_distributed_tpu.models.bert import (
     BertConfig,
@@ -23,6 +24,7 @@ from neuronx_distributed_tpu.models.llama import (
 __all__ = [
     "causal_lm_loss",
     "causal_lm_loss_sum",
+    "make_causal_lm_loss_sum",
     "BertConfig",
     "BertForPreTraining",
     "BertModel",

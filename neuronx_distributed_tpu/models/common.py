@@ -108,6 +108,87 @@ def causal_lm_loss_sum(module, params, batch, rng=None):
     return loss_sum, tok
 
 
+def make_causal_lm_loss_sum(chunk_size: int = 0):
+    """Factory for a ``(loss_sum, tok)`` causal-LM loss with an optionally
+    *chunked* head: with ``chunk_size > 0`` the lm-head matmul and the
+    cross entropy run per sequence chunk inside a rematerialized
+    ``lax.scan``, so the full ``[B, S, V]`` logits — and the fp32 softmax
+    residuals autodiff would otherwise save for backward — never exist in
+    HBM.  Peak loss-head memory drops from O(B·S·V) to O(B·chunk·V) at the
+    cost of recomputing the head matmul in backward (~2·B·S·H·V extra FLOPs,
+    a few percent of a training step).
+
+    The reference cannot express this (its loss consumes materialized logits,
+    ``parallel_layers/loss_functions.py:17-135``); on TPU the [B,S,V] buffer
+    is the single biggest activation of the whole step and the prime
+    HBM-pressure suspect at bench shapes (VERDICT r3 #1c).
+
+    Requires a module exposing the ``hidden(ids, ...)`` / ``head(h)`` method
+    pair (the Llama family does); ``chunk_size == 0`` falls back to the
+    plain :func:`causal_lm_loss_sum`."""
+    if chunk_size == 0:
+        return causal_lm_loss_sum
+
+    def loss_fn(module, params, batch, rng=None):
+        import inspect
+        import math
+
+        accepted = inspect.signature(type(module).hidden).parameters
+        kwargs = {}
+        for key in ("positions", "segment_ids"):
+            if batch.get(key) is not None:
+                if key not in accepted:
+                    raise TypeError(
+                        f"batch carries {key!r} but {type(module).__name__}."
+                        "hidden does not accept it"
+                    )
+                kwargs[key] = batch[key]
+        h, variables = module.apply(
+            params, batch["ids"], mutable=["losses"], method="hidden", **kwargs
+        )
+        labels = batch["labels"]
+        mask = batch.get("mask")
+        if mask is None:
+            mask = (labels >= 0).astype(jnp.float32)
+        else:
+            mask = mask.astype(jnp.float32) * (labels >= 0)
+
+        B, S = labels.shape
+        # largest divisor of S that is <= chunk_size (NOT gcd — gcd(2048,
+        # 1000)=8 would silently scan 256 tiny chunks)
+        c = next(d for d in range(min(chunk_size, S), 0, -1) if S % d == 0)
+        n = S // c
+
+        def chunk_fn(p, h_c, y_c, m_c):
+            logits = module.apply(p, h_c, method="head")
+            per_tok = parallel_cross_entropy(logits, y_c)
+            return jnp.sum(per_tok * m_c), jnp.sum(m_c)
+
+        # remat: backward recomputes the chunk's logits from (params, h_c)
+        # instead of saving softmax residuals per chunk
+        chunk_fn = jax.checkpoint(chunk_fn)
+
+        def body(carry, xs):
+            h_c, y_c, m_c = xs
+            ls, tok = chunk_fn(params, h_c, y_c, m_c)
+            return (carry[0] + ls, carry[1] + tok), None
+
+        xs = (
+            h.reshape(B, n, c, h.shape[-1]).swapaxes(0, 1),
+            labels.reshape(B, n, c).swapaxes(0, 1),
+            mask.reshape(B, n, c).swapaxes(0, 1),
+        )
+        (loss_sum, tok), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), xs
+        )
+        aux_terms = jax.tree.leaves(variables.get("losses", {}))
+        if aux_terms:
+            loss_sum = loss_sum + MOE_AUX_COEF * jnp.mean(jnp.stack(aux_terms)) * tok
+        return loss_sum, tok
+
+    return loss_fn
+
+
 def dense_mha(
     q: jax.Array,
     k: jax.Array,
@@ -155,6 +236,7 @@ def build_pipelined_causal_lm(
     pipeline_cuts=None,
     block_aux: bool = False,
     extra_keys=(),
+    num_chunks: int = 1,
 ):
     """Shared engine wiring for pipeline-parallel causal-LM families.
 
@@ -215,4 +297,5 @@ def build_pipelined_causal_lm(
         block_aux=block_aux,
         pipeline_cuts=pipeline_cuts,
         extra_keys=extra_keys,
+        num_chunks=num_chunks,
     )

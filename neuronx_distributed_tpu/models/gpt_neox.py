@@ -170,12 +170,12 @@ class GPTNeoXForCausalLM(nn.Module):
 
     @nn.nowrap
     def build_pipelined(self, num_microbatches: int, schedule: str = "1f1b", seed: int = 0,
-                        pipeline_cuts=None):
+                        pipeline_cuts=None, num_chunks: int = 1):
         """Pipeline-capable-model protocol consumed by
         ``initialize_parallel_model`` when ``pipeline_parallel_size > 1``."""
         return build_pipelined_gpt_neox(
             self.config, num_microbatches=num_microbatches, seed=seed, schedule=schedule,
-            pipeline_cuts=pipeline_cuts,
+            pipeline_cuts=pipeline_cuts, num_chunks=num_chunks,
         )
 
     @nn.compact
@@ -234,7 +234,7 @@ class GPTNeoXHead(nn.Module):
 
 def build_pipelined_gpt_neox(
     cfg: GPTNeoXConfig, num_microbatches: int, seed: int = 0, schedule: str = "1f1b",
-    pipeline_cuts=None,
+    pipeline_cuts=None, num_chunks: int = 1,
 ):
     """Pipeline-parallel GPT-NeoX (the reference's 20B milestone topology,
     TP8 x PP4 1F1B — BASELINE config 4); same engine protocol as
@@ -270,4 +270,5 @@ def build_pipelined_gpt_neox(
         seed=seed,
         schedule=schedule,
         pipeline_cuts=pipeline_cuts,
+        num_chunks=num_chunks,
     )

@@ -182,6 +182,18 @@ def initialize_model_parallel(
     if _STATE.mesh is not None:
         raise RuntimeError("model parallel is already initialized; call destroy_model_parallel() first")
 
+    # RNG discipline (the framework's stance on the reference's TP-aware
+    # RNG tracker, ``parallel_layers/random.py:100-127``): partitionable
+    # threefry makes every jax.random draw sharding-invariant AND cheap
+    # under GSPMD — each shard generates only its slice of the global
+    # stream, yet the values equal the single-device run.  The reference
+    # forks per-TP-rank seeds so each rank drops its own shard elements
+    # independently; here the one-key global-array semantics gives each
+    # shard its own mask slice for free, with no rank-seed bookkeeping.
+    # Pinned centrally so dropout/noise is reproducible across tp/dp/mesh
+    # choices (tests/test_rng_dropout.py).
+    jax.config.update("jax_threefry_partitionable", True)
+
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     cfg = MeshConfig(

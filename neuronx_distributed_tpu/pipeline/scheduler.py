@@ -172,7 +172,8 @@ class TrainSchedule(PipeSchedule):
 
 
 def bubble_fraction(
-    num_microbatches: int, num_stages: int, schedule: str = "eager"
+    num_microbatches: int, num_stages: int, schedule: str = "eager",
+    num_chunks: int = 1,
 ) -> float:
     """Fraction of pipeline compute capacity wasted on bubbles.
 
@@ -202,7 +203,28 @@ def bubble_fraction(
         return (P - 1) / (M + P - 1)
     if schedule == "sync_1f1b":
         return 2 * (P - 1) / (M + 2 * (P - 1))
-    raise ValueError(f"unknown schedule {schedule!r} (eager | sync_1f1b)")
+    if schedule == "sync_interleaved":
+        # ``sync_interleaved``: V chunks per rank, chunk-granular ticks, and
+        # the engine's PHASE-SPLIT scans (fwd-only warmup / mixed middle /
+        # bwd-only drain — tick-dependent but rank-uniform control flow is
+        # SPMD-legal, so warm/drain ticks stop paying the garbage half).
+        # Cost model: fwd-only tick = 1 unit, bwd-only = 2 (bwd ≈ 2x fwd
+        # FLOPs), mixed = 3; useful work = 3 units per microbatch-chunk.
+        # The fill/drain now costs chunk-ticks, which is how interleaving
+        # divides the bubble (Megatron interleaved 1F1B; the reference has
+        # no interleaving at all, SURVEY §2.10).
+        tables = build_interleaved_sync_tables(M, P, num_chunks)
+        T = tables.num_slots
+        any_b = [any(tables.bwd_mb[r][t] >= 0 for r in range(P)) for t in range(T)]
+        any_f = [any(tables.fwd_mb[r][t] >= 0 for r in range(P)) for t in range(T)]
+        warm = any_b.index(True) if any(any_b) else T
+        drain_start = T - list(reversed(any_f)).index(True) if any(any_f) else 0
+        total = warm * 1 + (drain_start - warm) * 3 + (T - drain_start) * 2
+        useful = 3 * M * num_chunks
+        return (total - useful) / total
+    raise ValueError(
+        f"unknown schedule {schedule!r} (eager | sync_1f1b | sync_interleaved)"
+    )
 
 
 def sync_1f1b_head_overhead(
@@ -360,6 +382,339 @@ def build_slot_tables(num_microbatches: int, num_stages: int) -> SlotTables:
         bwd_mb=tuple(tuple(r) for r in bwd_rows),
         fwd_stash_size=_min_stash(fwd_ints),
         bwd_stash_size=_min_stash(bwd_ints) if bwd_ints else 1,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class InterleavedSlotTables:
+    """Tick tables for the interleaved (virtual-stage) synchronous 1F1B.
+
+    ``V`` model chunks per pp rank; virtual stage ``s = v * P + r`` lives on
+    rank ``r`` as its chunk ``v`` — the Megatron interleaved assignment
+    (absent from the reference, SURVEY §2.10 "interleaved: Absent"), chosen
+    because consecutive virtual stages sit on consecutive ranks, so ONE ring
+    ppermute per tick still moves every edge, including the rank ``P-1 →
+    0`` chunk wrap.
+
+    Every tick each rank runs at most one chunk-forward and one
+    chunk-backward (1/V of a full stage each), so the fill/drain overhead
+    costs chunk-ticks, not stage-ticks: measured ticks ``T ≈ MV + O(P·V
+    drain)`` against ``MV`` useful — at P=4/M=8: 43% (V=1) → ~30% (V=2) →
+    ~21% (V=4) bubble, approaching the eager engine's 27%@V=1 figure from
+    a fully-SPMD program (see ``bubble_fraction(..., "sync_interleaved")``).
+
+    All index tables are ``[P][T]`` (-1 = none).  Stash slots are allocated
+    offline by live-interval graph coloring (`slots` = per-rank maximum),
+    so the engine does no modular-index arithmetic: it reads the slot
+    number for the tick from the table."""
+
+    num_microbatches: int
+    num_stages: int       # pp ranks P
+    num_chunks: int       # V
+    num_slots: int        # ticks T
+    # compute tables
+    fwd_mb: Tuple[Tuple[int, ...], ...]
+    fwd_chunk: Tuple[Tuple[int, ...], ...]
+    bwd_mb: Tuple[Tuple[int, ...], ...]
+    bwd_chunk: Tuple[Tuple[int, ...], ...]
+    # activation-stash slot tables
+    fwd_slot: Tuple[Tuple[int, ...], ...]     # slot holding this fwd's input
+    bwd_slot: Tuple[Tuple[int, ...], ...]     # slot holding this bwd's stashed input
+    in_fwd_slot: Tuple[Tuple[int, ...], ...]  # slot to store the arriving activation
+    stash_size: int
+    # incoming-grad stash
+    gin_slot: Tuple[Tuple[int, ...], ...]     # slot holding this bwd's incoming grad
+    in_bwd_slot: Tuple[Tuple[int, ...], ...]  # slot to store the arriving grad
+    gstash_size: int
+
+
+def build_interleaved_sync_tables(
+    num_microbatches: int, num_stages: int, num_chunks: int
+) -> InterleavedSlotTables:
+    """Greedy dependency-honoring tick assignment for interleaved sync-1F1B.
+
+    Issue order per rank follows Megatron's interleaved 1F1B (chunk-major
+    groups of P microbatches: ``for each group of P mbs: for each chunk:
+    the P mbs``; backwards mirrored chunk-descending), with each op placed
+    at the earliest tick satisfying:
+
+    - ``fwd(s, m)`` needs ``fwd(s-1, m)`` in an *earlier* tick (activation
+      arrives via the end-of-tick ppermute);
+    - ``bwd(s, m)`` needs ``bwd(s+1, m)`` in an earlier tick, and
+      ``fwd(s, m)`` in an earlier-or-equal tick (the backward recomputes
+      the stage forward from the stashed input; at the last virtual stage
+      fwd and bwd of a microbatch share the tick, as in the V=1 engine);
+    - at most one fwd and one bwd per rank per tick;
+    - per-rank ops issue in order (pointer semantics, like the engine's
+      sequential consumption of its tick table).
+
+    Activation-stash live intervals ``[arrival(or fwd tick for s=0), bwd
+    tick]`` and grad intervals ``[arrival, bwd tick]`` are then colored
+    into the minimum slot count per rank (max over ranks = stash shape).
+    ``M`` must be a multiple of ``P`` (Megatron's interleaving constraint —
+    groups of P microbatches per chunk visit)."""
+    M, P, V = num_microbatches, num_stages, num_chunks
+    if V < 1:
+        raise ValueError(f"num_chunks must be >= 1, got {V}")
+    if M % P != 0:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({M}) divisible by "
+            f"pipeline size ({P})"
+        )
+    S = V * P
+
+    def owner(s):
+        return s % P
+
+    def chunk(s):
+        return s // P
+
+    # per-rank issue orders
+    fwd_order: List[List[Tuple[int, int]]] = [[] for _ in range(P)]  # (s, m)
+    bwd_order: List[List[Tuple[int, int]]] = [[] for _ in range(P)]
+    for g in range(M // P):
+        for v in range(V):
+            for j in range(P):
+                m = g * P + j
+                for r in range(P):
+                    fwd_order[r].append((v * P + r, m))
+        for v in reversed(range(V)):
+            for j in range(P):
+                m = g * P + j
+                for r in range(P):
+                    bwd_order[r].append((v * P + r, m))
+
+    fwd_done = {}
+    bwd_done = {}
+    fi = [0] * P
+    bi = [0] * P
+    rows: dict = {k: [[] for _ in range(P)] for k in ("fm", "fc", "bm", "bc")}
+    t = 0
+    while any(fi[r] < len(fwd_order[r]) or bi[r] < len(bwd_order[r])
+              for r in range(P)):
+        placed_f = {}
+        placed_b = {}
+        for r in range(P):
+            f_sm = b_sm = None
+            if fi[r] < len(fwd_order[r]):
+                s, m = fwd_order[r][fi[r]]
+                if s == 0 or fwd_done.get((s - 1, m), t) < t:
+                    f_sm = (s, m)
+                    fi[r] += 1
+            if bi[r] < len(bwd_order[r]):
+                s, m = bwd_order[r][bi[r]]
+                f_t = fwd_done.get((s, m))
+                if f_sm == (s, m):  # same tick fwd (last virtual stage)
+                    f_t = t
+                ready = f_t is not None and f_t <= t
+                if s < S - 1:
+                    ready = ready and bwd_done.get((s + 1, m), t) < t
+                if ready:
+                    b_sm = (s, m)
+                    bi[r] += 1
+            placed_f[r] = f_sm
+            placed_b[r] = b_sm
+        for r in range(P):
+            f_sm, b_sm = placed_f[r], placed_b[r]
+            if f_sm is not None:
+                fwd_done[f_sm] = t
+            if b_sm is not None:
+                bwd_done[b_sm] = t
+            rows["fm"][r].append(f_sm[1] if f_sm else -1)
+            rows["fc"][r].append(chunk(f_sm[0]) if f_sm else -1)
+            rows["bm"][r].append(b_sm[1] if b_sm else -1)
+            rows["bc"][r].append(chunk(b_sm[0]) if b_sm else -1)
+        t += 1
+        if t > 4 * (M * V + S) + 16:  # pragma: no cover - schedule bug guard
+            raise RuntimeError(
+                f"interleaved slot assignment did not converge (M={M}, P={P}, V={V})"
+            )
+    T = t
+
+    # ---- offline stash slot allocation (interval coloring per rank) ----
+    color = _color_intervals
+
+    fwd_slot = [[-1] * T for _ in range(P)]
+    bwd_slot = [[-1] * T for _ in range(P)]
+    in_fwd_slot = [[-1] * T for _ in range(P)]
+    gin_slot = [[-1] * T for _ in range(P)]
+    in_bwd_slot = [[-1] * T for _ in range(P)]
+    stash_size = 1
+    gstash_size = 1
+    for r in range(P):
+        # activation intervals: input of (s, m) lives from its availability
+        # (fwd tick for virtual stage 0; arrival tick otherwise) to its bwd.
+        acts = []
+        for s in range(r, S, P):
+            for m in range(M):
+                start = fwd_done[(s, m)] if s == 0 else fwd_done[(s - 1, m)] + 1
+                acts.append((start, bwd_done[(s, m)], (s, m)))
+        assign, n = color(acts)
+        stash_size = max(stash_size, n)
+        grads = []
+        for s in range(r, S, P):
+            if s == S - 1:
+                continue
+            for m in range(M):
+                grads.append(
+                    (bwd_done[(s + 1, m)] + 1, bwd_done[(s, m)], (s, m)))
+        gassign, gn = color(grads)
+        gstash_size = max(gstash_size, gn)
+        for t_ in range(T):
+            fm, fc = rows["fm"][r][t_], rows["fc"][r][t_]
+            if fm >= 0:
+                fwd_slot[r][t_] = assign[(fc * P + r, fm)]
+            bm, bc = rows["bm"][r][t_], rows["bc"][r][t_]
+            if bm >= 0:
+                s = bc * P + r
+                bwd_slot[r][t_] = assign[(s, m_ := bm)]
+                if s < S - 1:
+                    gin_slot[r][t_] = gassign[(s, m_)]
+        # arrival tables: what lands at the END of tick t_ on this rank
+        prev_r = (r - 1) % P
+        next_r = (r + 1) % P
+        for t_ in range(T):
+            pm, pc = rows["fm"][prev_r][t_], rows["fc"][prev_r][t_]
+            if pm >= 0:
+                s_sender = pc * P + prev_r
+                if s_sender + 1 < S and owner(s_sender + 1) == r:
+                    in_fwd_slot[r][t_] = assign[(s_sender + 1, pm)]
+            nm, nc = rows["bm"][next_r][t_], rows["bc"][next_r][t_]
+            if nm >= 0:
+                s_sender = nc * P + next_r
+                if s_sender - 1 >= 0 and owner(s_sender - 1) == r:
+                    in_bwd_slot[r][t_] = gassign[(s_sender - 1, nm)]
+
+    tup = lambda rows_: tuple(tuple(x) for x in rows_)  # noqa: E731
+    return InterleavedSlotTables(
+        num_microbatches=M,
+        num_stages=P,
+        num_chunks=V,
+        num_slots=T,
+        fwd_mb=tup(rows["fm"]),
+        fwd_chunk=tup(rows["fc"]),
+        bwd_mb=tup(rows["bm"]),
+        bwd_chunk=tup(rows["bc"]),
+        fwd_slot=tup(fwd_slot),
+        bwd_slot=tup(bwd_slot),
+        in_fwd_slot=tup(in_fwd_slot),
+        stash_size=stash_size,
+        gin_slot=tup(gin_slot),
+        in_bwd_slot=tup(in_bwd_slot),
+        gstash_size=gstash_size,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class InterleavedFwdTables:
+    """Forward-only interleaved timetable (fill-drain over virtual stages):
+    drives the differentiable loss oracle and the inference path of the
+    interleaved engine (``InferenceSchedule`` analogue)."""
+
+    num_microbatches: int
+    num_stages: int
+    num_chunks: int
+    num_slots: int
+    fwd_mb: Tuple[Tuple[int, ...], ...]
+    fwd_chunk: Tuple[Tuple[int, ...], ...]
+    fwd_slot: Tuple[Tuple[int, ...], ...]
+    in_fwd_slot: Tuple[Tuple[int, ...], ...]
+    stash_size: int
+
+
+def _color_intervals(intervals):
+    """First-fit interval coloring: ``intervals`` of (start, end, key) →
+    (assignment dict, slot count).  A slot is reusable the tick after its
+    previous occupant's last read (strict ``<`` on starts)."""
+    intervals = sorted(intervals)
+    slot_free_at: List[int] = []
+    assign = {}
+    for lo, hi, key in intervals:
+        for i, free in enumerate(slot_free_at):
+            if free < lo:
+                slot_free_at[i] = hi
+                assign[key] = i
+                break
+        else:
+            assign[key] = len(slot_free_at)
+            slot_free_at.append(hi)
+    return assign, len(slot_free_at)
+
+
+def build_interleaved_fwd_tables(
+    num_microbatches: int, num_stages: int, num_chunks: int
+) -> InterleavedFwdTables:
+    """Greedy earliest-tick assignment of the interleaved *forward* pass:
+    per-rank Megatron chunk-major issue order, one fwd per rank per tick,
+    activation available the tick after the producing tick (ppermute)."""
+    M, P, V = num_microbatches, num_stages, num_chunks
+    if M % P != 0:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({M}) divisible by "
+            f"pipeline size ({P})"
+        )
+    S = V * P
+    fwd_order: List[List[Tuple[int, int]]] = [[] for _ in range(P)]
+    for g in range(M // P):
+        for v in range(V):
+            for j in range(P):
+                m = g * P + j
+                for r in range(P):
+                    fwd_order[r].append((v * P + r, m))
+
+    fwd_done = {}
+    fi = [0] * P
+    fm_rows: List[List[int]] = [[] for _ in range(P)]
+    fc_rows: List[List[int]] = [[] for _ in range(P)]
+    t = 0
+    while any(fi[r] < len(fwd_order[r]) for r in range(P)):
+        placed = {}
+        for r in range(P):
+            placed[r] = None
+            if fi[r] < len(fwd_order[r]):
+                s, m = fwd_order[r][fi[r]]
+                if s == 0 or fwd_done.get((s - 1, m), t) < t:
+                    placed[r] = (s, m)
+                    fi[r] += 1
+        for r in range(P):
+            sm = placed[r]
+            if sm is not None:
+                fwd_done[sm] = t
+            fm_rows[r].append(sm[1] if sm else -1)
+            fc_rows[r].append(sm[0] // P if sm else -1)
+        t += 1
+        if t > 4 * (M * V + S) + 16:  # pragma: no cover
+            raise RuntimeError("interleaved fwd assignment did not converge")
+    T = t
+
+    fwd_slot = [[-1] * T for _ in range(P)]
+    in_fwd_slot = [[-1] * T for _ in range(P)]
+    stash_size = 1
+    for r in range(P):
+        acts = []
+        for s in range(r, S, P):
+            for m in range(M):
+                start = fwd_done[(s, m)] if s == 0 else fwd_done[(s - 1, m)] + 1
+                acts.append((start, fwd_done[(s, m)], (s, m)))
+        assign, n = _color_intervals(acts)
+        stash_size = max(stash_size, n)
+        for t_ in range(T):
+            fm, fc = fm_rows[r][t_], fc_rows[r][t_]
+            if fm >= 0:
+                fwd_slot[r][t_] = assign[(fc * P + r, fm)]
+        prev_r = (r - 1) % P
+        for t_ in range(T):
+            pm, pc = fm_rows[prev_r][t_], fc_rows[prev_r][t_]
+            if pm >= 0:
+                s_sender = pc * P + prev_r
+                if s_sender + 1 < S and (s_sender + 1) % P == r:
+                    in_fwd_slot[r][t_] = assign[(s_sender + 1, pm)]
+
+    tup = lambda rows_: tuple(tuple(x) for x in rows_)  # noqa: E731
+    return InterleavedFwdTables(
+        num_microbatches=M, num_stages=P, num_chunks=V, num_slots=T,
+        fwd_mb=tup(fm_rows), fwd_chunk=tup(fc_rows), fwd_slot=tup(fwd_slot),
+        in_fwd_slot=tup(in_fwd_slot), stash_size=stash_size,
     )
 
 

@@ -192,6 +192,8 @@ def initialize_parallel_model(
         extra = {} if pc.pipeline_cuts is None else {"pipeline_cuts": pc.pipeline_cuts}
         if pc.packed_inputs:
             extra["packed"] = True
+        if pc.virtual_stages > 1 or pc.schedule == "interleaved":
+            extra["num_chunks"] = pc.virtual_stages
         pmodel = builder(
             num_microbatches=pc.num_microbatches,
             schedule=pc.schedule,

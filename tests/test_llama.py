@@ -120,6 +120,76 @@ def test_train_loop_tp_sp_zero1(devices8):
     assert losses[-1] < losses[0] * 0.8, losses
 
 
+@pytest.mark.parametrize("sp", [False, True], ids=["nosp", "sp"])
+def test_chunked_loss_head_matches_unchunked(devices8, sp):
+    """make_causal_lm_loss_sum(chunk_size) — the no-[B,S,V]-materialization
+    loss head — must match the plain (loss_sum, tok) path in value AND
+    gradients, incl. ignore-index masking (VERDICT r3 #1c)."""
+    from neuronx_distributed_tpu.models import (
+        causal_lm_loss_sum,
+        make_causal_lm_loss_sum,
+    )
+
+    cfg = LlamaConfig.tiny(sequence_parallel=sp, remat="none",
+                           dtype=jnp.float32, param_dtype=jnp.float32)
+    config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3,
+                                 compute_dtype="float32")
+    model = initialize_parallel_model(
+        config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, 16), jnp.int32),)
+    )
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, cfg.vocab_size)
+    labels = np.asarray(jnp.roll(ids, -1, axis=1)).copy()
+    labels[1, 5:] = -100  # uneven masking
+    batch = {"ids": ids, "labels": jnp.asarray(labels)}
+
+    chunked = make_causal_lm_loss_sum(chunk_size=8)  # 16 -> 2 chunks
+
+    def total(fn):
+        def f(p):
+            s, t = fn(model.module, p, batch)
+            return s / jnp.maximum(t, 1.0)
+        return jax.jit(jax.value_and_grad(f))
+
+    l_ref, g_ref = total(causal_lm_loss_sum)(model.params)
+    l_chk, g_chk = total(chunked)(model.params)
+    assert float(l_chk) == pytest.approx(float(l_ref), rel=1e-6)
+    for (kp, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(g_ref)[0],
+        jax.tree_util.tree_flatten_with_path(g_chk)[0],
+    ):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=2e-5,
+                                   atol=1e-7, err_msg=jax.tree_util.keystr(kp))
+
+    # non-divisible chunk_size falls back to a divisor of S, still exact
+    l_odd, _ = total(make_causal_lm_loss_sum(chunk_size=6))(model.params)
+    assert float(l_odd) == pytest.approx(float(l_ref), rel=1e-6)
+
+
+def test_chunked_loss_trains(devices8):
+    """End-to-end: make_train_step with the chunked head, loss decreases."""
+    from neuronx_distributed_tpu.models import make_causal_lm_loss_sum
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3,
+                                 compute_dtype="float32")
+    model = initialize_parallel_model(
+        config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, 16), jnp.int32),)
+    )
+    opt = initialize_parallel_optimizer(config, model)
+    step = make_train_step(
+        config, model, opt, make_causal_lm_loss_sum(chunk_size=8),
+        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()},
+    )
+    params, state = model.params, opt.state
+    ids = jax.random.randint(jax.random.PRNGKey(42), (8, 16), 0, cfg.vocab_size)
+    batch = {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+    losses = []
+    for i in range(8):
+        params, state, m = step(params, state, batch, None)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
 def test_remat_matches_no_remat(devices8):
     """selective/full remat must not change numerics."""
     ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 256)

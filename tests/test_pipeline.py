@@ -239,8 +239,10 @@ def _dense_params_from_pipelined(pmodel, cfg):
         "embed": jax.tree.map(np.asarray, pmodel.params["embed"]),
         "final_norm": jax.tree.map(np.asarray, pmodel.params["head"]["final_norm"]),
     }
+    rows = pmodel.layer_rows or tuple(range(cfg.num_layers))
     for i in range(cfg.num_layers):
-        model_tree[f"layer_{i}"] = jax.tree.map(lambda a: np.asarray(a[i]), stacked)
+        r = rows[i]
+        model_tree[f"layer_{i}"] = jax.tree.map(lambda a: np.asarray(a[r]), stacked)
     return {
         "params": {
             "model": model_tree,
@@ -374,6 +376,143 @@ def test_1f1b_grads_match_gpipe_autodiff(devices8, pp, tp, num_mb, kv, sp, kvr):
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
             err_msg=jax.tree_util.keystr(k1),
         )
+
+
+@pytest.mark.parametrize("pp,tp,num_mb,V,sp,layers", [
+    (2, 2, 4, 2, False, 4),
+    (2, 1, 2, 2, True, 4),
+    (4, 1, 4, 2, False, 8),
+])
+def test_interleaved_matches_dense_and_autodiff(devices8, pp, tp, num_mb, V, sp, layers):
+    """Interleaved (virtual-stage) sync 1F1B: the manual phase-split engine
+    must match (a) the dense single-model oracle on the same weights — this
+    catches any chunk/row-order bug, since the stack layout is permuted —
+    and (b) autodiff of the interleaved fill-drain loss, gradient-exactly
+    (VERDICT r3 #2)."""
+    nxd.initialize_model_parallel(
+        tensor_parallel_size=tp, pipeline_parallel_size=pp,
+        devices=devices8[: pp * tp * (8 // (pp * tp)) ],
+    )
+    cfg = LlamaConfig.tiny(
+        num_layers=layers, num_heads=8, num_kv_heads=8, sequence_parallel=sp,
+        remat="none", dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16,
+    )
+    pmodel = build_pipelined_llama(
+        cfg, num_microbatches=num_mb, seed=3, schedule="interleaved", num_chunks=V)
+    dp = 8 // (pp * tp)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (num_mb * dp, 16), 0, cfg.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+
+    (ls, tok), grads = jax.jit(pmodel.loss_and_grad_fn)(pmodel.params, ids, labels)
+
+    # (a) dense oracle on identical weights, through the permuted row map
+    dense = LlamaForCausalLM(cfg)
+    dparams = _dense_params_from_pipelined(pmodel, cfg)
+    from neuronx_distributed_tpu.models.llama import causal_lm_loss
+
+    dense_loss = float(jax.jit(
+        lambda p: causal_lm_loss(dense, p, {"ids": ids, "labels": labels})
+    )(dparams))
+    assert float(ls) / float(tok) == pytest.approx(dense_loss, rel=2e-4)
+
+    # (b) autodiff of the interleaved fill-drain oracle
+    (ls2, tok2), g2 = jax.jit(
+        lambda p, i, l: jax.value_and_grad(pmodel.loss_fn, has_aux=True)(p, i, l)
+    )(pmodel.params, ids, labels)
+    assert float(ls) == pytest.approx(float(ls2), rel=1e-5)
+    assert float(tok) == float(tok2)
+    for (k1, a), (k2, b) in zip(
+        jax.tree_util.tree_flatten_with_path(grads)[0],
+        jax.tree_util.tree_flatten_with_path(g2)[0],
+    ):
+        assert k1 == k2
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(k1),
+        )
+
+
+def test_interleaved_forward_matches_dense(devices8):
+    cfg, pp, tp, num_mb, V = None, 2, 2, 4, 2
+    nxd.initialize_model_parallel(
+        tensor_parallel_size=tp, pipeline_parallel_size=pp, devices=devices8)
+    cfg = LlamaConfig.tiny(
+        num_layers=4, num_heads=8, num_kv_heads=8, sequence_parallel=False,
+        remat="none", dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16,
+    )
+    pmodel = build_pipelined_llama(
+        cfg, num_microbatches=num_mb, seed=3, schedule="interleaved", num_chunks=V)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (num_mb * 2, 16), 0, cfg.vocab_size)
+    logits_pp = np.asarray(jax.jit(pmodel.forward_fn)(pmodel.params, ids))
+    dense = LlamaForCausalLM(cfg)
+    dparams = _dense_params_from_pipelined(pmodel, cfg)
+    logits_dense = np.asarray(jax.jit(lambda p, i: dense.apply(p, i))(dparams, ids))
+    np.testing.assert_allclose(logits_pp, logits_dense, rtol=2e-3, atol=2e-3)
+
+
+def test_interleaved_bubble_below_sync_1f1b():
+    """'Done' criterion for VERDICT r3 #2: the interleaved schedule's bubble
+    is below sync-1F1B at M in {8,16,32} — and with the phase-split cost
+    model, V=1 matches the reference's eager 1F1B while V>=2 beats it."""
+    from neuronx_distributed_tpu.pipeline.scheduler import bubble_fraction
+
+    for M in (8, 16, 32):
+        sync = bubble_fraction(M, 4, "sync_1f1b")
+        eager = bubble_fraction(M, 4, "eager")
+        for V in (1, 2, 4):
+            b = bubble_fraction(M, 4, "sync_interleaved", num_chunks=V)
+            assert b < sync, (M, V, b, sync)
+            if V == 1:
+                assert b == pytest.approx(eager, abs=1e-9)
+            else:
+                assert b < eager, (M, V, b, eager)
+
+
+def test_interleaved_rejects_bad_configs():
+    from neuronx_distributed_tpu.pipeline.engine import interleaved_row_of_layer
+    from neuronx_distributed_tpu.pipeline.scheduler import (
+        build_interleaved_sync_tables,
+    )
+
+    with pytest.raises(ValueError, match="divisible"):
+        interleaved_row_of_layer(6, 2, 2)  # 6 layers, pp*V = 4
+    with pytest.raises(ValueError, match="divisible"):
+        build_interleaved_sync_tables(3, 2, 2)  # M % P != 0
+
+
+def test_interleaved_via_trainer_config(devices8):
+    """Trainer dispatch: schedule='interleaved' + virtual_stages from the
+    config; loss decreases over steps."""
+    nxd.initialize_model_parallel(
+        tensor_parallel_size=2, pipeline_parallel_size=2, devices=devices8)
+    cfg = LlamaConfig.tiny(
+        num_layers=4, sequence_parallel=False, remat="none",
+        dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16,
+    )
+    config = nxd.training_config(
+        tensor_parallel_size=2, pipeline_parallel_size=2, learning_rate=1e-3,
+        compute_dtype="float32", num_microbatches=2, schedule="interleaved",
+        virtual_stages=2,
+    )
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        make_train_step,
+    )
+
+    model = initialize_parallel_model(
+        config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, 16), jnp.int32),))
+    assert model.schedule == "interleaved"
+    opt = initialize_parallel_optimizer(config, model)
+    step = make_train_step(config, model, opt, None)
+    params, state = model.params, opt.state
+    ids = jax.random.randint(jax.random.PRNGKey(42), (4, 16), 0, cfg.vocab_size)
+    batch = {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+    losses = []
+    for i in range(8):
+        params, state, m = step(params, state, batch, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses
 
 
 def test_1f1b_memory_below_fill_drain(devices8):

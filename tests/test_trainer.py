@@ -157,6 +157,72 @@ def test_grad_accumulation_matches_full_batch(config):
                                    atol=1e-6, err_msg=jax.tree_util.keystr(k1_))
 
 
+def test_fit_runs_and_records(config, tmp_path):
+    """fit(): loss decreases, eval cadence recorded, checkpoints rotated,
+    metrics written (the Lightning-residual loop, VERDICT r3 #4)."""
+    from neuronx_distributed_tpu.trainer import TrainingMetrics, fit
+
+    model = initialize_parallel_model(config, TinyLM, (jnp.zeros((1, 8), jnp.int32),))
+    opt = initialize_parallel_optimizer(config, model)
+    bs = {"ids": default_batch_spec(), "labels": default_batch_spec()}
+    data = lambda step: _data(jax.random.PRNGKey(7))  # noqa: E731 — fixed batch
+    ckpt = str(tmp_path / "ck")
+    metrics = TrainingMetrics(str(tmp_path / "metrics.json"))
+
+    res = fit(
+        config, model, opt, data, steps=12, loss_fn=lm_loss, batch_spec=bs,
+        eval_data=lambda step: _data(jax.random.PRNGKey(7)), eval_every=4,
+        ckpt_dir=ckpt, ckpt_every=5, keep_ckpts=2, metrics=metrics,
+        log_every=0,
+    )
+    assert res.steps_run == 12 and res.start_step == 0
+    assert np.isfinite(res.final_loss)
+    assert [s for s, _ in res.eval_history] == [4, 8, 12]
+    assert res.eval_history[-1][1] < res.eval_history[0][1]  # eval improves
+    kept = sorted(d for d in os.listdir(ckpt) if d.startswith("step_"))
+    assert kept == ["step_10", "step_12"]  # rotation kept 2
+    import json as _json
+
+    recorded = _json.load(open(tmp_path / "metrics.json"))
+    assert recorded["completed_steps"] == 12
+
+
+def test_fit_interrupted_resume_identical_trajectory(config, tmp_path):
+    """'Done' criterion: an interrupted+resumed fit reproduces the
+    uninterrupted run's loss trajectory exactly (params, optimizer state,
+    LR-schedule step all restored; step-indexed data resumes itself)."""
+    from neuronx_distributed_tpu.trainer import fit
+
+    def data(step):
+        return _data(jax.random.PRNGKey(100 + step))
+
+    def build():
+        # fresh model+opt from the same seed each time
+        m = initialize_parallel_model(config, TinyLM, (jnp.zeros((1, 8), jnp.int32),))
+        o = initialize_parallel_optimizer(config, m)
+        return m, o
+
+    losses_a: list = []
+    m1, o1 = build()
+    fit(config, m1, o1, data, steps=10, loss_fn=lm_loss, log_every=0,
+        on_step=lambda s, m: losses_a.append((s, float(m["loss"]))))
+
+    ck = str(tmp_path / "ck")
+    losses_b: list = []
+    m2, o2 = build()
+    fit(config, m2, o2, data, steps=6, loss_fn=lm_loss, ckpt_dir=ck,
+        ckpt_every=100, log_every=0,  # only the final step_6 checkpoint
+        on_step=lambda s, m: losses_b.append((s, float(m["loss"]))))
+    m3, o3 = build()
+    res = fit(config, m3, o3, data, steps=10, loss_fn=lm_loss, ckpt_dir=ck,
+              resume=True, log_every=0,
+              on_step=lambda s, m: losses_b.append((s, float(m["loss"]))))
+    assert res.start_step == 6
+    assert [s for s, _ in losses_b] == list(range(10))
+    for (sa, la), (sb, lb) in zip(losses_a, losses_b):
+        assert sa == sb and la == pytest.approx(lb, rel=1e-6), (sa, la, lb)
+
+
 def lm_loss_masked_mean(module, params, batch, rng):
     logits = module.apply(params, batch["ids"])
     per_tok = parallel_cross_entropy(logits, batch["labels"])

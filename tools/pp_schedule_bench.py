@@ -1,10 +1,15 @@
-"""Measure GPipe fill-drain vs sync-1F1B step time at PP4 (verdict r2 #3).
+"""Measure GPipe fill-drain vs sync-1F1B vs interleaved step time at PP4.
 
 Runs on the 8-device virtual CPU mesh (tp=2 x pp=4); CPU timings are a rough
 proxy but expose the schedules' M-dependence.  Results are recorded in
-docs/PP_SCHEDULE_NOTES.md.
+docs/PP_SCHEDULE_NOTES.md.  ``interleavedV`` rows run the phase-split
+virtual-stage engine with V chunks per rank (VERDICT r3 #2 wall-clock
+criterion: beat sync-1F1B).
 """
 import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
@@ -18,7 +23,7 @@ from neuronx_distributed_tpu.models.llama import LlamaConfig
 from neuronx_distributed_tpu.pipeline.scheduler import bubble_fraction
 
 
-def measure(schedule: str, M: int, steps: int = 4) -> float:
+def measure(schedule: str, M: int, steps: int = 4, num_chunks: int = 1) -> float:
     nxd.destroy_model_parallel()
     nxd.initialize_model_parallel(tensor_parallel_size=2, pipeline_parallel_size=4)
     cfg = LlamaConfig(
@@ -27,7 +32,8 @@ def measure(schedule: str, M: int, steps: int = 4) -> float:
         remat="none", dtype=jnp.float32, param_dtype=jnp.float32,
     )
     from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
-    model = LlamaForCausalLM(cfg).build_pipelined(num_microbatches=M, schedule=schedule)
+    model = LlamaForCausalLM(cfg).build_pipelined(
+        num_microbatches=M, schedule=schedule, num_chunks=num_chunks)
     ids = jax.random.randint(jax.random.PRNGKey(0), (2 * M, 64), 0, cfg.vocab_size)
     labels = jnp.roll(ids, -1, axis=1)
     fn = jax.jit(model.loss_and_grad_fn)
@@ -40,9 +46,13 @@ def measure(schedule: str, M: int, steps: int = 4) -> float:
     return (time.perf_counter() - t0) / steps
 
 
-print(f"{'M':>4} {'gpipe ms':>9} {'sync1f1b ms':>12} {'ratio':>6} {'eager bubble':>13} {'sync bubble':>12}")
+print(f"{'M':>4} {'gpipe ms':>9} {'sync1f1b ms':>12} {'ilvV1 ms':>9} {'ilvV2 ms':>9} "
+      f"{'eager bub':>10} {'sync bub':>9} {'ilv2 bub':>9}")
 for M in (4, 8, 16, 32):
     tg = measure("gpipe", M)
     ts = measure("1f1b", M)
-    print(f"{M:>4} {tg*1000:>9.1f} {ts*1000:>12.1f} {ts/tg:>6.2f} "
-          f"{bubble_fraction(M, 4):>13.3f} {bubble_fraction(M, 4, 'sync_1f1b'):>12.3f}")
+    t1 = measure("interleaved", M, num_chunks=1)
+    t2 = measure("interleaved", M, num_chunks=2)
+    print(f"{M:>4} {tg*1000:>9.1f} {ts*1000:>12.1f} {t1*1000:>9.1f} {t2*1000:>9.1f} "
+          f"{bubble_fraction(M, 4):>10.3f} {bubble_fraction(M, 4, 'sync_1f1b'):>9.3f} "
+          f"{bubble_fraction(M, 4, 'sync_interleaved', 2):>9.3f}")
