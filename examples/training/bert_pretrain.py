@@ -7,7 +7,6 @@
 """
 
 import argparse
-import json
 import os
 import sys
 
@@ -43,11 +42,11 @@ def main():
         pretraining_loss,
     )
     from neuronx_distributed_tpu.trainer import (
-        Throughput,
+        TrainingMetrics,
         default_batch_spec,
+        fit,
         initialize_parallel_model,
         initialize_parallel_optimizer,
-        make_train_step,
     )
     from neuronx_distributed_tpu.utils import initialize_distributed
 
@@ -63,9 +62,6 @@ def main():
         (jnp.zeros((1, args.seq_len), jnp.int32),), seed=args.seed)
     opt = initialize_parallel_optimizer(config, model)
     spec = default_batch_spec()
-    step_fn = make_train_step(
-        config, model, opt, pretraining_loss,
-        batch_spec={"ids": spec, "mlm_labels": spec, "nsp_labels": spec})
 
     MASK = 103  # [MASK] in the BERT vocab
     # skip the special-token id range on the real vocab; tiny vocabs have no
@@ -84,23 +80,15 @@ def main():
             "nsp_labels": jax.random.randint(k3, (args.batch_size,), 0, 2),
         }
 
-    params, state = model.params, opt.state
-    thr = Throughput(args.batch_size)
-    for step in range(args.steps):
-        params, state, m = step_fn(params, state, next_batch(step),
-                                   jax.random.fold_in(jax.random.PRNGKey(0), step))
-        seqs = thr.step()
-        if step % 10 == 0 or step == args.steps - 1:
-            print(json.dumps({"step": step, "loss": round(float(m["loss"]), 4),
-                              "seq_per_sec": round(seqs, 2)}), flush=True)
-    if args.metrics_file:
-        from neuronx_distributed_tpu.trainer.metrics import TrainingMetrics
-
-        rec = TrainingMetrics(args.metrics_file)
-        rec.update(final_loss=float(m["loss"]), completed_steps=args.steps,
-                   peak_seq_per_sec=thr.peak)
-        rec.write()
-    print(f"done: final loss {float(m['loss']):.4f}")
+    res = fit(
+        config, model, opt, next_batch, steps=args.steps,
+        loss_fn=pretraining_loss,
+        batch_spec={"ids": spec, "mlm_labels": spec, "nsp_labels": spec},
+        metrics=TrainingMetrics(args.metrics_file) if args.metrics_file else None,
+        step_rng=True,  # BERT trains with dropout
+        log_every=10,
+    )
+    print(f"done: final loss {res.final_loss:.4f}")
 
 
 if __name__ == "__main__":

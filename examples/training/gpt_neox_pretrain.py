@@ -7,7 +7,6 @@
 """
 
 import argparse
-import json
 import os
 import sys
 
@@ -48,11 +47,11 @@ def main():
         causal_lm_loss,
     )
     from neuronx_distributed_tpu.trainer import (
-        Throughput,
+        TrainingMetrics,
         default_batch_spec,
+        fit,
         initialize_parallel_model,
         initialize_parallel_optimizer,
-        make_train_step,
     )
     from neuronx_distributed_tpu.utils import initialize_distributed
 
@@ -75,9 +74,6 @@ def main():
         config, lambda: GPTNeoXForCausalLM(cfg),
         (jnp.zeros((1, args.seq_len), jnp.int32),), seed=args.seed)
     opt = initialize_parallel_optimizer(config, model)
-    step_fn = make_train_step(
-        config, model, opt, causal_lm_loss,
-        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()})
 
     if args.data:
         from neuronx_distributed_tpu.data import TokenDataLoader, TokenDataset
@@ -104,23 +100,14 @@ def main():
             ids = jax.random.randint(k, (args.batch_size, args.seq_len), 0, cfg.vocab_size)
             return {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
 
-    params, state = model.params, opt.state
-    thr = Throughput(args.batch_size)
-    for step in range(args.steps):
-        params, state, m = step_fn(params, state, next_batch(step),
-                                   jax.random.fold_in(jax.random.PRNGKey(0), step))
-        seqs = thr.step()
-        if step % 10 == 0 or step == args.steps - 1:
-            print(json.dumps({"step": step, "loss": round(float(m["loss"]), 4),
-                              "seq_per_sec": round(seqs, 2)}), flush=True)
-    if args.metrics_file:
-        from neuronx_distributed_tpu.trainer.metrics import TrainingMetrics
-
-        rec = TrainingMetrics(args.metrics_file)
-        rec.update(final_loss=float(m["loss"]), completed_steps=args.steps,
-                   peak_seq_per_sec=thr.peak)
-        rec.write()
-    print(f"done: final loss {float(m['loss']):.4f}")
+    res = fit(
+        config, model, opt, next_batch, steps=args.steps,
+        loss_fn=causal_lm_loss,
+        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()},
+        metrics=TrainingMetrics(args.metrics_file) if args.metrics_file else None,
+        log_every=10,
+    )
+    print(f"done: final loss {res.final_loss:.4f}")
 
 
 if __name__ == "__main__":

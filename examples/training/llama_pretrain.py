@@ -21,7 +21,6 @@ Real corpus (NXDT token file, see neuronx_distributed_tpu.data):
 """
 
 import argparse
-import json
 import os
 import sys
 
@@ -40,7 +39,15 @@ def parse_args():
     p.add_argument("--pp", type=int, default=1, help="pipeline parallel degree")
     p.add_argument("--microbatches", type=int, default=1,
                    help="pipeline microbatches (pp>1)")
-    p.add_argument("--pp-schedule", default="1f1b", choices=["1f1b", "gpipe"])
+    p.add_argument("--pp-schedule", default="1f1b",
+                   choices=["1f1b", "gpipe", "interleaved"])
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   help="interleaved virtual stages per pp rank (with "
+                        "--pp-schedule interleaved); divides the bubble by ~V")
+    p.add_argument("--loss-chunk", type=int, default=0,
+                   help="chunked lm-head+CE: compute the loss per N-token "
+                        "sequence chunk so [B,S,V] logits never hit HBM "
+                        "(0 = off; 512 is a good TPU value)")
     p.add_argument("--cp", type=int, default=1, help="context parallel degree (ring attention)")
     p.add_argument("--kv-multiplier", type=int, default=1,
                    help="KV replication when num_kv_heads < tp")
@@ -75,6 +82,10 @@ def parse_args():
     p.add_argument("--virtual-devices", type=int, default=None,
                    help="force an N-device virtual CPU mesh (dev/test runs)")
     args = p.parse_args()
+    if args.loss_chunk and args.pp > 1:
+        p.error("--loss-chunk has no effect with --pp > 1: the pipeline "
+                "engine owns the head+loss (its last stage computes per-"
+                "microbatch logits already bounded by the microbatch size)")
     if args.packed and not args.data:
         p.error("--packed requires --data (an eos-joined NXDT document stream)")
     if args.packed and args.packed_eos_id is None:
@@ -88,19 +99,14 @@ def main():
     from neuronx_distributed_tpu.models.llama import (
         LlamaConfig,
         LlamaForCausalLM,
-        causal_lm_loss,
+        make_causal_lm_loss_sum,
     )
     from neuronx_distributed_tpu.trainer import (
-        Throughput,
         TrainingMetrics,
         default_batch_spec,
+        fit,
         initialize_parallel_model,
         initialize_parallel_optimizer,
-        load_checkpoint,
-        make_train_step,
-        mfu,
-        newest_tag,
-        save_checkpoint,
         transformer_flops_per_token,
     )
     from neuronx_distributed_tpu.utils import Timeline, initialize_distributed
@@ -125,6 +131,7 @@ def main():
         kv_size_multiplier=args.kv_multiplier,
         num_microbatches=args.microbatches,
         schedule=args.pp_schedule,
+        virtual_stages=args.virtual_stages,
         packed_inputs=args.packed and args.pp > 1,
         learning_rate=args.lr,
         lr_schedule="cosine",
@@ -155,15 +162,9 @@ def main():
     if args.packed:
         bspec.update({"positions": default_batch_spec(),
                       "segment_ids": default_batch_spec()})
-    step_fn = make_train_step(config, model, opt, causal_lm_loss, batch_spec=bspec)
-    params, opt_state = model.params, opt.state
-
-    start_step = 0
-    if args.resume and args.ckpt_dir and newest_tag(args.ckpt_dir):
-        params, opt_state, _, user = load_checkpoint(
-            args.ckpt_dir, model_template=params, optimizer_template=opt_state)
-        start_step = (user or {}).get("step", 0)
-        print(f"resumed from step {start_step}")
+    # token-exact (loss_sum, tok) loss; --loss-chunk > 0 additionally chunks
+    # the lm-head+CE so [B,S,V] logits never materialize (TPU HBM saver)
+    loss_fn = make_causal_lm_loss_sum(chunk_size=args.loss_chunk)
 
     # data: NXDT corpus through the native loader, or synthetic
     dp = nxd.get_data_parallel_size()
@@ -219,21 +220,22 @@ def main():
         loader = TokenDataLoader(
             ds, batch_size=args.batch_size, seq_len=args.seq_len,
             dp_rank=0, dp_size=1, seed=args.seed)  # single-controller: full batch
-        # resume at the right epoch so the shuffle order matches an
-        # uninterrupted run (epoch = step // batches-per-epoch)
-        loader.set_epoch(
-            start_step // max(len(loader), 1),
-            skip_batches=start_step % max(len(loader), 1),
-        )
-        data_iter = iter(loader)
+        L = max(len(loader), 1)
+        state = {"iter": None, "expected": None}
 
         def next_batch(step):
-            nonlocal data_iter
-            b = next(data_iter, None)
+            # step-indexed facade over the epoch iterator: any jump (fit()'s
+            # resume, an epoch boundary) re-seeks by epoch + skip so the
+            # shuffle order matches an uninterrupted run
+            if state["expected"] != step:
+                loader.set_epoch(step // L, skip_batches=step % L)
+                state["iter"] = iter(loader)
+            b = next(state["iter"], None)
             if b is None:
-                loader.set_epoch(step // max(len(loader), 1))
-                data_iter = iter(loader)
-                b = next(data_iter)
+                loader.set_epoch(step // L)
+                state["iter"] = iter(loader)
+                b = next(state["iter"])
+            state["expected"] = step + 1
             return {"ids": jnp.asarray(b["ids"]), "labels": jnp.asarray(b["labels"])}
     else:
         def next_batch(step):
@@ -244,55 +246,26 @@ def main():
     flops_tok = transformer_flops_per_token(
         cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
         args.seq_len, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_)
-    tl = Timeline(args.timeline)
-    thr = Throughput(args.batch_size)
     metrics = TrainingMetrics(args.metrics_file) if args.metrics_file else None
-    from neuronx_distributed_tpu.trainer.scalar_log import ScalarWriter
 
-    scalars = ScalarWriter(args.scalar_dir) if args.scalar_dir else None
-
-    for step in range(start_step, args.steps):
-        with tl.event("train_step"):
-            batch = next_batch(step)
-            params, opt_state, m = step_fn(params, opt_state, batch,
-                                           jax.random.fold_in(jax.random.PRNGKey(0), step))
-            loss = float(m["loss"])
-        seqs = thr.step()
-        toks = seqs * args.seq_len
-        if scalars:
-            scalars.scalars(step, loss=loss, grad_norm=float(m["grad_norm"]),
-                            seq_per_sec=seqs)
-        if step % 10 == 0 or step == args.steps - 1:
-            line = {
-                "step": step, "loss": round(loss, 4),
-                "seq_per_sec": round(seqs, 2),
-                "tokens_per_sec": round(toks, 1),
-                "grad_norm": round(float(m["grad_norm"]), 4),
-            }
-            print(json.dumps(line), flush=True)
-        tl.mark_step_end(step)
-        if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
-            # async: the save overlaps the next training steps; the next
-            # save (or the final wait) finalizes it
-            save_checkpoint(args.ckpt_dir, f"step_{step + 1}", params, opt_state,
-                            user_content={"step": step + 1},
-                            num_kept_ckpts=args.keep_ckpts, async_save=True)
-
-    if args.ckpt_dir:
-        save_checkpoint(args.ckpt_dir, f"step_{args.steps}", params, opt_state,
-                        user_content={"step": args.steps}, num_kept_ckpts=args.keep_ckpts)
-        from neuronx_distributed_tpu.trainer.checkpoint import wait_for_checkpoint
-
-        wait_for_checkpoint()
-    if scalars:
-        scalars.close()
-    if metrics:
-        peak = 197e12 if on_tpu else 1e12
-        metrics.update(final_loss=loss, peak_seq_per_sec=thr.peak,
-                       mfu=mfu(toks, flops_tok, peak), steps=args.steps,
-                       completed_steps=args.steps, resumed_from_step=start_step)
-        metrics.write()
-    print(f"done: final loss {loss:.4f}")
+    # the whole loop — step/eval/checkpoint/resume/logging — is fit()'s job
+    res = fit(
+        config, model, opt, next_batch,
+        steps=args.steps,
+        loss_fn=loss_fn,
+        batch_spec=bspec,
+        ckpt_dir=args.ckpt_dir,
+        ckpt_every=args.ckpt_every,
+        keep_ckpts=args.keep_ckpts,
+        resume=args.resume,
+        scalar_dir=args.scalar_dir,
+        metrics=metrics,
+        timeline=Timeline(args.timeline) if args.timeline else None,
+        flops_per_token=flops_tok,
+        peak_flops=197e12 if on_tpu else 1e12,
+        log_every=10,
+    )
+    print(f"done: final loss {res.final_loss:.4f}")
 
 
 if __name__ == "__main__":
