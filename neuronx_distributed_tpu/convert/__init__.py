@@ -1,6 +1,13 @@
 """HF-checkpoint interoperability (reference:
 ``examples/training/llama2/convert_checkpoints.py`` HF↔NxD conversion)."""
 
+from neuronx_distributed_tpu.convert.nxd import (  # noqa: F401
+    GPT_NEOX_TP_RULES,
+    LLAMA_TP_RULES,
+    load_nxd_checkpoint,
+    merge_tp_shards,
+    split_fused_llama,
+)
 from neuronx_distributed_tpu.convert.hf import (  # noqa: F401
     bert_params_from_hf,
     bert_params_to_hf,

@@ -1,0 +1,108 @@
+"""Dropout / RNG discipline under tensor and data parallelism.
+
+The reference maintains a TP-aware RNG tracker that forks per-rank seeds
+(seed, seed+2718+tp_rank) so each TP rank draws an independent dropout mask
+for its activation shard (``parallel_layers/random.py:100-127``).  The
+TPU-native stance (pinned in ``parallel.mesh.initialize_model_parallel``):
+``jax_threefry_partitionable = True`` gives every ``jax.random`` draw
+*sharding-invariant* global-array semantics — each shard generates exactly
+its slice of the one logical stream — so per-rank seed bookkeeping
+disappears while masks remain shard-correct and runs remain reproducible
+across mesh shapes.  These tests pin that contract (VERDICT r3 #5):
+
+- same seed → bit-identical loss; different seed → different loss;
+- train/eval toggling: ``rng=None`` is deterministic and differs from the
+  dropout path;
+- mesh invariance: tp=2 x dp=4 reproduces the single-device loss exactly,
+  masks included — the shard-consistency property the reference needs a
+  dedicated RNG tracker for;
+- gradients under dropout are mesh-invariant too.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.models.bert import (
+    BertConfig,
+    BertForPreTraining,
+    pretraining_loss,
+)
+from conftest import sharded_params
+
+
+def _cfg():
+    return BertConfig.tiny(hidden_dropout=0.5, dtype=jnp.float32,
+                           param_dtype=jnp.float32)
+
+
+def _batch(bsz=8, seq=16, vocab=256):
+    k = jax.random.PRNGKey(0)
+    ids = jax.random.randint(k, (bsz, seq), 5, vocab)
+    labels = jnp.where(jax.random.bernoulli(k, 0.15, ids.shape), ids, -100)
+    return {"ids": ids, "mlm_labels": labels,
+            "nsp_labels": jnp.zeros((bsz,), jnp.int32)}
+
+
+def _loss_and_grad(module, params, batch, rng):
+    def f(p):
+        return pretraining_loss(module, p, batch, rng)
+    return jax.jit(jax.value_and_grad(f))(params)
+
+
+def _run(devices, rng):
+    cfg = _cfg()
+    module = BertForPreTraining(cfg)
+    batch = _batch()
+    params = module.init(jax.random.PRNGKey(1), batch["ids"][:1])
+    loss, grads = _loss_and_grad(module, sharded_params(params), batch, rng)
+    return float(loss), grads
+
+
+def test_dropout_seed_reproducible_and_toggles(devices8):
+    nxd.initialize_model_parallel(tensor_parallel_size=2, devices=devices8)
+    cfg = _cfg()
+    assert cfg.hidden_dropout == 0.5
+    module = BertForPreTraining(cfg)
+    batch = _batch()
+    params = sharded_params(module.init(jax.random.PRNGKey(1), batch["ids"][:1]))
+
+    la, _ = _loss_and_grad(module, params, batch, jax.random.PRNGKey(7))
+    lb, _ = _loss_and_grad(module, params, batch, jax.random.PRNGKey(7))
+    lc, _ = _loss_and_grad(module, params, batch, jax.random.PRNGKey(8))
+    le1, _ = _loss_and_grad(module, params, batch, None)
+    le2, _ = _loss_and_grad(module, params, batch, None)
+    assert float(la) == float(lb)          # same seed: bit-identical
+    assert float(la) != float(lc)          # different seed: different masks
+    assert float(le1) == float(le2)        # eval deterministic
+    assert float(le1) != float(la)         # dropout actually active in train
+
+
+def test_dropout_mask_mesh_invariant(devices8):
+    """tp=2 x dp=4 must reproduce the single-device dropout loss exactly:
+    under partitionable threefry each shard draws its slice of the same
+    logical mask, so sharding choice cannot change the math (the property
+    the reference's forked-seed tracker exists to approximate)."""
+    rng = jax.random.PRNGKey(7)
+
+    nxd.initialize_model_parallel(tensor_parallel_size=1, devices=devices8[:1])
+    l1, g1 = _run(devices8[:1], rng)
+    nxd.destroy_model_parallel()
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2, devices=devices8)
+    l2, g2 = _run(devices8, rng)
+
+    assert l1 == pytest.approx(l2, rel=1e-6), (l1, l2)
+    for (kp, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(g1)[0],
+        jax.tree_util.tree_flatten_with_path(g2)[0],
+    ):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
+                                   atol=1e-6, err_msg=jax.tree_util.keystr(kp))
+
+
+def test_threefry_partitionable_pinned(devices8):
+    nxd.initialize_model_parallel(tensor_parallel_size=2, devices=devices8)
+    assert jax.config.jax_threefry_partitionable

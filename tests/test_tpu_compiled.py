@@ -19,6 +19,21 @@ _REPO = os.path.dirname(os.path.dirname(__file__))
 def test_flash_attention_compiles_and_matches_on_tpu():
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # Fast pre-probe: when the tunnel is down, backend init hangs — don't
+    # spend the full 420s kernel budget discovering that (the round-3/4
+    # outage cost every full-suite run 7 minutes here).  A 90s probe that
+    # never prints TPU-READY means "environment, skip".
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices()[0]; "
+             "print('TPU-READY' if d.platform != 'cpu' else 'cpu')"],
+            env=env, capture_output=True, text=True, timeout=90,
+        )
+        if "TPU-READY" not in (probe.stdout or ""):
+            pytest.skip("no TPU attached (probe saw cpu backend)")
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU backend unresponsive (tunnel down); skipping compiled check")
     try:
         proc = subprocess.run(
             [sys.executable, _CHECK], env=env, capture_output=True, text=True,

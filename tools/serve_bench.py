@@ -1,0 +1,103 @@
+"""Serving decode-latency benchmark on the local chip — one JSON line.
+
+Measures the bench-scale (438M, the single-chip Llama-2-7B/TP8 slice) model
+through the serving engine's neuronperf-equivalent harness
+(`trace.engine.benchmark`: context-encode ms, per-token p50/p99 ms,
+tokens/s — reference `examples/inference/benchmark.py:53-77`).  Run by the
+TPU watcher in a healthy window (VERDICT r3 #6: record serving latency in
+the repo); `--tiny` smoke-tests the harness on CPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--tiny", action="store_true", help="CPU smoke config")
+    p.add_argument("--batch-size", type=int, default=1)
+    p.add_argument("--context-len", type=int, default=128)
+    p.add_argument("--max-total-len", type=int, default=256)
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    args = p.parse_args()
+
+    import jax
+
+    if args.tiny:
+        jax.config.update("jax_platforms", "cpu")
+    # persistent compilation cache (shared with bench.py)
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache")
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001
+        pass
+
+    import jax.numpy as jnp
+
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.trace import InferenceConfig, ParallelInferenceModel
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform != "cpu"
+    if not on_tpu and not args.tiny:
+        print("refusing to record a non-TPU serving number; use --tiny for "
+              "a CPU harness smoke", file=sys.stderr)
+        return 1
+    nxd.initialize_model_parallel(tensor_parallel_size=1, devices=devices[:1])
+
+    if args.tiny:
+        cfg = LlamaConfig.tiny(max_seq_len=args.max_total_len,
+                               sequence_parallel=False, remat="none")
+        args.max_new_tokens = min(args.max_new_tokens, 8)
+    else:
+        # the bench.py 438M model (7B hidden layout / 4)
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_layers=12, num_heads=12, num_kv_heads=12, head_dim=128,
+            max_seq_len=args.max_total_len, sequence_parallel=False,
+            remat="none",
+        )
+    from flax import linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neuronx_distributed_tpu.parallel.mesh import get_mesh
+
+    module = LlamaForCausalLM(cfg)
+    ids0 = jnp.zeros((args.batch_size, args.context_len), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), ids0)
+    specs = nn.get_partition_spec(params)
+    mesh = get_mesh()
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        nn.unbox(params), specs,
+        is_leaf=lambda x: isinstance(x, P) or not isinstance(x, dict))
+    icfg = InferenceConfig(
+        batch_size=args.batch_size, context_len=args.context_len,
+        max_total_len=args.max_total_len,
+        kv_cache_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    model = ParallelInferenceModel(module, params, icfg)
+    stats = model.benchmark(max_new_tokens=args.max_new_tokens)
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    print(json.dumps({
+        "metric": "serving_decode_latency",
+        "device": getattr(devices[0], "device_kind", devices[0].platform),
+        "model_params_m": round(n_params / 1e6),
+        "config": {"batch": args.batch_size, "context": args.context_len,
+                   "max_new": args.max_new_tokens},
+        **stats,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

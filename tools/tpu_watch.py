@@ -42,12 +42,14 @@ DEFAULT_RESULTS = os.path.join(REPO, "docs", "tpu_watch_results.jsonl")
 
 # Ladder measured when healthy, best-first.  Mirrors bench.py's TPU rungs;
 # the watcher runs ALL of them (not first-success-wins) so a single healthy
-# window yields the full batch/remat picture.
+# window yields the full batch/remat/loss picture.
 MEASURE = [
-    ("flash", 16, "selective"),
-    ("flash", 8, "none"),
-    ("flash", 8, "selective"),
-    ("dense", 8, "selective"),
+    ("flash", 16, "none", "chunked:512"),
+    ("flash", 16, "selective", "chunked:512"),
+    ("flash", 8, "none", "chunked:512"),
+    ("flash", 8, "none", "mean"),
+    ("flash", 8, "selective", "mean"),
+    ("dense", 8, "selective", "mean"),
 ]
 
 PROBE_TIMEOUT_S = 180
@@ -77,16 +79,18 @@ def probe() -> tuple[bool, str]:
     return proc.returncode == 0, msg[0]
 
 
-def measure(attn: str, batch: int, remat: str) -> dict:
+def measure(attn: str, batch: int, remat: str, loss: str) -> dict:
     cmd = [sys.executable, BENCH, "--run", "--platform=tpu",
-           f"--attn={attn}", f"--batch={batch}", f"--remat={remat}"]
+           f"--attn={attn}", f"--batch={batch}", f"--remat={remat}",
+           f"--loss={loss}"]
+    base = {"kind": "measurement", "attn": attn, "batch": batch,
+            "remat": remat, "loss": loss}
     t0 = time.time()
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=MEASURE_TIMEOUT_S, cwd=REPO)
     except subprocess.TimeoutExpired:
-        return {"kind": "measurement", "attn": attn, "batch": batch,
-                "remat": remat, "ok": False,
+        return {**base, "ok": False,
                 "error": f"timed out after {MEASURE_TIMEOUT_S}s"}
     dt = round(time.time() - t0, 1)
     if proc.returncode == 0:
@@ -96,12 +100,9 @@ def measure(attn: str, batch: int, remat: str) -> dict:
                     parsed = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                return {"kind": "measurement", "attn": attn, "batch": batch,
-                        "remat": remat, "ok": True, "wall_s": dt,
-                        "result": parsed}
+                return {**base, "ok": True, "wall_s": dt, "result": parsed}
     tail = " | ".join((proc.stderr or "").strip().splitlines()[-3:])
-    return {"kind": "measurement", "attn": attn, "batch": batch,
-            "remat": remat, "ok": False, "wall_s": dt,
+    return {**base, "ok": False, "wall_s": dt,
             "error": f"rc={proc.returncode}: {tail[:400]}"}
 
 
@@ -109,6 +110,7 @@ def run_extra_jobs(results_path: str) -> None:
     """One-shot jobs that ride the first healthy window (VERDICT r3 #6)."""
     jobs = [
         ("tp_allreduce", [sys.executable, os.path.join(REPO, "tools", "ici_bench.py")]),
+        ("serving_latency", [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")]),
     ]
     for name, cmd in jobs:
         if not os.path.exists(cmd[1]):
@@ -149,8 +151,8 @@ def main() -> int:
         ok, msg = probe()
         append(args.results, {"kind": "probe", "ok": ok, "detail": msg})
         if ok:
-            for attn, batch, remat in MEASURE:
-                rec = measure(attn, batch, remat)
+            for attn, batch, remat, loss in MEASURE:
+                rec = measure(attn, batch, remat, loss)
                 append(args.results, rec)
             if not extra_done:
                 run_extra_jobs(args.results)
