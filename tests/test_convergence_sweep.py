@@ -40,37 +40,42 @@ def _data():
     return {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
 
 
-def _run(devices, *, tp=1, pp=1, cp=1, kvr=1, sp=False, remat="none",
+def _run(devices, *, tp=1, pp=1, cp=1, ep=1, kvr=1, sp=False, remat="none",
          zero1=True, dtype="float32", attn="dense", num_mb=1, kv_heads=8,
          num_layers=2, pipelined=None, fsdp=False, cp_impl="ring",
-         num_experts=1, cuts=None):
+         num_experts=1, cuts=None, schedule="1f1b", virtual_stages=1,
+         moe_dispatch="einsum"):
     """One grid cell.  ``pipelined`` forces the pipelined-model code path
     even at pp=1 (the PP rows' golden: same stacked init, single device)."""
     nxd.destroy_model_parallel()
-    n = tp * pp * cp
+    n = tp * pp * cp * ep
     use = devices[: n * (len(devices) // n)] if n > 1 else devices[:1]
     nxd.initialize_model_parallel(
         tensor_parallel_size=tp, pipeline_parallel_size=pp,
-        context_parallel_size=cp, kv_size_multiplier=kvr, devices=use,
+        context_parallel_size=cp, expert_parallel_size=ep,
+        kv_size_multiplier=kvr, devices=use,
     )
     cfg = LlamaConfig.tiny(
         vocab_size=VOCAB, num_heads=8, num_kv_heads=kv_heads, num_layers=num_layers,
         sequence_parallel=sp, remat=remat, attention_impl=attn, cp_impl=cp_impl,
         num_experts=num_experts, moe_capacity_factor=8.0,
+        moe_dispatch=moe_dispatch,
         dtype=jnp.dtype(dtype), param_dtype=jnp.float32, max_seq_len=S,
     )
     config = nxd.training_config(
         tensor_parallel_size=tp, pipeline_parallel_size=pp,
-        context_parallel_size=cp, kv_size_multiplier=kvr,
-        num_microbatches=num_mb, schedule="1f1b", pipeline_cuts=cuts,
+        context_parallel_size=cp, expert_parallel_size=ep,
+        kv_size_multiplier=kvr,
+        num_microbatches=num_mb, schedule=schedule, pipeline_cuts=cuts,
+        virtual_stages=virtual_stages,
         learning_rate=LR, zero_one_enabled=zero1, fsdp=fsdp,
         compute_dtype=dtype, param_dtype="float32",
     )
     use_pipelined = pipelined if pipelined is not None else pp > 1
     if use_pipelined:
         model = LlamaForCausalLM(cfg).build_pipelined(
-            num_microbatches=num_mb, schedule="1f1b", seed=config.seed,
-            pipeline_cuts=cuts,
+            num_microbatches=num_mb, schedule=schedule, seed=config.seed,
+            pipeline_cuts=cuts, num_chunks=virtual_stages,
         )
         opt = initialize_parallel_optimizer(config, model)
         from neuronx_distributed_tpu.trainer.trainer import make_pipelined_train_step
@@ -133,6 +138,15 @@ GRID = {
     "TP2_CP2_ULYSSES_PP1_Zero1_FP32": ("mha", dict(tp=2, cp=2, attn="flash", cp_impl="ulysses", zero1=True)),
     "TP1_CUTS31_PP2_Zero1_FP32": ("pipelined4", dict(pp=2, num_mb=2, num_layers=4, cuts=(3,), zero1=True)),
     "TP2_MOE4_PP2_Zero1_FP32": ("moe", dict(tp=2, pp=2, num_mb=2, num_experts=4, zero1=True)),
+    # round-4 dimensions: interleaved virtual stages, scatter dispatch,
+    # expert-sharded MoE under PP
+    "TP2_ILV2_PP2_Zero1_FP32": ("pipelined4", dict(
+        tp=2, pp=2, num_mb=2, num_layers=4, schedule="interleaved",
+        virtual_stages=2, zero1=True)),
+    "TP2_MOE4_SCATTER_PP1_Zero1_FP32": ("moe", dict(
+        tp=2, num_experts=4, pipelined=True, moe_dispatch="scatter", zero1=True)),
+    "EP2_MOE4_SCATTER_PP2_Zero1_FP32": ("moe", dict(
+        pp=2, ep=2, num_mb=2, num_experts=4, moe_dispatch="scatter", zero1=True)),
 }
 
 

@@ -722,10 +722,12 @@ def test_pipeline_cuts_via_trainer_config(devices8):
     assert losses[-1] < losses[0] - 0.2, losses
 
 
-def test_packed_pipeline_matches_dense(devices8):
+@pytest.mark.parametrize("schedule,chunks", [("1f1b", 1), ("interleaved", 2)])
+def test_packed_pipeline_matches_dense(devices8, schedule, chunks):
     """Packed pretraining under PP (the extras channel): segment masking and
-    per-document positions through the 1F1B schedule must match the dense
-    pp=1 model, and 1F1B grads must match the fill-drain autodiff oracle."""
+    per-document positions through the schedule — plain sync-1F1B and the
+    chunk-granular interleaved engine alike — must match the dense pp=1
+    model, and manual grads must match the fill-drain autodiff oracle."""
     from neuronx_distributed_tpu.data.packing import pack_documents
 
     nxd.initialize_model_parallel(
@@ -735,7 +737,8 @@ def test_packed_pipeline_matches_dense(devices8):
         num_layers=4, sequence_parallel=False, remat="none",
         dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=32,
     )
-    pmodel = build_pipelined_llama(cfg, num_microbatches=2, seed=11, packed=True)
+    pmodel = build_pipelined_llama(cfg, num_microbatches=2, seed=11, packed=True,
+                                   schedule=schedule, num_chunks=chunks)
     assert pmodel.extra_keys == ("positions", "segment_ids")
 
     rng = np.random.RandomState(0)
