@@ -178,35 +178,54 @@ class GPTNeoXForCausalLM(nn.Module):
             pipeline_cuts=pipeline_cuts, num_chunks=num_chunks,
         )
 
-    @nn.compact
-    def __call__(self, ids, positions=None):
+    def setup(self):
+        # setup-style (explicit names preserve the compact-era param paths)
+        # so ``hidden``/``head`` below share submodules with ``__call__`` —
+        # the chunked-loss-head protocol (models.common.make_causal_lm_loss_sum)
         cfg = self.config
-        if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
-        h = ParallelEmbedding(
+        self.embed_in_mod = ParallelEmbedding(
             num_embeddings=cfg.vocab_size,
             features=cfg.hidden_size,
             sequence_parallel_output=cfg.sequence_parallel,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="embed_in",
-        )(ids)
-
+        )
         block_cls = maybe_remat(GPTNeoXBlock, cfg.remat)
-        for i in range(cfg.num_layers):
-            h = block_cls(cfg, name=f"layer_{i}")(h, positions)
-        h = LayerNorm(eps=cfg.ln_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                      name="final_norm")(h)
-        if cfg.sequence_parallel:
-            h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))
-        return ColumnParallelLinear(
+        self.blocks = [block_cls(cfg, name=f"layer_{i}")
+                       for i in range(cfg.num_layers)]
+        self.final_norm_mod = LayerNorm(
+            eps=cfg.ln_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="final_norm")
+        self.embed_out_mod = ColumnParallelLinear(
             features=cfg.vocab_size,
             use_bias=False,
             gather_output=False,  # vocab-sharded for parallel_cross_entropy
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             name="embed_out",
-        )(h)
+        )
+
+    def __call__(self, ids, positions=None):
+        return self.head(self.hidden(ids, positions))
+
+    def hidden(self, ids, positions=None):
+        """Backbone: final-norm hidden states with the sequence gathered
+        back from SP (chunked-loss-head input)."""
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+        h = self.embed_in_mod(ids)
+        for blk in self.blocks:
+            h = blk(h, positions)
+        h = self.final_norm_mod(h)
+        if cfg.sequence_parallel:
+            h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))
+        return h
+
+    def head(self, h):
+        """Vocab-sharded logits for a (chunk of) hidden states."""
+        return self.embed_out_mod(h)
 
 
 class GPTNeoXHead(nn.Module):

@@ -193,6 +193,42 @@ def test_neox_remat_matches_no_remat(devices8):
     assert outs["selective"] == pytest.approx(outs["none"], rel=1e-5)
 
 
+def test_neox_chunked_loss_head_matches_unchunked(devices8):
+    """GPT-NeoX exposes the hidden()/head() chunked-loss protocol too:
+    make_causal_lm_loss_sum(chunk) parity vs the plain (sum, tok) path."""
+    from neuronx_distributed_tpu.models import (
+        causal_lm_loss_sum,
+        make_causal_lm_loss_sum,
+    )
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2, devices=devices8)
+    cfg = GPTNeoXConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                             max_seq_len=16)
+    config = nxd.training_config(tensor_parallel_size=2, compute_dtype="float32")
+    model = initialize_parallel_model(
+        config, lambda: GPTNeoXForCausalLM(cfg), (jnp.zeros((1, 16), jnp.int32),))
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, cfg.vocab_size)
+    labels = np.asarray(jnp.roll(ids, -1, axis=1)).copy()
+    labels[0, 9:] = -100
+    batch = {"ids": ids, "labels": jnp.asarray(labels)}
+
+    def total(fn):
+        def f(p):
+            s, t = fn(model.module, p, batch)
+            return s / jnp.maximum(t, 1.0)
+        return jax.jit(jax.value_and_grad(f))
+
+    l_ref, g_ref = total(causal_lm_loss_sum)(model.params)
+    l_chk, g_chk = total(make_causal_lm_loss_sum(chunk_size=8))(model.params)
+    assert float(l_chk) == pytest.approx(float(l_ref), rel=1e-6)
+    for (kp, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(g_ref)[0],
+        jax.tree_util.tree_flatten_with_path(g_chk)[0],
+    ):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=2e-5,
+                                   atol=1e-7, err_msg=jax.tree_util.keystr(kp))
+
+
 def test_neox_pipeline_1f1b_matches_autodiff(devices8):
     """GPT-NeoX under the PP engine (the reference's 20B TP8xPP4 milestone
     topology scaled down): 1F1B manual backward == fill-drain autodiff."""
