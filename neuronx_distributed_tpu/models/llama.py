@@ -100,6 +100,12 @@ class LlamaConfig:
     # "einsum" (dense one-hot parity oracle) | "scatter" (O(N·H) segment-sum
     # dispatch — the trainable path at Mixtral scale, parallel/moe.py)
     moe_dispatch: str = "einsum"
+    # "topk" (tokens choose experts, Mixtral-style) | "expert_choice"
+    # (experts choose tokens — balanced by construction; NOTE: leaks future
+    # tokens into routing under causal training and differs between
+    # teacher-forced training and incremental decoding — principally an
+    # encoder/research router, see parallel/moe.py)
+    moe_router: str = "topk"
     # internal (set by build_pipelined_llama): experts held per ep rank when
     # the PP engine's manual-ep expert sharding is active; 0 = GSPMD mode
     moe_local_experts: int = 0
@@ -373,6 +379,7 @@ class LlamaBlock(nn.Module):
                 top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
                 dispatch=cfg.moe_dispatch,
+                router_type=cfg.moe_router,
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 name="moe_mlp",

@@ -95,6 +95,17 @@ class ExpertParallelMLP(nn.Module):
     # token shard — the explicit form of the a2a GSPMD inserts on the
     # pp==1 path.  0 = single-program GSPMD mode (num_experts is global).
     num_experts_global: int = 0
+    # "topk": tokens choose experts (GShard/Switch/Mixtral; needs the aux
+    #   loss + capacity drops).  "expert_choice": experts choose their top-C
+    #   tokens (Zhou et al. 2022, C = ceil(factor*k*N/E)) — every expert is
+    #   exactly full (no aux pressure; aux returns 0), though a token picked
+    #   by NO expert passes through residual-only, and ``top_k`` only sets
+    #   the AVERAGE experts per token.  CAUTION for causal LMs: each
+    #   expert's top-C compares a token's score against LATER tokens of the
+    #   same batch, so routing leaks future information during training and
+    #   differs between teacher-forced training and incremental decoding —
+    #   expert choice is principally an encoder/non-autoregressive router.
+    router_type: str = "topk"
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     kernel_init: Initializer = nn.initializers.lecun_normal()
@@ -117,6 +128,10 @@ class ExpertParallelMLP(nn.Module):
         if self.dispatch not in ("einsum", "scatter"):
             raise ValueError(
                 f"unknown dispatch {self.dispatch!r} (einsum | scatter)")
+        if self.router_type not in ("topk", "expert_choice"):
+            raise ValueError(
+                f"unknown router_type {self.router_type!r} "
+                "(topk | expert_choice)")
         *lead, H = x.shape
         E, I, K = self.num_experts, self.intermediate_size, self.top_k
         xt = x.reshape(-1, H)
@@ -150,6 +165,38 @@ class ExpertParallelMLP(nn.Module):
         )
         probs = jax.nn.softmax(logits, axis=-1)  # [N, Eg]
 
+        def ffn(x_e, wi_e, wo_e):
+            gu = jnp.einsum("ch,hfi->cfi", x_e, wi_e.astype(self.dtype),
+                            preferred_element_type=self.dtype)
+            h = jax.nn.silu(gu[:, 0, :]) * gu[:, 1, :]
+            h = shard_activation(h, _auto_spec(None, TENSOR_AXES))
+            return jnp.einsum("ci,ih->ch", h, wo_e.astype(self.dtype),
+                              preferred_element_type=self.dtype)
+
+        if self.router_type == "expert_choice":
+            # experts choose their top-C tokens (Zhou et al. 2022): every
+            # expert processes exactly C = cap tokens — perfect balance, no
+            # aux pressure (a token chosen by no expert is residual-only;
+            # see the router_type docstring for the causal-LM caveat).
+            # Gather/scatter dispatch is inherent (``dispatch`` is moot).
+            e0 = lax.axis_index(EXPERT_AXIS) * E if manual_ep else 0
+            w_all = probs.T.astype(jnp.float32)  # [Eg, N]
+            w_loc = lax.dynamic_slice_in_dim(w_all, e0, E, axis=0) \
+                if manual_ep else w_all
+            g_ec, tok_idx = jax.lax.top_k(w_loc, cap)  # [E, C]
+            xe = xt.astype(self.dtype)[tok_idx.reshape(-1)].reshape(E, cap, H)
+            xe = shard_activation(xe, _auto_spec(EXPERT_AXIS, None, None))
+            ye = jax.vmap(ffn)(xe, jnp.asarray(wi), jnp.asarray(wo))  # [E, C, H]
+            ye = shard_activation(ye, _auto_spec(EXPERT_AXIS, None, None))
+            contrib = (g_ec.astype(ye.dtype)[..., None] * ye).reshape(E * cap, H)
+            y = jax.ops.segment_sum(contrib, tok_idx.reshape(-1), num_segments=N)
+            if manual_ep:
+                y = lax.psum_scatter(y, EXPERT_AXIS, scatter_dimension=0,
+                                     tiled=True)
+            y = shard_activation(y, _auto_spec(BATCH_AXES, None))
+            return (y.reshape(*lead, H).astype(self.dtype),
+                    jnp.zeros((), jnp.float32))
+
         gate_vals, expert_idx = jax.lax.top_k(probs, K)  # [N, K]
         onehot = jax.nn.one_hot(expert_idx, Eg, dtype=jnp.float32)  # [N, K, Eg]
         expert_mask = jnp.max(onehot, axis=1)  # [N, Eg] (for the aux loss)
@@ -168,14 +215,6 @@ class ExpertParallelMLP(nn.Module):
         # normalize kept gates per token (Mixtral convention); fp32
         denom = jnp.maximum(jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
         gate_vals = gate_vals / denom
-
-        def ffn(x_e, wi_e, wo_e):
-            gu = jnp.einsum("ch,hfi->cfi", x_e, wi_e.astype(self.dtype),
-                            preferred_element_type=self.dtype)
-            h = jax.nn.silu(gu[:, 0, :]) * gu[:, 1, :]
-            h = shard_activation(h, _auto_spec(None, TENSOR_AXES))
-            return jnp.einsum("ci,ih->ch", h, wo_e.astype(self.dtype),
-                              preferred_element_type=self.dtype)
 
         # under manual ep this rank computes experts [e0, e0+E) of the
         # global space; elsewhere e0 = 0 and E == Eg
