@@ -69,7 +69,19 @@ def _rank_files(model_dir: str) -> Dict[Tuple[int, int], str]:
     for fname in sorted(os.listdir(model_dir)):
         m = pat.match(fname)
         if m:
-            out[(int(m.group(1)), int(m.group(2)))] = os.path.join(model_dir, fname)
+            path = os.path.join(model_dir, fname)
+            # The reference's ``use_xser=True`` serializer writes a ref-data
+            # .pt file plus a ``<name>.pt.tensors/`` directory of out-of-line
+            # tensors (xser.save); torch.load of the ref-data file alone
+            # yields tensor-reference stubs, not data.  Fail loudly up front.
+            if os.path.isdir(path + ".tensors"):
+                raise ValueError(
+                    f"{fname} is an xser-serialized checkpoint (sibling "
+                    f"'{fname}.tensors/' directory found); xser layouts are "
+                    "not supported — re-save from the reference with "
+                    "use_xser=False"
+                )
+            out[(int(m.group(1)), int(m.group(2)))] = path
     if not out:
         raise FileNotFoundError(
             f"no dp_rank_00_tp_rank_*_pp_rank_*.pt files in {model_dir} — "
@@ -101,13 +113,27 @@ def load_nxd_checkpoint(
     model_dir: str,
     tp_rules: Sequence[Tuple[str, Tuple[int, int]]] = LLAMA_TP_RULES,
     extra_rules: Optional[Sequence[Tuple[str, Tuple[int, int]]]] = None,
+    allow_pickle: bool = False,
+    allow_replicated_kv: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Read a reference per-rank model checkpoint directory into one full
     numpy state dict (original param names).
 
     ``extra_rules`` prepend user patterns for custom modules.  A param that
     matches no rule must be bit-identical across TP ranks, else this
-    raises with the offending name (add a rule rather than guess)."""
+    raises with the offending name (add a rule rather than guess).
+
+    Files are loaded with ``weights_only=True`` — reference model state
+    dicts are plain tensors, and this module's whole job is ingesting
+    third-party files, so arbitrary-pickle deserialization stays off.  If a
+    checkpoint genuinely needs full pickle, pass ``allow_pickle=True`` and
+    accept that a malicious file can then execute arbitrary code.
+
+    GQA ``weight_k``/``weight_v`` shards that are bit-identical across any
+    pair of tp ranks are rejected (they indicate the reference's
+    ``kv_size_multiplier > 1`` replication, which the ``(0, 1)`` rule
+    cannot invert); ``allow_replicated_kv=True`` skips that check for
+    checkpoints with genuinely identical shards (e.g. constant init)."""
     import torch  # CPU-only usage
 
     rules = tuple(extra_rules or ()) + tuple(tp_rules)
@@ -125,7 +151,7 @@ def load_nxd_checkpoint(
     for p in pp_ranks:
         per_tp = [
             {k: v for k, v in torch.load(files[(t, p)], map_location="cpu",
-                                         weights_only=False).items()}
+                                         weights_only=not allow_pickle).items()}
             for t in tp_ranks
         ]
         names = list(per_tp[0])
@@ -152,8 +178,47 @@ def load_nxd_checkpoint(
                 full[name] = shards[0]
             else:
                 dim, stride = ds
+                if (not allow_replicated_kv
+                        and re.search(r"\.(weight_k|weight_v)$", name)):
+                    _check_kv_not_replicated(name, shards)
                 full[name] = merge_tp_shards(shards, dim, stride)
     return full
+
+
+def _check_kv_not_replicated(name: str, shards: List[np.ndarray]) -> None:
+    """Refuse GQA KV shards saved with replication.
+
+    The reference's ``GQAQKVColumnParallelLinear`` with
+    ``kv_size_multiplier > 1`` replicates each KV head across a shared
+    group of TP ranks (``parallel_layers/layers.py`` KV-replication path),
+    so the per-rank ``weight_k``/``weight_v`` files hold duplicate copies.
+    Concatenating them with the plain ``(0, 1)`` rule would yield an
+    oversized, wrongly-ordered tensor with no error.  Replicated groups are
+    bit-identical by construction, so any pair of identical tp shards here
+    means the checkpoint used replication — raise with guidance instead of
+    silently corrupting the merge."""
+    import hashlib
+
+    # One byte-level digest per shard (O(tp), not O(tp^2) full compares);
+    # replicas are bit-copies, so digest equality catches them even when
+    # the values include NaNs (where elementwise == would miss).
+    seen: Dict[str, int] = {}
+    for i, s in enumerate(shards):
+        digest = hashlib.sha256(
+            repr((s.shape, s.dtype.str)).encode() + s.tobytes()).hexdigest()
+        if digest in seen:
+            raise ValueError(
+                f"{name}: tp ranks {seen[digest]} and {i} hold bit-identical "
+                "KV shards — this checkpoint was saved with GQA KV "
+                "replication (kv_size_multiplier > 1), which the (0, 1) "
+                "merge rule cannot invert. Re-save from the reference "
+                "with kv_size_multiplier=1, merge manually by taking one "
+                "shard per shared-KV group, or pass "
+                "allow_replicated_kv=True if the shards are genuinely "
+                "identical without replication (e.g. a constant-init "
+                "checkpoint)"
+            )
+        seen[digest] = i
 
 
 def split_fused_llama(state: Dict[str, np.ndarray],

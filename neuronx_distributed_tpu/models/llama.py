@@ -608,10 +608,13 @@ def build_pipelined_llama(
         # MoE block: hand the sown load-balancing term to the engine's aux
         # channel (coefficient folded here so the engine's layer-mean
         # normalization reproduces causal_lm_loss's
-        # ``MOE_AUX_COEF * mean(aux)``).  Note that inside the engine's
-        # manual (dp, ep, pp) shard_map the ep axis degenerates to data
-        # parallelism: expert weights are replicated per stage and routing
-        # is per-rank-local (parallel/moe._auto_spec).
+        # ``MOE_AUX_COEF * mean(aux)``).  Expert placement inside the
+        # engine's manual (dp, ep, pp) shard_map depends on the path: with
+        # ep == 1 or pp == 1 the ep axis degenerates to data parallelism
+        # (expert weights replicated per stage, routing per-rank-local,
+        # parallel/moe._auto_spec); with pp > 1 and ep > 1 the manual-ep
+        # path (moe_local_experts + keep_ep engine specs) shards experts
+        # across the ep axis within each stage and all-to-alls tokens.
         from neuronx_distributed_tpu.models.common import MOE_AUX_COEF
 
         def block_fn(lp, x, *extras):

@@ -107,6 +107,79 @@ def test_unmatched_sharded_param_raises(tmp_path):
     assert state["custom.weird.weight"].shape == (8, 4)
 
 
+def test_pickle_payload_rejected_by_default(tmp_path):
+    """weights_only=True is the default: a checkpoint carrying arbitrary
+    pickled objects (the ACE vector for third-party files) must fail to
+    load unless the caller explicitly opts in (ADVICE r4 medium)."""
+    import torch
+
+    class Sneaky:
+        def __reduce__(self):
+            return (str, ("pwned",))
+
+    mdir = str(tmp_path / "model")
+    os.makedirs(mdir)
+    for t in range(2):
+        torch.save({"model.norm.weight": torch.ones(4), "meta": Sneaky()},
+                   os.path.join(mdir, f"dp_rank_00_tp_rank_{t:02d}_pp_rank_00.pt"))
+    with pytest.raises(Exception):
+        load_nxd_checkpoint(mdir, LLAMA_TP_RULES)
+    # explicit opt-in loads it (replicated across ranks, no TP rule needed)
+    state = load_nxd_checkpoint(mdir, LLAMA_TP_RULES, allow_pickle=True)
+    np.testing.assert_array_equal(state["model.norm.weight"], np.ones(4))
+
+
+def test_replicated_gqa_kv_shards_raise(tmp_path):
+    """Reference checkpoints saved with kv_size_multiplier > 1 hold
+    bit-identical weight_k/weight_v copies across shared-group tp ranks;
+    the (0,1) concat cannot invert that, so the loader must raise rather
+    than silently emit an oversized tensor (ADVICE r4 low)."""
+    import torch
+
+    kv = np.random.RandomState(5).randn(4, 8).astype(np.float32)
+    mdir = str(tmp_path / "model")
+    os.makedirs(mdir)
+    for t in range(2):  # both ranks hold the SAME kv shard -> replication
+        torch.save({"model.layers.0.self_attn.qkv.weight_k": torch.tensor(kv)},
+                   os.path.join(mdir, f"dp_rank_00_tp_rank_{t:02d}_pp_rank_00.pt"))
+    with pytest.raises(ValueError, match="KV replication"):
+        load_nxd_checkpoint(mdir, LLAMA_TP_RULES)
+    # explicit opt-out for genuinely-identical (e.g. constant-init) shards
+    state = load_nxd_checkpoint(mdir, LLAMA_TP_RULES, allow_replicated_kv=True)
+    assert state["model.layers.0.self_attn.qkv.weight_k"].shape == (8, 8)
+
+
+def test_nonadjacent_kv_replication_detected(tmp_path):
+    """Strided replica placements (e.g. [h0, h1, h0, h1] at tp=4) have no
+    adjacent identical pair — the guard must compare all pairs."""
+    import torch
+
+    rng = np.random.RandomState(7)
+    h0, h1 = rng.randn(4, 8).astype(np.float32), rng.randn(4, 8).astype(np.float32)
+    mdir = str(tmp_path / "model")
+    os.makedirs(mdir)
+    for t, shard in enumerate([h0, h1, h0, h1]):
+        torch.save({"a.qkv.weight_v": torch.tensor(shard)},
+                   os.path.join(mdir, f"dp_rank_00_tp_rank_{t:02d}_pp_rank_00.pt"))
+    with pytest.raises(ValueError, match="KV replication"):
+        load_nxd_checkpoint(mdir, LLAMA_TP_RULES)
+
+
+def test_xser_layout_rejected(tmp_path):
+    """use_xser=True checkpoints (ref-data .pt + '<name>.pt.tensors/'
+    directory) must be rejected up front with guidance, not fail obscurely
+    downstream (ADVICE r4 low)."""
+    import torch
+
+    mdir = str(tmp_path / "model")
+    os.makedirs(mdir)
+    fname = "dp_rank_00_tp_rank_00_pp_rank_00.pt"
+    torch.save({"model.norm.weight": torch.ones(4)}, os.path.join(mdir, fname))
+    os.makedirs(os.path.join(mdir, fname + ".tensors"))
+    with pytest.raises(ValueError, match="xser"):
+        load_nxd_checkpoint(mdir, LLAMA_TP_RULES)
+
+
 def test_import_feeds_framework_llama(devices8, tmp_path):
     """End-to-end migration: reference per-rank ckpt -> merged dict -> HF
     bridge -> this framework's sharded LlamaForCausalLM params, logits
