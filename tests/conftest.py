@@ -45,6 +45,7 @@ SLOW_MODULES = {
     "test_lora",
     "test_models",
     "test_moe",
+    "test_northstar_dryrun",
     "test_rng_dropout",
     "test_tpu_compiled",
     "test_trace",

@@ -122,7 +122,9 @@ def test_pickle_payload_rejected_by_default(tmp_path):
     for t in range(2):
         torch.save({"model.norm.weight": torch.ones(4), "meta": Sneaky()},
                    os.path.join(mdir, f"dp_rank_00_tp_rank_{t:02d}_pp_rank_00.pt"))
-    with pytest.raises(Exception):
+    import pickle
+
+    with pytest.raises(pickle.UnpicklingError):
         load_nxd_checkpoint(mdir, LLAMA_TP_RULES)
     # explicit opt-in loads it (replicated across ranks, no TP rule needed)
     state = load_nxd_checkpoint(mdir, LLAMA_TP_RULES, allow_pickle=True)
