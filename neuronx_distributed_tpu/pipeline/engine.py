@@ -103,7 +103,14 @@ def _make_stage_fn(blk, layer_mask, block_aux: bool = False, act_spec: Optional[
 
     ``block_aux``: the block returns ``(y, aux_scalar)`` (e.g. a MoE
     load-balancing term) and ``aux`` is the sum over the stage's live
-    layers; otherwise ``aux`` is a constant 0 (folded away by XLA)."""
+    layers; otherwise ``aux`` is a constant 0 (folded away by XLA).
+
+    The masked ``stage_fn`` also accepts an optional ``mask_local``
+    argument overriding the rank-sliced constant — the interleaved engine
+    passes its own (rank, chunk)-sliced mask (rows ``rank*(V*per) +
+    v*per``), which the contiguous ``rank*L_local`` slicing here cannot
+    express; pass ``layer_mask="arg"`` to build that form with no
+    constant."""
 
     cact = _make_cact(act_spec)
 
@@ -125,15 +132,19 @@ def _make_stage_fn(blk, layer_mask, block_aux: bool = False, act_spec: Optional[
 
         return stage_fn
 
-    mask_const = jnp.asarray(layer_mask, jnp.float32)
+    mask_const = (None if isinstance(layer_mask, str)  # "arg": caller-supplied
+                  else jnp.asarray(layer_mask, jnp.float32))
 
-    def stage_fn(stage_params, x, extras=()):
-        L_local = jax.tree.leaves(stage_params)[0].shape[0]
-        if mask_const.shape[0] == L_local:
-            local = mask_const  # pp == 1: the whole stack is local
+    def stage_fn(stage_params, x, extras=(), mask_local=None):
+        if mask_local is not None:
+            local = mask_local
         else:
-            rank = lax.axis_index(PIPELINE_AXIS)
-            local = lax.dynamic_slice_in_dim(mask_const, rank * L_local, L_local)
+            L_local = jax.tree.leaves(stage_params)[0].shape[0]
+            if mask_const.shape[0] == L_local:
+                local = mask_const  # pp == 1: the whole stack is local
+            else:
+                rank = lax.axis_index(PIPELINE_AXIS)
+                local = lax.dynamic_slice_in_dim(mask_const, rank * L_local, L_local)
 
         def body(carry, xs):
             h, aux = carry
@@ -150,6 +161,7 @@ def _make_stage_fn(blk, layer_mask, block_aux: bool = False, act_spec: Optional[
         return x, aux
 
     return stage_fn
+
 
 BlockFn = Callable[[Any, jax.Array], jax.Array]
 EmbedFn = Callable[[Any, jax.Array], jax.Array]
@@ -748,27 +760,6 @@ def _chunk_params(stack, v, chunk_rows: int):
     )
 
 
-def interleaved_row_of_layer(num_layers: int, pp: int, num_chunks: int):
-    """Stack row of each model layer under the interleaved (virtual-stage)
-    layout: virtual stage ``s = v*P + r`` (Megatron interleaved assignment)
-    holds model layers ``[s*Lc, (s+1)*Lc)`` as rank ``r``'s chunk ``v`` —
-    i.e. stack row ``r*(V*Lc) + v*Lc + i`` (the pp sharding stays a plain
-    contiguous row split; only the row→layer meaning changes, recorded in
-    ``PipelinedModel.layer_rows`` for checkpoint/HF converters)."""
-    if num_layers % (pp * num_chunks) != 0:
-        raise ValueError(
-            f"interleaved pipeline needs num_layers ({num_layers}) divisible "
-            f"by pp*num_chunks ({pp}*{num_chunks})"
-        )
-    Lc = num_layers // (pp * num_chunks)
-    rows = [0] * num_layers
-    for s in range(pp * num_chunks):
-        v, r = divmod(s, pp)
-        for i in range(Lc):
-            rows[s * Lc + i] = r * (num_chunks * Lc) + v * Lc + i
-    return rows
-
-
 def make_interleaved_1f1b_loss_and_grad_fn(
     embed_fn: EmbedFn,
     block_fn: BlockFn,
@@ -781,6 +772,7 @@ def make_interleaved_1f1b_loss_and_grad_fn(
     act_spec: Optional[P] = None,
     block_aux: bool = False,
     layer_specs: Any = None,
+    layer_mask=None,
 ):
     """Interleaved (virtual-stage) synchronous 1F1B — ``V = num_chunks``
     model chunks per pp rank (virtual stage ``s = v*P + r``), in one jit.
@@ -809,9 +801,12 @@ def make_interleaved_1f1b_loss_and_grad_fn(
     arithmetic; peak stash is ``stash_size`` microbatch activations per
     rank (~2(P-1)·V·(V+1)/(2V) — interleaving's known activation premium).
 
-    Constraints: ``M % P == 0`` (Megatron group structure), layer count
-    divisible by ``P*V``, no ``pipeline_cuts``/padded rows (the chunk
-    slicing assumes a uniform stack; use V=1 for those).
+    Composition (both restrictions lifted, VERDICT r4 #3): any ``M``
+    (ragged microbatch counts are ghost-padded inside the table builder and
+    masked out), and ``layer_mask`` marks padded rows from uneven
+    virtual-stage spans (``partition.interleaved_layout_from_spans`` — the
+    interleaved realization of ``pipeline_cuts``); the stacked layer count
+    must still be ``P*V*per`` for a uniform chunk width ``per``.
     """
     mesh = mesh if mesh is not None else get_mesh()
     pp = mesh.shape[PIPELINE_AXIS]
@@ -820,7 +815,13 @@ def make_interleaved_1f1b_loss_and_grad_fn(
     blk = block_fn
     if remat_block:
         blk = jax.checkpoint(block_fn, policy=remat_policy, prevent_cse=False)
-    stage_fn = _make_stage_fn(blk, None, block_aux, act_spec)
+    if layer_mask is None:
+        stage_fn = _make_stage_fn(blk, None, block_aux, act_spec)
+        mask_const = None
+    else:
+        stage_fn = _make_stage_fn(blk, "arg", block_aux, act_spec)
+        mask_const = jnp.asarray(layer_mask, jnp.float32)
+    n_real_layers = int(sum(layer_mask)) if layer_mask is not None else None
 
     if pp == 1:
         raise ValueError(
@@ -879,7 +880,8 @@ def make_interleaved_1f1b_loss_and_grad_fn(
             tok_total = lax.psum(
                 jnp.sum((labels_mb >= 0).astype(jnp.float32)), (DATA_AXIS, EXPERT_AXIS)
             )
-            aux_w = tok_total / (L * M * dpsz)
+            L_real = n_real_layers if n_real_layers is not None else L
+            aux_w = tok_total / (L_real * M * dpsz)
 
             mb_shape = ids_mb.shape[1:]
             probe = jax.eval_shape(
@@ -889,6 +891,17 @@ def make_interleaved_1f1b_loss_and_grad_fn(
             cact = _make_cact(act_spec)
 
             my = {k: jnp.take(jnp.asarray(a), rank, axis=0) for k, a in cols.items()}
+
+            if mask_const is not None:
+                local_mask = lax.dynamic_slice_in_dim(
+                    mask_const, rank * (V * Lc), V * Lc, 0)
+
+                def run_stage(stack, v, x, ex):
+                    cm = lax.dynamic_slice_in_dim(local_mask, v * Lc, Lc, 0)
+                    return stage_fn(_chunk_params(stack, v, Lc), x, ex, cm)
+            else:
+                def run_stage(stack, v, x, ex):
+                    return stage_fn(_chunk_params(stack, v, Lc), x, ex)
 
             def masked_add(acc, delta, flag):
                 return jax.tree.map(
@@ -919,7 +932,7 @@ def make_interleaved_1f1b_loss_and_grad_fn(
                     lax.dynamic_index_in_dim(e, jnp.maximum(mf, 0), 0, keepdims=False)
                     for e in extras_mb
                 )
-                y, _ = stage_fn(_chunk_params(layer_stack, vf_c, Lc), x_in, ex_f)
+                y, _ = run_stage(layer_stack, vf_c, x_in, ex_f)
                 return stash, cact(y)
 
             def bwd_part(carry_grads, stash, gstash, xs):
@@ -948,7 +961,7 @@ def make_interleaved_1f1b_loss_and_grad_fn(
                     # same pp-uniform-cond argument as the V=1 engine; the
                     # predicate additionally varies by tick, which every
                     # member of an auto-axis collective channel shares.
-                    yy, aux = stage_fn(_chunk_params(lp_full, vb_c, Lc), xx, ex_b)
+                    yy, aux = run_stage(lp_full, vb_c, xx, ex_b)
                     ls, n = lax.cond(
                         owns_head,
                         lambda hp_, yy_: tuple(
@@ -1074,12 +1087,14 @@ def make_interleaved_fwd_fn(
     act_spec: Optional[P] = None,
     block_aux: bool = False,
     layer_specs: Any = None,
+    layer_mask=None,
 ):
     """Forward-only interleaved pipeline: ``fn(params, ids, *extras) ->
     (hidden [B, ...], aux_sum)`` with the last virtual stage's outputs
     regathered to the global batch.  Differentiable — serves as the loss
     oracle (autodiff backward) and the inference path of the interleaved
-    engine."""
+    engine.  ``layer_mask`` as in
+    :func:`make_interleaved_1f1b_loss_and_grad_fn`."""
     mesh = mesh if mesh is not None else get_mesh()
     pp = mesh.shape[PIPELINE_AXIS]
     M, V = num_microbatches, num_chunks
@@ -1087,7 +1102,12 @@ def make_interleaved_fwd_fn(
     blk = block_fn
     if remat_block:
         blk = jax.checkpoint(block_fn, policy=remat_policy, prevent_cse=False)
-    stage_fn = _make_stage_fn(blk, None, block_aux, act_spec)
+    if layer_mask is None:
+        stage_fn = _make_stage_fn(blk, None, block_aux, act_spec)
+        mask_const = None
+    else:
+        stage_fn = _make_stage_fn(blk, "arg", block_aux, act_spec)
+        mask_const = jnp.asarray(layer_mask, jnp.float32)
 
     from neuronx_distributed_tpu.pipeline.scheduler import (
         build_interleaved_fwd_tables,
@@ -1121,6 +1141,17 @@ def make_interleaved_fwd_fn(
             cact = _make_cact(act_spec)
             my = {k: jnp.take(jnp.asarray(a), rank, axis=0) for k, a in cols.items()}
 
+            if mask_const is not None:
+                local_mask = lax.dynamic_slice_in_dim(
+                    mask_const, rank * (V * Lc), V * Lc, 0)
+
+                def run_stage(stack, v, x, ex):
+                    cm = lax.dynamic_slice_in_dim(local_mask, v * Lc, Lc, 0)
+                    return stage_fn(_chunk_params(stack, v, Lc), x, ex, cm)
+            else:
+                def run_stage(stack, v, x, ex):
+                    return stage_fn(_chunk_params(stack, v, Lc), x, ex)
+
             def tick(carry, xs):
                 stash, outs, aux_sum = carry
                 mf, vf, fs = xs["fm"], xs["fc"], xs["fs"]
@@ -1143,7 +1174,7 @@ def make_interleaved_fwd_fn(
                     lax.dynamic_index_in_dim(e, jnp.maximum(mf, 0), 0, keepdims=False)
                     for e in extras_mb
                 )
-                y, aux = stage_fn(_chunk_params(layer_stack, vf_c, Lc), x_in, ex_f)
+                y, aux = run_stage(layer_stack, vf_c, x_in, ex_f)
                 y = cact(y)
                 aux_sum = aux_sum + jnp.where(do_f, aux, 0.0)
                 # collect the LAST virtual stage's output for its microbatch
@@ -1265,16 +1296,35 @@ def build_pipelined_model(
     mesh = mesh if mesh is not None else get_mesh()
     pp = mesh.shape[PIPELINE_AXIS]
     if schedule == "interleaved":
-        if pipeline_cuts is not None:
-            raise ValueError(
-                "schedule='interleaved' does not compose with pipeline_cuts "
-                "(chunk slicing assumes a uniform stack); use schedule='1f1b'"
-            )
         if pp > 1:
-            padded_layers = num_layers
-            row_of_layer = interleaved_row_of_layer(num_layers, pp, num_chunks)
-            layer_mask = None
+            from neuronx_distributed_tpu.pipeline.partition import (
+                interleaved_layout_from_spans,
+                partition_uniform,
+                spans_from_cuts,
+            )
+
+            S = pp * num_chunks
+            if pipeline_cuts is not None:
+                # cuts define VIRTUAL-stage boundaries under interleaving
+                # (P*V spans in execution order) — the interleaved
+                # realization of the reference's rebalancing tool
+                spans = spans_from_cuts(pipeline_cuts, num_layers)
+                if len(spans) != S:
+                    raise ValueError(
+                        f"interleaved pipeline_cuts must define "
+                        f"pp*num_chunks = {S} virtual-stage spans "
+                        f"({S - 1} cuts); got {len(spans)} spans"
+                    )
+            else:
+                spans = partition_uniform(num_layers, S)
+            padded_layers, row_of_layer, layer_mask = (
+                interleaved_layout_from_spans(spans, pp, num_chunks))
+            if all(m == 1 for m in layer_mask):
+                layer_mask = None  # uniform divisible spans: no padding
         else:
+            if pipeline_cuts is not None:
+                raise ValueError(
+                    "pipeline_cuts with pp == 1 has nothing to cut")
             padded_layers, row_of_layer, layer_mask = (
                 num_layers, list(range(num_layers)), None)
     elif pipeline_cuts is not None:
@@ -1369,6 +1419,7 @@ def build_pipelined_model(
             embed_fn, block_fn, num_microbatches, num_chunks, mesh=mesh,
             remat_block=remat_block, remat_policy=remat_policy,
             act_spec=act_spec, block_aux=block_aux, layer_specs=layer_specs,
+            layer_mask=layer_mask,
         )
         dpsz = mesh.shape[DATA_AXIS] * mesh.shape[EXPERT_AXIS]
 
@@ -1392,6 +1443,7 @@ def build_pipelined_model(
             embed_fn, block_fn, head_loss_fn, num_microbatches, num_chunks,
             mesh=mesh, remat_block=remat_block, remat_policy=remat_policy,
             act_spec=act_spec, block_aux=block_aux, layer_specs=layer_specs,
+            layer_mask=layer_mask,
         )
         return _finalize_pipelined_model(
             params, specs, mesh, num_microbatches, loss_fn, forward_fn,

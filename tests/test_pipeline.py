@@ -432,6 +432,57 @@ def test_interleaved_matches_dense_and_autodiff(devices8, pp, tp, num_mb, V, sp,
         )
 
 
+@pytest.mark.parametrize("num_mb,V,cuts,layers", [
+    (4, 2, (1, 3, 5), 6),    # uneven virtual-stage spans (1,2,2,1) via cuts
+    (3, 2, None, 6),         # ragged M (3 % pp != 0) + non-divisible layers
+    (3, 2, (1, 3, 5), 6),    # both at once
+], ids=["cuts", "ragged-M", "cuts+ragged-M"])
+def test_interleaved_with_cuts_matches_dense(devices8, num_mb, V, cuts, layers):
+    """Interleaved PP composed with pipeline_cuts (uneven virtual-stage
+    spans, padded+masked rows) and with ragged microbatch counts
+    (ghost-padded tick tables) must stay loss- and gradient-exact vs the
+    dense oracle (VERDICT r4 next-step #3: composition-complete)."""
+    pp = tp = 2
+    nxd.initialize_model_parallel(
+        tensor_parallel_size=tp, pipeline_parallel_size=pp, devices=devices8)
+    cfg = LlamaConfig.tiny(
+        num_layers=layers, num_heads=8, num_kv_heads=8, sequence_parallel=False,
+        remat="none", dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16,
+    )
+    pmodel = build_pipelined_llama(
+        cfg, num_microbatches=num_mb, seed=3, schedule="interleaved",
+        num_chunks=V, pipeline_cuts=cuts)
+    dp = 8 // (pp * tp)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (num_mb * dp, 16), 0, cfg.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+
+    (ls, tok), grads = jax.jit(pmodel.loss_and_grad_fn)(pmodel.params, ids, labels)
+
+    dense = LlamaForCausalLM(cfg)
+    dparams = _dense_params_from_pipelined(pmodel, cfg)
+    from neuronx_distributed_tpu.models.llama import causal_lm_loss
+
+    dense_loss = float(jax.jit(
+        lambda p: causal_lm_loss(dense, p, {"ids": ids, "labels": labels})
+    )(dparams))
+    assert float(ls) / float(tok) == pytest.approx(dense_loss, rel=2e-4)
+
+    (ls2, tok2), g2 = jax.jit(
+        lambda p, i, l: jax.value_and_grad(pmodel.loss_fn, has_aux=True)(p, i, l)
+    )(pmodel.params, ids, labels)
+    assert float(ls) == pytest.approx(float(ls2), rel=1e-5)
+    assert float(tok) == float(tok2)
+    for (k1, a), (k2, b) in zip(
+        jax.tree_util.tree_flatten_with_path(grads)[0],
+        jax.tree_util.tree_flatten_with_path(g2)[0],
+    ):
+        assert k1 == k2
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(k1),
+        )
+
+
 def test_interleaved_forward_matches_dense(devices8):
     cfg, pp, tp, num_mb, V = None, 2, 2, 4, 2
     nxd.initialize_model_parallel(
@@ -469,15 +520,47 @@ def test_interleaved_bubble_below_sync_1f1b():
 
 
 def test_interleaved_rejects_bad_configs():
-    from neuronx_distributed_tpu.pipeline.engine import interleaved_row_of_layer
     from neuronx_distributed_tpu.pipeline.scheduler import (
         build_interleaved_sync_tables,
     )
 
-    with pytest.raises(ValueError, match="divisible"):
-        interleaved_row_of_layer(6, 2, 2)  # 6 layers, pp*V = 4
-    with pytest.raises(ValueError, match="divisible"):
-        build_interleaved_sync_tables(3, 2, 2)  # M % P != 0
+    with pytest.raises(ValueError, match="num_chunks"):
+        build_interleaved_sync_tables(4, 2, 0)
+    with pytest.raises(ValueError, match="num_microbatches"):
+        build_interleaved_sync_tables(0, 2, 2)
+
+
+def test_interleaved_ragged_m_tables_complete():
+    """M need not divide P (VERDICT r4 #3): ghost-padded tables still
+    compute every real (virtual stage, microbatch) pair exactly once, in
+    dependency order, with ghost-only ticks compacted away."""
+    from neuronx_distributed_tpu.pipeline.scheduler import (
+        build_interleaved_sync_tables,
+    )
+
+    for (M, P, V) in [(3, 2, 2), (5, 4, 2), (1, 2, 2)]:
+        tb = build_interleaved_sync_tables(M, P, V)
+        S = P * V
+        ft, bt = {}, {}
+        for r in range(P):
+            for t in range(tb.num_slots):
+                if tb.fwd_mb[r][t] >= 0:
+                    ft[(tb.fwd_chunk[r][t] * P + r, tb.fwd_mb[r][t])] = t
+                if tb.bwd_mb[r][t] >= 0:
+                    bt[(tb.bwd_chunk[r][t] * P + r, tb.bwd_mb[r][t])] = t
+        want = {(s, m) for s in range(S) for m in range(M)}
+        assert set(ft) == want and set(bt) == want
+        for (s, m), t in ft.items():
+            if s > 0:
+                assert ft[(s - 1, m)] < t
+        for (s, m), t in bt.items():
+            assert ft[(s, m)] <= t
+            if s < S - 1:
+                assert bt[(s + 1, m)] < t
+        # no ghost-only ticks survive compaction
+        for t in range(tb.num_slots):
+            assert any(tb.fwd_mb[r][t] >= 0 or tb.bwd_mb[r][t] >= 0
+                       for r in range(P))
 
 
 def test_interleaved_via_trainer_config(devices8):
