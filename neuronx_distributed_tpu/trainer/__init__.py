@@ -6,7 +6,7 @@ from neuronx_distributed_tpu.trainer.checkpoint import (
     newest_tag,
     save_checkpoint,
 )
-from neuronx_distributed_tpu.trainer.fit import FitResult, fit
+from neuronx_distributed_tpu.trainer.fit import Callback, FitResult, fit
 from neuronx_distributed_tpu.trainer.metrics import (
     Throughput,
     TrainingMetrics,
@@ -27,6 +27,7 @@ from neuronx_distributed_tpu.trainer.trainer import (
 __all__ = [
     "fit",
     "FitResult",
+    "Callback",
     "ParallelModel",
     "ParallelOptimizer",
     "initialize_parallel_model",

@@ -120,12 +120,27 @@ def save_checkpoint(
     user_content: Any = None,
     num_kept_ckpts: Optional[int] = None,
     async_save: bool = False,
+    save_dtype: Any = None,
 ) -> str:
     """Save a tagged checkpoint (reference ``save_checkpoint``,
     ``trainer/checkpoint.py:85-199``).  With ``async_save`` the call returns
     after device arrays are snapshotted; durability is guaranteed only after
-    :func:`wait_for_checkpoint` (implicitly invoked by the next save)."""
+    :func:`wait_for_checkpoint` (implicitly invoked by the next save).
+
+    ``save_dtype`` (e.g. ``jnp.bfloat16``) downcasts the MODEL state's
+    floating leaves on the way to disk — half-size checkpoints, the
+    reference's ``down_cast_bf16`` option
+    (``parallel_layers/checkpointing.py:55,92``).  The optimizer state
+    (fp32 masters/moments) is never downcast — that would defeat mixed-
+    precision training; :func:`load_checkpoint` restores leaves at the
+    template's dtype, so an fp32 template upcasts the stored bf16 values
+    (precision truncated once at save, as with the reference)."""
     wait_for_checkpoint()  # at most one in-flight async save
+
+    if save_dtype is not None:
+        from neuronx_distributed_tpu.utils.dtypes import cast_floating
+
+        model_state = cast_floating(model_state, save_dtype)
 
     path = _tag_dir(ckpt_dir, tag)
     if _is_primary():

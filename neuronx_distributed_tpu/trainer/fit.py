@@ -63,6 +63,41 @@ class FitResult:
     eval_history: list  # [(step, eval_loss)]
 
 
+class Callback:
+    """Extension hooks for :func:`fit` — observe every cadence event
+    without forking the loop (the reference's Lightning layer offers the
+    same through ``NeuronLTModule``'s hook overrides,
+    ``lightning/module.py:138-309``; here it is a plain object, no
+    framework).
+
+    Subclass and override any subset; all hooks default to no-ops.  Hooks
+    receive plain Python data (step numbers, metric dicts with host floats
+    for ``loss``/``grad_norm``/``seq_per_sec``; other entries may still be
+    device scalars — convert with ``float()`` only if needed, each
+    conversion is a device sync).  Setting ``self.should_stop = True``
+    inside any hook ends the loop after the current step (early stopping);
+    the final checkpoint and summary metrics are still written for the
+    steps actually run."""
+
+    should_stop: bool = False
+
+    def on_fit_start(self, step: int, params: Any, opt_state: Any) -> None:
+        """Called once before the first step; ``step`` is the resume
+        start step (0 for a fresh run)."""
+
+    def on_step(self, step: int, metrics: dict) -> None:
+        """Called after every optimizer step with the step metrics."""
+
+    def on_eval(self, step: int, metrics: dict) -> None:
+        """Called after each eval-cadence evaluation (``eval_loss`` key)."""
+
+    def on_checkpoint(self, step: int, path: str) -> None:
+        """Called after each checkpoint save (cadence and final)."""
+
+    def on_fit_end(self, result: "FitResult") -> None:
+        """Called once with the final :class:`FitResult`."""
+
+
 def fit(
     config: TrainingConfig,
     model: Any,
@@ -80,6 +115,7 @@ def fit(
     keep_ckpts: int = 3,
     resume: bool = False,
     async_save: bool = True,
+    ckpt_save_dtype: Optional[Any] = None,
     log_every: int = 10,
     scalar_dir: Optional[str] = None,
     metrics: Optional[Any] = None,
@@ -88,6 +124,7 @@ def fit(
     peak_flops: Optional[float] = None,
     step_rng: bool = False,
     on_step: Optional[Callable[[int, dict], None]] = None,
+    callbacks: "tuple[Callback, ...] | list" = (),
 ) -> FitResult:
     """Run the training loop: steps, eval cadence, checkpoint cadence with
     resume, scalar/throughput logging.
@@ -105,12 +142,18 @@ def fit(
         ``resume=True`` restores the newest tag's params/opt state and
         continues from its recorded step.  A final checkpoint is always
         written when ``ckpt_dir`` is set.
+      ckpt_save_dtype: e.g. ``jnp.bfloat16`` — downcast the MODEL payload
+        on save (half-size checkpoints; optimizer masters stay fp32).
       metrics: a ``TrainingMetrics`` to fill with final summary numbers.
       timeline: a ``utils.Timeline`` for per-step host events.
       flops_per_token / peak_flops: enable the MFU summary metric.
       step_rng: pass a per-step PRNG key to the train step (dropout models);
         default None keeps deterministic-eval semantics.
-      on_step: callback ``(step, metrics_dict)`` after every step.
+      on_step: shorthand callback ``(step, metrics_dict)`` after every step
+        (equivalent to a :class:`Callback` overriding only ``on_step``).
+      callbacks: :class:`Callback` instances receiving every cadence event
+        (fit start/end, step, eval, checkpoint); any callback setting
+        ``should_stop`` ends the loop after the current step.
     """
     step_fn = make_train_step(
         config, model, optimizer, loss_fn, batch_spec=batch_spec,
@@ -149,6 +192,17 @@ def fit(
     loss = float("nan")
     rng0 = jax.random.PRNGKey(config.seed)
 
+    cbs = list(callbacks)
+    if on_step is not None:
+        legacy = Callback()
+        legacy.on_step = on_step  # type: ignore[method-assign]
+        cbs.append(legacy)
+    for cb in cbs:
+        cb.should_stop = False  # instances are reusable across fit() calls
+        cb.on_fit_start(start_step, params, opt_state)
+
+    final_step = steps
+    last_saved_step = -1
     for step in range(start_step, steps):
         batch = next_batch(step)
         if thr is None:
@@ -169,29 +223,43 @@ def fit(
             params, opt_state, m = step_fn(params, opt_state, batch, rng)
             loss = float(m["loss"])
         seqs = thr.step()
+        grad_norm = float(m["grad_norm"])
         if scalars:
-            scalars.scalars(step, loss=loss, grad_norm=float(m["grad_norm"]),
+            scalars.scalars(step, loss=loss, grad_norm=grad_norm,
                             seq_per_sec=seqs)
-        if on_step is not None:
-            on_step(step, m)
+        step_metrics = dict(m)
+        step_metrics.update(loss=loss, grad_norm=grad_norm, seq_per_sec=seqs)
+        for cb in cbs:
+            cb.on_step(step, step_metrics)
         if log_every and (step % log_every == 0 or step == steps - 1):
             # stdout JSON lines — the launcher-harness contract the example
             # scripts (and their tests) have always exposed
             print(json.dumps({
                 "step": step, "loss": round(loss, 4),
                 "seq_per_sec": round(seqs, 2),
-                "grad_norm": round(float(m["grad_norm"]), 4),
+                "grad_norm": round(grad_norm, 4),
             }), flush=True)
         if eval_fn is not None and (step + 1) % eval_every == 0:
             ev = eval_fn(params, eval_data(step))
-            eval_history.append((step + 1, float(ev["loss"])))
+            eval_loss = float(ev["loss"])
+            eval_history.append((step + 1, eval_loss))
             if scalars:
-                scalars.scalars(step, eval_loss=float(ev["loss"]))
+                scalars.scalars(step, eval_loss=eval_loss)
+            for cb in cbs:
+                cb.on_eval(step + 1, {"eval_loss": eval_loss})
         if ckpt_dir and ckpt_every and (step + 1) % ckpt_every == 0 \
                 and step + 1 < steps:
-            save_checkpoint(ckpt_dir, f"step_{step + 1}", params, opt_state,
-                            user_content={"step": step + 1},
-                            num_kept_ckpts=keep_ckpts, async_save=async_save)
+            path = save_checkpoint(ckpt_dir, f"step_{step + 1}", params, opt_state,
+                                   user_content={"step": step + 1},
+                                   num_kept_ckpts=keep_ckpts, async_save=async_save,
+                                   save_dtype=ckpt_save_dtype)
+            last_saved_step = step + 1
+            for cb in cbs:
+                cb.on_checkpoint(step + 1, path)
+        if any(cb.should_stop for cb in cbs):
+            final_step = step + 1
+            logger.info("callback requested stop after step %d", final_step)
+            break
 
     ran_any = start_step < steps
     if not ran_any:
@@ -199,16 +267,25 @@ def fit(
         # existing final checkpoint and metrics file stay authoritative
         logger.info("resume step %d >= steps %d: nothing to do", start_step, steps)
     if ckpt_dir and ran_any:
-        save_checkpoint(ckpt_dir, f"step_{steps}", params, opt_state,
-                        user_content={"step": steps}, num_kept_ckpts=keep_ckpts)
-        wait_for_checkpoint()
+        if last_saved_step != final_step:
+            # skip when an early stop landed exactly on a cadence save — a
+            # rewrite would rmtree the just-written tag and double-notify
+            path = save_checkpoint(ckpt_dir, f"step_{final_step}", params, opt_state,
+                                   user_content={"step": final_step},
+                                   num_kept_ckpts=keep_ckpts,
+                                   save_dtype=ckpt_save_dtype)
+            wait_for_checkpoint()
+            for cb in cbs:
+                cb.on_checkpoint(final_step, path)
+        else:
+            wait_for_checkpoint()  # cadence save may be async: make it durable
     if scalars:
         scalars.close()
     if metrics is not None and ran_any:
         summary = {
             "final_loss": loss,
             "steps": steps,
-            "completed_steps": steps,
+            "completed_steps": final_step,
             "resumed_from_step": start_step,
             "peak_seq_per_sec": thr.peak if thr else 0.0,
         }
@@ -220,12 +297,15 @@ def fit(
         metrics.update(**summary)
         metrics.write()
 
-    return FitResult(
+    result = FitResult(
         params=params,
         opt_state=opt_state,
         final_loss=loss,
-        steps_run=max(0, steps - start_step),
+        steps_run=max(0, final_step - start_step),
         start_step=start_step,
         peak_seq_per_sec=thr.peak if thr else 0.0,
         eval_history=eval_history,
     )
+    for cb in cbs:
+        cb.on_fit_end(result)
+    return result

@@ -1,0 +1,59 @@
+"""Dtype policy helpers: tree casting and runtime dtype audit.
+
+TPU-native counterpart of the reference's autocast/cast-verification
+utilities (``parallel_layers/utils.py:143-170`` ``cast_all``/``cast_tensor``
+and ``:207-222`` ``verify_casted_dtypes_of_module``): this framework states
+dtype policy explicitly (``param_dtype``/``compute_dtype`` in the config)
+rather than monkey-patching autocast, so what remains useful is (a) a
+floating-only tree cast — used by checkpoint bf16-downcast-on-save — and
+(b) an audit that reports any floating leaf whose dtype disagrees with the
+declared policy, for catching silently-upcast parameters before they double
+the HBM bill.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast every floating-point leaf to ``dtype``; integer/bool leaves
+    (token ids, step counters, RNG keys) pass through untouched."""
+    dtype = jnp.dtype(dtype)
+
+    def one(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(one, tree)
+
+
+def audit_dtypes(
+    tree: Any, expected: Any, *, raise_on_mismatch: bool = False
+) -> List[Tuple[str, Any]]:
+    """Report floating leaves whose dtype differs from ``expected``.
+
+    Returns ``[(path, actual_dtype), ...]`` (empty = clean).  With
+    ``raise_on_mismatch`` a non-empty report raises ``TypeError`` listing
+    the offenders — the fail-fast form of the reference's
+    ``verify_casted_dtypes_of_module`` (``parallel_layers/utils.py:207-222``).
+    Non-floating leaves are never audited (an int32 token table is not a
+    policy violation)."""
+    expected = jnp.dtype(expected)
+    bad: List[Tuple[str, Any]] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            if leaf.dtype != expected:
+                bad.append((jax.tree_util.keystr(path), leaf.dtype))
+    if bad and raise_on_mismatch:
+        listing = ", ".join(f"{p}: {d}" for p, d in bad[:10])
+        more = f" (+{len(bad) - 10} more)" if len(bad) > 10 else ""
+        raise TypeError(
+            f"dtype audit: {len(bad)} floating leaves are not {expected}: "
+            f"{listing}{more}"
+        )
+    return bad
