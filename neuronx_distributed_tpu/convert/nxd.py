@@ -34,6 +34,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
 # (regex, (partition_dim, stride)) — first match wins.  Weight layouts are
 # torch [out_features, in_features]: column-parallel shards dim 0,
 # row-parallel shards dim 1.
@@ -42,6 +46,7 @@ LLAMA_TP_RULES: Sequence[Tuple[str, Tuple[int, int]]] = (
     (r"\.gate_up_proj\.weight$", (0, 2)),   # fused gate/up, stride 2
     (r"\.(q_proj|k_proj|v_proj)\.weight$", (0, 1)),
     (r"\.(weight_q|weight_k|weight_v)$", (0, 1)),  # GQA qkv module
+    (r"\.(bias_q|bias_k|bias_v)$", (0, 1)),  # GQA qkv biases (Qwen2-style)
     (r"\.gate_proj\.weight$", (0, 1)),
     (r"\.up_proj\.weight$", (0, 1)),
     (r"\.o_proj\.weight$", (1, 1)),
@@ -115,6 +120,7 @@ def load_nxd_checkpoint(
     extra_rules: Optional[Sequence[Tuple[str, Tuple[int, int]]]] = None,
     allow_pickle: bool = False,
     allow_replicated_kv: bool = False,
+    kv_size_multiplier: Optional[int] = None,
 ) -> Dict[str, np.ndarray]:
     """Read a reference per-rank model checkpoint directory into one full
     numpy state dict (original param names).
@@ -129,11 +135,16 @@ def load_nxd_checkpoint(
     checkpoint genuinely needs full pickle, pass ``allow_pickle=True`` and
     accept that a malicious file can then execute arbitrary code.
 
-    GQA ``weight_k``/``weight_v`` shards that are bit-identical across any
-    pair of tp ranks are rejected (they indicate the reference's
-    ``kv_size_multiplier > 1`` replication, which the ``(0, 1)`` rule
-    cannot invert); ``allow_replicated_kv=True`` skips that check for
-    checkpoints with genuinely identical shards (e.g. constant init)."""
+    GQA ``weight_k``/``weight_v``/``bias_k``/``bias_v`` entries saved with
+    the reference's ``kv_size_multiplier > 1`` replication (detected by
+    bit-identical tp shards) are inverted automatically — the replication
+    tiles the master KV block, so the merge is a clean tiling whose first
+    slice is the original (see :func:`_strip_kv_replication` for the
+    inference rules and its one undecidable corner).  Pass
+    ``kv_size_multiplier=`` to pin the factor explicitly (required for
+    ambiguous tensors, e.g. constant-init biases); duplicates with no
+    clean tiling raise; ``allow_replicated_kv=True`` skips the inversion
+    and keeps the raw merge."""
     import torch  # CPU-only usage
 
     rules = tuple(extra_rules or ()) + tuple(tp_rules)
@@ -178,47 +189,107 @@ def load_nxd_checkpoint(
                 full[name] = shards[0]
             else:
                 dim, stride = ds
+                merged = merge_tp_shards(shards, dim, stride)
                 if (not allow_replicated_kv
-                        and re.search(r"\.(weight_k|weight_v)$", name)):
-                    _check_kv_not_replicated(name, shards)
-                full[name] = merge_tp_shards(shards, dim, stride)
+                        and re.search(r"\.(weight_k|weight_v|bias_k|bias_v)$",
+                                      name)
+                        and _has_duplicate_shards(shards)):
+                    merged = _strip_kv_replication(
+                        name, merged, tp=len(shards),
+                        multiplier=kv_size_multiplier)
+                full[name] = merged
     return full
 
 
-def _check_kv_not_replicated(name: str, shards: List[np.ndarray]) -> None:
-    """Refuse GQA KV shards saved with replication.
-
-    The reference's ``GQAQKVColumnParallelLinear`` with
-    ``kv_size_multiplier > 1`` replicates each KV head across a shared
-    group of TP ranks (``parallel_layers/layers.py`` KV-replication path),
-    so the per-rank ``weight_k``/``weight_v`` files hold duplicate copies.
-    Concatenating them with the plain ``(0, 1)`` rule would yield an
-    oversized, wrongly-ordered tensor with no error.  Replicated groups are
-    bit-identical by construction, so any pair of identical tp shards here
-    means the checkpoint used replication — raise with guidance instead of
-    silently corrupting the merge."""
+def _has_duplicate_shards(shards: List[np.ndarray]) -> bool:
+    """Any pair of bit-identical tp shards?  One byte-level digest per
+    shard (O(tp), not O(tp^2) full compares); replicas are bit-copies, so
+    digest equality catches them even when the values include NaNs (where
+    elementwise ``==`` would miss)."""
     import hashlib
 
-    # One byte-level digest per shard (O(tp), not O(tp^2) full compares);
-    # replicas are bit-copies, so digest equality catches them even when
-    # the values include NaNs (where elementwise == would miss).
-    seen: Dict[str, int] = {}
-    for i, s in enumerate(shards):
+    seen = set()
+    for s in shards:
         digest = hashlib.sha256(
             repr((s.shape, s.dtype.str)).encode() + s.tobytes()).hexdigest()
         if digest in seen:
+            return True
+        seen.add(digest)
+    return False
+
+
+def _strip_kv_replication(
+    name: str, merged: np.ndarray, tp: int, multiplier: Optional[int] = None,
+) -> np.ndarray:
+    """Invert the reference's GQA KV replication.
+
+    ``GQAQKVColumnParallelLinear`` with ``kv_size_multiplier = m`` tiles
+    the whole master KV weight m times along dim 0 —
+    ``master_weight.repeat(m, 1)``, ``modules/qkv_linear.py:110-115`` (and
+    ``master_bias.repeat(m)`` for biases, ``:500-502``) — before the
+    standard contiguous chunk shard.  The plain ``(0, 1)`` merge therefore
+    reconstructs the TILED matrix exactly, and the original is its first
+    ``rows/m`` slice.
+
+    ``m`` is not stored in the files.  With ``multiplier`` given, exactly
+    that factor is verified and stripped.  Otherwise it is inferred as the
+    largest divisor of ``tp`` whose tiling relation holds bit-exactly
+    (the reference asserts ``tp % kv_size_multiplier == 0``,
+    ``modules/qkv_linear.py:417``): for a non-repetitive master this is
+    provably the unique factor whose base does not itself tile.  The
+    inference refuses the detectable degenerate case — a recovered base
+    that still tiles (constant-init values) — by raising for the explicit
+    ``kv_size_multiplier``.  One corner is byte-level indistinguishable
+    and therefore documented rather than detected: a master that itself
+    repeats KV head blocks bit-exactly (e.g. a freshly MHA→GQA-upcycled,
+    untrained checkpoint) looks identical to a larger multiplier over the
+    deduplicated block — pass ``kv_size_multiplier=`` explicitly there."""
+
+    def tiles_as(arr, m):
+        if arr.shape[0] % m != 0:
+            return False
+        base = arr[: arr.shape[0] // m]
+        return np.array_equal(arr, np.tile(base, (m,) + (1,) * (arr.ndim - 1)))
+
+    rows = merged.shape[0]
+    if multiplier is not None:
+        if multiplier == 1:
+            return merged  # explicit "no replication": keep the plain merge
+        if multiplier < 1 or not tiles_as(merged, multiplier):
             raise ValueError(
-                f"{name}: tp ranks {seen[digest]} and {i} hold bit-identical "
-                "KV shards — this checkpoint was saved with GQA KV "
-                "replication (kv_size_multiplier > 1), which the (0, 1) "
-                "merge rule cannot invert. Re-save from the reference "
-                "with kv_size_multiplier=1, merge manually by taking one "
-                "shard per shared-KV group, or pass "
-                "allow_replicated_kv=True if the shards are genuinely "
-                "identical without replication (e.g. a constant-init "
-                "checkpoint)"
+                f"{name}: merged KV tensor ({rows} rows) is not a clean "
+                f"{multiplier}x tiling — kv_size_multiplier={multiplier} "
+                "does not match this checkpoint"
             )
-        seen[digest] = i
+        return merged[: rows // multiplier]
+
+    for m in sorted((d for d in range(2, tp + 1) if tp % d == 0),
+                    reverse=True):
+        if not tiles_as(merged, m):
+            continue
+        base = merged[: rows // m]
+        still_tiled = any(tiles_as(base, d)
+                          for d in range(2, base.shape[0] + 1)
+                          if base.shape[0] % d == 0)
+        if still_tiled:
+            raise ValueError(
+                f"{name}: KV replication factor is ambiguous — the tensor "
+                f"tiles at multiple factors (constant-init values or a "
+                "master that itself repeats KV heads). Pass "
+                "kv_size_multiplier= explicitly, or "
+                "allow_replicated_kv=True to keep the raw merge"
+            )
+        logger.info(
+            "%s: inverted GQA KV replication (kv_size_multiplier=%d, "
+            "%d -> %d rows)", name, m, rows, rows // m)
+        return base
+    raise ValueError(
+        f"{name}: tp ranks hold bit-identical KV shards but the merged "
+        "tensor is not a clean tiling by any divisor of tp — cannot invert "
+        "the replication layout. Re-save from the reference with "
+        "kv_size_multiplier=1, or pass allow_replicated_kv=True to keep "
+        "the raw merge if the duplicates are genuine"
+    )
 
 
 def split_fused_llama(state: Dict[str, np.ndarray],

@@ -131,39 +131,72 @@ def test_pickle_payload_rejected_by_default(tmp_path):
     np.testing.assert_array_equal(state["model.norm.weight"], np.ones(4))
 
 
-def test_replicated_gqa_kv_shards_raise(tmp_path):
-    """Reference checkpoints saved with kv_size_multiplier > 1 hold
-    bit-identical weight_k/weight_v copies across shared-group tp ranks;
-    the (0,1) concat cannot invert that, so the loader must raise rather
-    than silently emit an oversized tensor (ADVICE r4 low)."""
+def test_replicated_gqa_kv_checkpoint_inverts(tmp_path):
+    """Reference checkpoints saved with kv_size_multiplier > 1 tile the
+    master KV block m times before sharding (modules/qkv_linear.py:110-115);
+    the loader must detect the duplicate shards and recover the ORIGINAL
+    un-tiled weights (ADVICE r4 low, upgraded from reject to invert)."""
     import torch
 
-    kv = np.random.RandomState(5).randn(4, 8).astype(np.float32)
+    rng = np.random.RandomState(5)
+    master = rng.randn(4, 8).astype(np.float32)
+    bias = rng.randn(4).astype(np.float32)
+    for tp, m in [(2, 2), (4, 2), (4, 4)]:
+        mdir = str(tmp_path / f"model_tp{tp}_m{m}")
+        os.makedirs(mdir)
+        tiled_w = np.tile(master, (m, 1))
+        tiled_b = np.tile(bias, m)
+        for t in range(tp):
+            sd = {"a.qkv.weight_k": torch.tensor(
+                      _reference_shard(tiled_w, t, tp, 0, 1)),
+                  "a.qkv.bias_v": torch.tensor(
+                      _reference_shard(tiled_b, t, tp, 0, 1))}
+            torch.save(sd, os.path.join(
+                mdir, f"dp_rank_00_tp_rank_{t:02d}_pp_rank_00.pt"))
+        state = load_nxd_checkpoint(mdir, LLAMA_TP_RULES)
+        np.testing.assert_array_equal(state["a.qkv.weight_k"], master,
+                                      err_msg=f"tp={tp} m={m}")
+        np.testing.assert_array_equal(state["a.qkv.bias_v"], bias)
+        # opt-out keeps the raw tiled merge
+        raw = load_nxd_checkpoint(mdir, LLAMA_TP_RULES, allow_replicated_kv=True)
+        assert raw["a.qkv.weight_k"].shape == (4 * m, 8)
+
+
+def test_constant_kv_bias_is_ambiguous_and_explicit_multiplier_resolves(tmp_path):
+    """A constant-init bias tiles at every factor — inference must refuse
+    to guess (the old silent over-strip), and an explicit
+    kv_size_multiplier= pin recovers the right shape."""
+    import torch
+
+    bias = np.zeros(8, np.float32)          # 8-row master, all zeros
+    tiled = np.tile(bias, 2)                # kv_size_multiplier = 2
     mdir = str(tmp_path / "model")
     os.makedirs(mdir)
-    for t in range(2):  # both ranks hold the SAME kv shard -> replication
-        torch.save({"model.layers.0.self_attn.qkv.weight_k": torch.tensor(kv)},
+    for t in range(4):
+        torch.save({"a.qkv.bias_k": torch.tensor(_reference_shard(tiled, t, 4, 0, 1))},
                    os.path.join(mdir, f"dp_rank_00_tp_rank_{t:02d}_pp_rank_00.pt"))
-    with pytest.raises(ValueError, match="KV replication"):
+    with pytest.raises(ValueError, match="ambiguous"):
         load_nxd_checkpoint(mdir, LLAMA_TP_RULES)
-    # explicit opt-out for genuinely-identical (e.g. constant-init) shards
-    state = load_nxd_checkpoint(mdir, LLAMA_TP_RULES, allow_replicated_kv=True)
-    assert state["model.layers.0.self_attn.qkv.weight_k"].shape == (8, 8)
+    state = load_nxd_checkpoint(mdir, LLAMA_TP_RULES, kv_size_multiplier=2)
+    assert state["a.qkv.bias_k"].shape == (8,)
+    # a wrong explicit factor is rejected, not silently applied
+    with pytest.raises(ValueError, match="does not match"):
+        load_nxd_checkpoint(mdir, LLAMA_TP_RULES, kv_size_multiplier=3)
 
 
-def test_nonadjacent_kv_replication_detected(tmp_path):
-    """Strided replica placements (e.g. [h0, h1, h0, h1] at tp=4) have no
-    adjacent identical pair — the guard must compare all pairs."""
+def test_ambiguous_kv_duplicates_raise(tmp_path):
+    """Duplicate shards WITHOUT a clean tiling (not a kv_size_multiplier
+    layout) are ambiguous and must raise, not silently merge."""
     import torch
 
     rng = np.random.RandomState(7)
-    h0, h1 = rng.randn(4, 8).astype(np.float32), rng.randn(4, 8).astype(np.float32)
+    a, b, c = (rng.randn(4, 8).astype(np.float32) for _ in range(3))
     mdir = str(tmp_path / "model")
     os.makedirs(mdir)
-    for t, shard in enumerate([h0, h1, h0, h1]):
+    for t, shard in enumerate([a, a, b, c]):  # duplicates, but no tiling
         torch.save({"a.qkv.weight_v": torch.tensor(shard)},
                    os.path.join(mdir, f"dp_rank_00_tp_rank_{t:02d}_pp_rank_00.pt"))
-    with pytest.raises(ValueError, match="KV replication"):
+    with pytest.raises(ValueError, match="not a clean tiling"):
         load_nxd_checkpoint(mdir, LLAMA_TP_RULES)
 
 

@@ -108,11 +108,15 @@ def run(mode: str, steps: int, out_dir: str, force_cpu: bool) -> dict:
         config, model, opt, causal_lm_loss,
         batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()})
 
-    os.makedirs(out_dir, exist_ok=True)
-    for f in os.listdir(out_dir):  # ScalarWriter appends; a rerun must replace
-        if f == "scalars.jsonl" or f.startswith("events.out.tfevents"):
-            os.remove(os.path.join(out_dir, f))
-    writer = ScalarWriter(out_dir)
+    # stage into a sibling dir and swap in only on success: an interrupted
+    # run must never destroy or truncate the existing (committed) curve
+    stage_dir = out_dir.rstrip("/") + ".tmp"
+    if os.path.isdir(stage_dir):
+        import shutil
+
+        shutil.rmtree(stage_dir)
+    os.makedirs(stage_dir)
+    writer = ScalarWriter(stage_dir)
     data_rng = np.random.RandomState(1234)  # one stream -> step-deterministic
     params, state = model.params, opt.state
     losses = []
@@ -128,6 +132,13 @@ def run(mode: str, steps: int, out_dir: str, force_cpu: bool) -> dict:
         if step % 10 == 0:
             print(f"# step {step} loss {loss:.4f}", file=sys.stderr, flush=True)
     writer.close()
+    os.makedirs(out_dir, exist_ok=True)
+    for f in os.listdir(out_dir):
+        if f == "scalars.jsonl" or f.startswith("events.out.tfevents"):
+            os.remove(os.path.join(out_dir, f))
+    for f in os.listdir(stage_dir):
+        os.replace(os.path.join(stage_dir, f), os.path.join(out_dir, f))
+    os.rmdir(stage_dir)
     return {"platform": platform, "steps": steps, "losses": losses,
             "final_loss": losses[-1], "out_dir": out_dir}
 
@@ -156,6 +167,22 @@ def main() -> int:
     )
 
     if args.mode == "parity":
+        # fail in milliseconds, not after burning the TPU window on a run
+        # that cannot be compared: the golden must exist AND hold enough
+        # post-warmup records
+        golden_file = os.path.join(GOLDEN_DIR, "scalars.jsonl")
+        n_golden = 0
+        if os.path.isfile(golden_file):
+            from neuronx_distributed_tpu.trainer.scalar_log import read_scalars
+
+            n_golden = len(read_scalars(GOLDEN_DIR, "loss"))
+        if n_golden <= args.warmup + 1:
+            print(json.dumps({"kind": "convergence_parity", "ok": False,
+                              "error": f"golden missing or truncated "
+                              f"({n_golden} records <= warmup {args.warmup}) "
+                              f"at {golden_file} — regenerate with "
+                              "`convergence_run.py golden`"}))
+            return 1
         out = args.out or os.path.join(REPO, "docs", "convergence", "tpu_parity")
         r = run("parity", steps, out, force_cpu=False)
         verdict = compare_scalar_logs(
