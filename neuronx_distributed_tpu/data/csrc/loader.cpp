@@ -291,4 +291,40 @@ int64_t nxd_loader_next(NxdLoader* L, int32_t* out) {
   return want;
 }
 
+
+// First-fit row assignment for sequence packing — the placement loop of
+// data/packing.pack_documents, bit-identical to its Python form: each piece
+// (length <= seq_len) goes into the first row with room among the last
+// `window` opened rows, else opens a new row.  lengths[n] -> out_rows[n]
+// (row index per piece); returns the number of rows, or -1 on a bad length.
+// Pure integer bookkeeping, but Python-loop-bound at corpus scale (millions
+// of documents): this native form removes the interpreter from the only
+// O(pieces * window) part while the numpy row assembly stays in Python.
+int64_t nxd_pack_assign(const int32_t* lengths, int64_t n, int32_t seq_len,
+                        int32_t window, int32_t* out_rows) {
+  if (!lengths || !out_rows || seq_len <= 0 || window < 0) return -1;
+  std::vector<int32_t> space;
+  space.reserve(4096);
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t need = lengths[i];
+    if (need < 0 || need > seq_len) return -1;
+    bool placed = false;
+    const int64_t sz = (int64_t)space.size();
+    const int64_t lo = sz > window ? sz - window : 0;
+    for (int64_t r = lo; r < sz; ++r) {
+      if (space[r] >= need) {
+        out_rows[i] = (int32_t)r;
+        space[r] -= need;
+        placed = true;
+        break;
+      }
+    }
+    if (!placed) {
+      out_rows[i] = (int32_t)space.size();
+      space.push_back(seq_len - need);
+    }
+  }
+  return (int64_t)space.size();
+}
+
 }  // extern "C"

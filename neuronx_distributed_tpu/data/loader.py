@@ -88,8 +88,34 @@ def _load_native():
     lib.nxd_loader_set_epoch.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
     lib.nxd_loader_next.restype = ctypes.c_int64
     lib.nxd_loader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+    if hasattr(lib, "nxd_pack_assign"):  # absent only in a stale cached .so
+        lib.nxd_pack_assign.restype = ctypes.c_int64
+        lib.nxd_pack_assign.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
     _lib = lib
     return _lib
+
+
+def native_pack_assign(lengths: np.ndarray, seq_len: int,
+                       window: int) -> Optional[Tuple[np.ndarray, int]]:
+    """First-fit row assignment via the native library (``nxd_pack_assign``
+    in ``csrc/loader.cpp``); ``None`` when the native path is unavailable —
+    callers fall back to the bit-identical Python loop
+    (``data.packing._assign_rows_py``)."""
+    lib = _load_native()
+    if lib is None or not hasattr(lib, "nxd_pack_assign"):
+        return None
+    lengths = np.ascontiguousarray(lengths, np.int32)
+    out = np.empty(len(lengths), np.int32)
+    n_rows = lib.nxd_pack_assign(
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(len(lengths)), ctypes.c_int32(int(seq_len)),
+        ctypes.c_int32(int(window)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if n_rows < 0:
+        return None
+    return out, int(n_rows)
 
 
 # ---------------------------------------------------------------------------

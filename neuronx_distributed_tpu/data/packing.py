@@ -75,45 +75,64 @@ def pack_documents(
         for i in range(0, len(toks), seq_len):
             pieces.append((toks[i : i + seq_len], labs[i : i + seq_len]))
 
-    rows: List[List[Tuple[np.ndarray, np.ndarray]]] = []
-    space: List[int] = []
     # first-fit over a bounded lookback of recently-opened rows: full
     # first-fit is O(pieces x rows) (quadratic at corpus scale); a window
-    # keeps packing near-identical at O(pieces x window)
+    # keeps packing near-identical at O(pieces x window).  The placement
+    # loop is the interpreter-bound part at corpus scale, so it runs in the
+    # native library when available (csrc/loader.cpp nxd_pack_assign), with
+    # this Python loop as the bit-identical fallback.
     window = 64
-    for piece in pieces:
-        need = len(piece[0])
+    lengths = np.asarray([len(p[0]) for p in pieces], np.int32)
+    from neuronx_distributed_tpu.data.loader import native_pack_assign
+
+    assigned = native_pack_assign(lengths, seq_len, window)
+    if assigned is None:
+        assigned = _assign_rows_py(lengths, seq_len, window)
+    row_of_piece, N = assigned
+
+    ids = np.full((N, seq_len), pad_id, np.int32)
+    labels = np.full((N, seq_len), IGNORE, np.int32)
+    segs = np.zeros((N, seq_len), np.int32)
+    pos = [0] * N
+    nseg = [0] * N
+    for (ptoks, plabs), r in zip(pieces, row_of_piece):
+        L = len(ptoks)
+        p = pos[r]
+        nseg[r] += 1
+        ids[r, p : p + L] = ptoks
+        labels[r, p : p + L] = plabs
+        segs[r, p : p + L] = nseg[r]
+        pos[r] += L
+    return ids, labels, segs
+
+
+def _assign_rows_py(lengths: np.ndarray, seq_len: int,
+                    window: int) -> Tuple[np.ndarray, int]:
+    """Pure-Python window-bounded first-fit — the reference semantics the
+    native ``nxd_pack_assign`` must match bit-for-bit."""
+    space: List[int] = []
+    out = np.empty(len(lengths), np.int32)
+    for i, need in enumerate(lengths):
         placed = False
-        lo = max(0, len(rows) - window)
-        for r in range(lo, len(rows)):
+        lo = max(0, len(space) - window)
+        for r in range(lo, len(space)):
             if space[r] >= need:
-                rows[r].append(piece)
+                out[i] = r
                 space[r] -= need
                 placed = True
                 break
         if not placed:
-            rows.append([piece])
-            space.append(seq_len - need)
-
-    N = len(rows)
-    ids = np.full((N, seq_len), pad_id, np.int32)
-    labels = np.full((N, seq_len), IGNORE, np.int32)
-    segs = np.zeros((N, seq_len), np.int32)
-    for r, row_pieces in enumerate(rows):
-        pos = 0
-        for si, (ptoks, plabs) in enumerate(row_pieces, start=1):
-            L = len(ptoks)
-            ids[r, pos : pos + L] = ptoks
-            labels[r, pos : pos + L] = plabs
-            segs[r, pos : pos + L] = si
-            pos += L
-    return ids, labels, segs
+            out[i] = len(space)
+            space.append(seq_len - int(need))
+    return out, len(space)
 
 
 def segment_positions(segment_ids: np.ndarray) -> np.ndarray:
     """Per-document RoPE positions from ``[N, S]`` segment ids: position =
-    offset within the segment's contiguous run (0 on padding too).  The
-    companion of :func:`pack_documents` every packed consumer needs."""
+    offset within the segment's contiguous run (the trailing padding run
+    restarts from 0 as well; its positions are inert — padding rows carry
+    IGNORE labels and segment id 0 blocks their attention).  The companion
+    of :func:`pack_documents` every packed consumer needs."""
     segment_ids = np.asarray(segment_ids)
     S = segment_ids.shape[-1]
     start = np.zeros_like(segment_ids)

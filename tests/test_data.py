@@ -188,6 +188,27 @@ def test_concat_and_chunk():
     np.testing.assert_array_equal(labels[1], [99, 10, 11, 12])
 
 
+def test_native_pack_assign_matches_python():
+    """The native first-fit placement (csrc nxd_pack_assign) must be
+    bit-identical to the Python loop across ragged workloads, including
+    window-eviction behavior."""
+    from neuronx_distributed_tpu.data.loader import native_pack_assign
+    from neuronx_distributed_tpu.data.packing import _assign_rows_py
+
+    rng = np.random.RandomState(0)
+    for trial, (n, seq_len, window) in enumerate(
+            [(500, 128, 64), (2000, 64, 8), (100, 32, 0), (1, 16, 64)]):
+        lengths = rng.randint(1, seq_len + 1, size=n).astype(np.int32)
+        got = native_pack_assign(lengths, seq_len, window)
+        assert got is not None, "native library unavailable"
+        rows_n, count_n = got
+        rows_p, count_p = _assign_rows_py(lengths, seq_len, window)
+        assert count_n == count_p, trial
+        np.testing.assert_array_equal(rows_n, rows_p, err_msg=str(trial))
+    # invalid length (piece longer than seq_len) -> native signals failure
+    assert native_pack_assign(np.asarray([40], np.int32), 32, 64) is None
+
+
 def test_pack_documents_first_fit():
     from neuronx_distributed_tpu.data.packing import IGNORE, pack_documents
 
