@@ -73,6 +73,9 @@ def parse_args():
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=50)
     p.add_argument("--keep-ckpts", type=int, default=3)
+    p.add_argument("--ckpt-bf16", action="store_true",
+                   help="downcast the model payload to bfloat16 on save "
+                   "(half-size checkpoints; optimizer masters stay fp32)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics-file", default=None, help="JSON results file")
     p.add_argument("--timeline", default=None, help="Chrome-trace output path")
@@ -257,6 +260,7 @@ def main():
         ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every,
         keep_ckpts=args.keep_ckpts,
+        ckpt_save_dtype=jnp.bfloat16 if args.ckpt_bf16 else None,
         resume=args.resume,
         scalar_dir=args.scalar_dir,
         metrics=metrics,

@@ -100,9 +100,12 @@ def _load_native():
 def native_pack_assign(lengths: np.ndarray, seq_len: int,
                        window: int) -> Optional[Tuple[np.ndarray, int]]:
     """First-fit row assignment via the native library (``nxd_pack_assign``
-    in ``csrc/loader.cpp``); ``None`` when the native path is unavailable —
-    callers fall back to the bit-identical Python loop
-    (``data.packing._assign_rows_py``)."""
+    in ``csrc/loader.cpp``); ``None`` ONLY when the native path is
+    unavailable — callers fall back to the bit-identical Python loop
+    (``data.packing._assign_rows_py``).  Invalid input (a piece longer than
+    ``seq_len``, which no assignment can place) raises rather than being
+    conflated with unavailability: the fallback must never silently run a
+    workload the native path rejected."""
     lib = _load_native()
     if lib is None or not hasattr(lib, "nxd_pack_assign"):
         return None
@@ -114,7 +117,11 @@ def native_pack_assign(lengths: np.ndarray, seq_len: int,
         ctypes.c_int32(int(window)),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     if n_rows < 0:
-        return None
+        raise ValueError(
+            f"pack_assign: invalid input (seq_len={seq_len}, window={window}, "
+            f"max piece length {int(lengths.max()) if len(lengths) else 0}) — "
+            "every piece must satisfy 0 <= length <= seq_len"
+        )
     return out, int(n_rows)
 
 

@@ -205,8 +205,12 @@ def test_native_pack_assign_matches_python():
         rows_p, count_p = _assign_rows_py(lengths, seq_len, window)
         assert count_n == count_p, trial
         np.testing.assert_array_equal(rows_n, rows_p, err_msg=str(trial))
-    # invalid length (piece longer than seq_len) -> native signals failure
-    assert native_pack_assign(np.asarray([40], np.int32), 32, 64) is None
+    # invalid length (piece longer than seq_len) raises — never conflated
+    # with native-unavailable (which would silently run the fallback)
+    import pytest
+
+    with pytest.raises(ValueError, match="length <= seq_len"):
+        native_pack_assign(np.asarray([40], np.int32), 32, 64)
 
 
 def test_pack_documents_first_fit():
