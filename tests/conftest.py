@@ -38,6 +38,7 @@ SLOW_MODULES = {
     "test_attention",
     "test_convergence_sweep",
     "test_distributed_ckpt",
+    "test_distributed_train",
     "test_fsdp",
     "test_hf_convert",
     "test_launchers",

@@ -1,0 +1,111 @@
+"""Shared config/data/model construction for the 2-process distributed
+training test — imported by BOTH the spawned workers
+(``dist_train_worker.py``) and the in-process single-host oracle
+(``test_distributed_train.py``), so the two runs are the same program by
+construction."""
+
+import jax.numpy as jnp
+import numpy as np
+
+STEPS = 3
+_B, _S = 8, 16
+
+import neuronx_distributed_tpu as nxd  # noqa: E402
+from neuronx_distributed_tpu.models.llama import (  # noqa: E402
+    LlamaConfig,
+    LlamaForCausalLM,
+    causal_lm_loss,
+)
+from neuronx_distributed_tpu.trainer import (  # noqa: E402
+    default_batch_spec,
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    make_train_step,
+)
+
+CFG = dict(
+    sequence_parallel=False, attention_impl="dense", remat="none",
+    dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=_S,
+)
+
+
+def batch_for_step(i: int):
+    rng = np.random.RandomState(1000 + i)
+    ids = rng.randint(0, 256, size=(_B, _S)).astype(np.int32)
+    return {"ids": ids, "labels": np.roll(ids, -1, axis=1).astype(np.int32)}
+
+
+def place_batch(mesh, batch):
+    """The one batch-placement used by worker AND oracle: explicit global
+    device_put with the default dp sharding (works identically in single-
+    and multi-process runs, keeping the two sides the same program)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    spec = default_batch_spec()
+    return {k: jax.device_put(v, NamedSharding(mesh, spec))
+            for k, v in batch.items()}
+
+
+def run_two_process_workers(worker_path, extra_args=(), timeout=600):
+    """Spawn a 2-process jax.distributed worker pair over a fresh localhost
+    coordinator; returns [(rc, stdout, stderr), ...].  Shared by the
+    distributed checkpoint and training tests.  A worker that exits early
+    is reported with its own stderr even when the peer then hangs at the
+    init barrier (the peer is killed and marked)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    coordinator = f"localhost:{port}"
+    import os
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    here = os.path.dirname(os.path.abspath(worker_path))
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker_path, str(i), coordinator, *extra_args],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            out, err = p.communicate()
+            outs.append((None, out, err))
+    if any(rc is None for rc, _, _ in outs):
+        # surface EVERY worker's output: the peer that crashed fast holds
+        # the real diagnostic, not the one that hung at the barrier
+        detail = "\n".join(
+            f"--- worker {i}: rc={rc}\nstdout:\n{out[-1500:]}\nstderr:\n{err[-2500:]}"
+            for i, (rc, out, err) in enumerate(outs))
+        raise AssertionError(f"distributed worker hung/killed:\n{detail}")
+    return outs
+
+
+def build_everything():
+    """Mesh (tp=2 over however many devices are visible), model, optimizer,
+    train step — identical seeds and dtypes on every invocation."""
+    nxd.destroy_model_parallel()
+    nxd.initialize_model_parallel(tensor_parallel_size=2)
+    config = nxd.training_config(
+        tensor_parallel_size=2, learning_rate=1e-3, compute_dtype="float32")
+    cfg = LlamaConfig.tiny(**CFG)
+    model = initialize_parallel_model(
+        config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, _S), jnp.int32),))
+    opt = initialize_parallel_optimizer(config, model)
+    step_fn = make_train_step(
+        config, model, opt, causal_lm_loss,
+        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()})
+    return model, opt, step_fn

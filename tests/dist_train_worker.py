@@ -1,0 +1,48 @@
+"""Worker for the 2-process distributed TRAINING test (spawned by
+``test_distributed_train.py``).  Usage: ``dist_train_worker.py <proc_id>
+<coordinator>``.
+
+Runs the FULL trainer stack — ``initialize_parallel_model`` (born-sharded
+init), ``initialize_parallel_optimizer``, ``make_train_step`` — on a
+dp=4 x tp=2 mesh spanning two processes (4 virtual CPU devices each, gloo
+collectives), the multi-host layout the reference drives with
+``torchrun``-per-host + NCCL/MPI process groups (SURVEY §5.8).  Prints each
+step's loss so the test can assert (a) both processes observe identical
+losses and (b) the trajectory matches a single-process run of the same
+global mesh bit-for-tolerance — cross-process DCN training is numerically
+the same program as single-process SPMD.
+"""
+
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+coordinator = sys.argv[2]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import neuronx_distributed_tpu as nxd  # noqa: E402,F401
+from neuronx_distributed_tpu.utils.distributed import initialize_distributed  # noqa: E402
+
+initialize_distributed(coordinator, num_processes=2, process_id=proc_id)
+assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+from dist_train_common import (  # noqa: E402
+    STEPS,
+    batch_for_step,
+    build_everything,
+    place_batch,
+)
+
+model, opt, step_fn = build_everything()
+params, state = model.params, opt.state
+for i in range(STEPS):
+    b = place_batch(model.mesh, batch_for_step(i))
+    params, state, m = step_fn(params, state, b, jax.random.PRNGKey(i))
+    print(f"DIST-TRAIN step {i} loss {float(m['loss']):.6f}", flush=True)
+print(f"proc {proc_id}: DIST-TRAIN-OK", flush=True)

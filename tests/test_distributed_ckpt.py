@@ -1,42 +1,18 @@
 """2-process ``jax.distributed`` checkpoint race test (round-2 verdict
 missing #5 'done' criterion): two hosts over one shared directory must save,
 overwrite, rotate, async-save, and restore racelessly.  Runs the worker in
-subprocesses because this suite's in-process backend is single-process."""
+subprocesses (via the shared harness in ``dist_train_common``) because this
+suite's in-process backend is single-process."""
 
 import os
-import socket
-import subprocess
-import sys
 
 _WORKER = os.path.join(os.path.dirname(__file__), "dist_ckpt_worker.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
-
-
 def test_two_process_checkpoint_raceless(tmp_path):
-    coordinator = f"localhost:{_free_port()}"
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _WORKER, str(i), coordinator, str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise AssertionError("distributed checkpoint worker hung (race/deadlock?)")
-        outs.append((p.returncode, out, err))
+    from dist_train_common import run_two_process_workers
+
+    outs = run_two_process_workers(_WORKER, extra_args=(str(tmp_path),))
     for i, (rc, out, err) in enumerate(outs):
         assert rc == 0 and "DIST-CKPT-OK" in out, (
             f"worker {i} failed rc={rc}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
