@@ -41,6 +41,7 @@ SLOW_MODULES = {
     "test_distributed_train",
     "test_fsdp",
     "test_hf_convert",
+    "test_hlo_collectives",
     "test_launchers",
     "test_llama",
     "test_lora",

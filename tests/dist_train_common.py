@@ -4,29 +4,10 @@ training test — imported by BOTH the spawned workers
 (``test_distributed_train.py``), so the two runs are the same program by
 construction."""
 
-import jax.numpy as jnp
 import numpy as np
 
 STEPS = 3
 _B, _S = 8, 16
-
-import neuronx_distributed_tpu as nxd  # noqa: E402
-from neuronx_distributed_tpu.models.llama import (  # noqa: E402
-    LlamaConfig,
-    LlamaForCausalLM,
-    causal_lm_loss,
-)
-from neuronx_distributed_tpu.trainer import (  # noqa: E402
-    default_batch_spec,
-    initialize_parallel_model,
-    initialize_parallel_optimizer,
-    make_train_step,
-)
-
-CFG = dict(
-    sequence_parallel=False, attention_impl="dense", remat="none",
-    dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=_S,
-)
 
 
 def batch_for_step(i: int):
@@ -41,6 +22,8 @@ def place_batch(mesh, batch):
     and multi-process runs, keeping the two sides the same program)."""
     import jax
     from jax.sharding import NamedSharding
+
+    from neuronx_distributed_tpu.trainer import default_batch_spec
 
     spec = default_batch_spec()
     return {k: jax.device_put(v, NamedSharding(mesh, spec))
@@ -66,7 +49,9 @@ def run_two_process_workers(worker_path, extra_args=(), timeout=600):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     here = os.path.dirname(os.path.abspath(worker_path))
-    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    # no trailing separator: an empty PYTHONPATH component means cwd
+    env["PYTHONPATH"] = (here if not env.get("PYTHONPATH")
+                         else here + os.pathsep + env["PYTHONPATH"])
     procs = [
         subprocess.Popen(
             [sys.executable, worker_path, str(i), coordinator, *extra_args],
@@ -96,12 +81,32 @@ def run_two_process_workers(worker_path, extra_args=(), timeout=600):
 
 def build_everything():
     """Mesh (tp=2 over however many devices are visible), model, optimizer,
-    train step — identical seeds and dtypes on every invocation."""
+    train step — identical seeds and dtypes on every invocation.  The heavy
+    model-stack imports live HERE (not module top level) so consumers that
+    only need the subprocess harness (e.g. the checkpoint race test) stay
+    stdlib-light."""
+    import jax.numpy as jnp
+
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        causal_lm_loss,
+    )
+    from neuronx_distributed_tpu.trainer import (
+        default_batch_spec,
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        make_train_step,
+    )
+
     nxd.destroy_model_parallel()
     nxd.initialize_model_parallel(tensor_parallel_size=2)
     config = nxd.training_config(
         tensor_parallel_size=2, learning_rate=1e-3, compute_dtype="float32")
-    cfg = LlamaConfig.tiny(**CFG)
+    cfg = LlamaConfig.tiny(
+        sequence_parallel=False, attention_impl="dense", remat="none",
+        dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=_S)
     model = initialize_parallel_model(
         config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, _S), jnp.int32),))
     opt = initialize_parallel_optimizer(config, model)
