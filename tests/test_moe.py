@@ -245,22 +245,31 @@ def test_moe_llama_trains_and_balances(devices8):
     assert losses[-1] < losses[0] - 0.3, losses
 
 
-def test_moe_pipeline_1f1b_matches_autodiff(devices8):
-    """MoE under PP: the 1F1B manual backward must reproduce autodiff of the
-    fill-drain loss — including the router's load-balancing aux term, which
-    flows through the engine's block_aux channel on every stage."""
+@pytest.mark.parametrize("schedule,num_mb,V,cuts,layers", [
+    ("1f1b", 4, 1, None, 4),
+    ("interleaved", 4, 2, None, 4),        # uniform chunks
+    ("interleaved", 3, 2, (1, 3, 5), 6),   # uneven spans + ragged M
+], ids=["1f1b", "interleaved", "interleaved-cuts+ragged-M"])
+def test_moe_pipeline_matches_autodiff(devices8, schedule, num_mb, V, cuts, layers):
+    """MoE under PP: each schedule's manual backward must reproduce autodiff
+    of its fill-drain loss — including the router's load-balancing aux term,
+    which flows through the engine's block_aux channel on every stage/chunk.
+    The interleaved rows additionally cover padded rows from pipeline_cuts
+    (masked rows contribute zero aux; normalization uses the REAL layer
+    count) and ragged microbatch counts."""
     from neuronx_distributed_tpu.models.llama import build_pipelined_llama
 
     nxd.initialize_model_parallel(
         tensor_parallel_size=2, pipeline_parallel_size=2, devices=devices8
     )
     cfg = LlamaConfig.tiny(
-        num_layers=4, num_experts=4, moe_top_k=2, moe_capacity_factor=4.0,
+        num_layers=layers, num_experts=4, moe_top_k=2, moe_capacity_factor=4.0,
         sequence_parallel=False, remat="none",
         dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16,
     )
-    num_mb = 4
-    pmodel = build_pipelined_llama(cfg, num_microbatches=num_mb, seed=3, schedule="1f1b")
+    pmodel = build_pipelined_llama(
+        cfg, num_microbatches=num_mb, seed=3, schedule=schedule,
+        num_chunks=V, pipeline_cuts=cuts)
     ids = jax.random.randint(jax.random.PRNGKey(0), (2 * num_mb, 16), 0, cfg.vocab_size)
     labels = jnp.roll(ids, -1, axis=1)
 
