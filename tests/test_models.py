@@ -229,10 +229,12 @@ def test_neox_chunked_loss_head_matches_unchunked(devices8):
                                    atol=1e-7, err_msg=jax.tree_util.keystr(kp))
 
 
-def test_neox_pipeline_1f1b_matches_autodiff(devices8):
-    """GPT-NeoX under the PP engine (the reference's 20B TP8xPP4 milestone
-    topology scaled down): 1F1B manual backward == fill-drain autodiff."""
-    import pytest as _pytest
+@pytest.mark.parametrize("schedule,chunks", [("1f1b", 1), ("interleaved", 2)])
+def test_neox_pipeline_matches_autodiff(devices8, schedule, chunks):
+    """GPT-NeoX under the PP engines (the reference's 20B TP8xPP4 milestone
+    topology scaled down): each schedule's manual backward must equal
+    fill-drain autodiff — the second model family pinning the interleaved
+    chunk engine, not just Llama."""
     from neuronx_distributed_tpu.models.gpt_neox import build_pipelined_gpt_neox
 
     nxd.initialize_model_parallel(
@@ -242,7 +244,8 @@ def test_neox_pipeline_1f1b_matches_autodiff(devices8):
         num_layers=4, sequence_parallel=True, remat="none",
         dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16,
     )
-    pmodel = build_pipelined_gpt_neox(cfg, num_microbatches=4, seed=3, schedule="1f1b")
+    pmodel = build_pipelined_gpt_neox(cfg, num_microbatches=4, seed=3,
+                                      schedule=schedule, num_chunks=chunks)
     ids = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, cfg.vocab_size)
     labels = jnp.roll(ids, -1, axis=1)
 
@@ -250,7 +253,7 @@ def test_neox_pipeline_1f1b_matches_autodiff(devices8):
     (ls2, tok2), g2 = jax.jit(
         lambda p, i, l: jax.value_and_grad(pmodel.loss_fn, has_aux=True)(p, i, l)
     )(pmodel.params, ids, labels)
-    assert float(ls) == _pytest.approx(float(ls2), rel=1e-5)
+    assert float(ls) == pytest.approx(float(ls2), rel=1e-5)
     assert float(tok) == float(tok2)
     for (k1, a), (k2, b) in zip(
         jax.tree_util.tree_flatten_with_path(grads)[0],

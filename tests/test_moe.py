@@ -254,9 +254,11 @@ def test_moe_pipeline_matches_autodiff(devices8, schedule, num_mb, V, cuts, laye
     """MoE under PP: each schedule's manual backward must reproduce autodiff
     of its fill-drain loss — including the router's load-balancing aux term,
     which flows through the engine's block_aux channel on every stage/chunk.
-    The interleaved rows additionally cover padded rows from pipeline_cuts
-    (masked rows contribute zero aux; normalization uses the REAL layer
-    count) and ragged microbatch counts."""
+    The interleaved rows additionally exercise padded rows from
+    pipeline_cuts and ragged microbatch counts (schedule-equivalence only:
+    both compared paths share the stage executor and aux normalization, so
+    absolute normalization semantics are pinned separately by the
+    pp=1 cross-checks in test_moe_pipeline_expert_sharded_matches_pp1)."""
     from neuronx_distributed_tpu.models.llama import build_pipelined_llama
 
     nxd.initialize_model_parallel(
