@@ -42,6 +42,7 @@ SLOW_MODULES = {
     "test_fsdp",
     "test_hf_convert",
     "test_hlo_collectives",
+    "test_inference_runner",
     "test_launchers",
     "test_llama",
     "test_lora",
@@ -64,6 +65,36 @@ SLOW_TESTS = {
     "test_zero1_matches_unsharded_adamw",
     "test_column_row_mlp_with_sequence_parallel",
 }
+
+
+def run_cli(script_path, *args, timeout=590):
+    """Run a repo CLI (launcher/runner) as a subprocess with the repo on
+    PYTHONPATH; asserts rc == 0 with tail-truncated diagnostics.  The one
+    subprocess harness for CLI end-to-end tests."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, script_path, *args], capture_output=True, text=True,
+        timeout=timeout, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"{os.path.basename(script_path)} {args[:1]} failed rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-3000:]}"
+    )
+    return proc
+
+
+def last_json_line(stdout: str):
+    """Parse the last JSON object line from a CLI's stdout."""
+    import json
+
+    lines = [l for l in stdout.strip().splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line in output:\n{stdout[-1000:]}"
+    return json.loads(lines[-1])
 
 
 def pytest_collection_modifyitems(config, items):
