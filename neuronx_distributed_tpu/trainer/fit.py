@@ -125,6 +125,7 @@ def fit(
     step_rng: bool = False,
     on_step: Optional[Callable[[int, dict], None]] = None,
     callbacks: "tuple[Callback, ...] | list" = (),
+    checkpoint_on_signal: bool = False,
 ) -> FitResult:
     """Run the training loop: steps, eval cadence, checkpoint cadence with
     resume, scalar/throughput logging.
@@ -154,7 +155,15 @@ def fit(
       callbacks: :class:`Callback` instances receiving every cadence event
         (fit start/end, step, eval, checkpoint); any callback setting
         ``should_stop`` ends the loop after the current step.
+      checkpoint_on_signal: install SIGTERM/SIGINT handlers for the run
+        (restored on exit): the first signal finishes the current step,
+        writes the final checkpoint, and returns normally — TPU-pod
+        maintenance events and preemptions send SIGTERM, so this turns a
+        preemption into a clean ``resume=True`` restart instead of losing
+        the work since the last cadence save.  Requires ``ckpt_dir``.
     """
+    if checkpoint_on_signal and not ckpt_dir:
+        raise ValueError("checkpoint_on_signal requires ckpt_dir")
     step_fn = make_train_step(
         config, model, optimizer, loss_fn, batch_spec=batch_spec,
         grad_accum_steps=grad_accum_steps,
@@ -201,84 +210,120 @@ def fit(
         cb.should_stop = False  # instances are reusable across fit() calls
         cb.on_fit_start(start_step, params, opt_state)
 
+    prev_handlers = {}
+    signal_seen: list = []
+    if checkpoint_on_signal:
+        import signal as _signal
+
+        def _on_signal(signum, frame):
+            # only append to a list (async-signal-safe — no logging/IO:
+            # a reentrant stderr write would raise inside the handler and
+            # skip the very checkpoint this feature exists to write); the
+            # loop logs when it observes the flag.  Restore the previous
+            # handlers immediately so a SECOND signal terminates normally —
+            # a preemptor's escalation must never be swallowed while the
+            # final checkpoint drains.
+            signal_seen.append(signum)
+            for s, h in prev_handlers.items():
+                if h is not None:
+                    _signal.signal(s, h)
+
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            prev_handlers[sig] = _signal.signal(sig, _on_signal)
+
     final_step = steps
     last_saved_step = -1
-    for step in range(start_step, steps):
-        batch = next_batch(step)
-        if thr is None:
-            leaves = jax.tree.leaves(batch)
-            bsz = leaves[0].shape[0]
-            # tokens/batch from a [B, S] leaf (MFU summary); batches of
-            # 1-D-only arrays simply have no token notion
-            two_d = [x for x in leaves if x.ndim >= 2]
-            tokens_per_batch = bsz * two_d[0].shape[1] if two_d else None
-            thr = Throughput(bsz)
-        rng = jax.random.fold_in(rng0, step) if step_rng else None
-        if timeline is not None:
-            with timeline.event("train_step"):
+    try:
+        for step in range(start_step, steps):
+            batch = next_batch(step)
+            if thr is None:
+                leaves = jax.tree.leaves(batch)
+                bsz = leaves[0].shape[0]
+                # tokens/batch from a [B, S] leaf (MFU summary); batches of
+                # 1-D-only arrays simply have no token notion
+                two_d = [x for x in leaves if x.ndim >= 2]
+                tokens_per_batch = bsz * two_d[0].shape[1] if two_d else None
+                thr = Throughput(bsz)
+            rng = jax.random.fold_in(rng0, step) if step_rng else None
+            if timeline is not None:
+                with timeline.event("train_step"):
+                    params, opt_state, m = step_fn(params, opt_state, batch, rng)
+                    loss = float(m["loss"])
+                timeline.mark_step_end(step)  # flushes the event buffer to disk
+            else:
                 params, opt_state, m = step_fn(params, opt_state, batch, rng)
                 loss = float(m["loss"])
-            timeline.mark_step_end(step)  # flushes the event buffer to disk
-        else:
-            params, opt_state, m = step_fn(params, opt_state, batch, rng)
-            loss = float(m["loss"])
-        seqs = thr.step()
-        grad_norm = float(m["grad_norm"])
-        if scalars:
-            scalars.scalars(step, loss=loss, grad_norm=grad_norm,
-                            seq_per_sec=seqs)
-        step_metrics = dict(m)
-        step_metrics.update(loss=loss, grad_norm=grad_norm, seq_per_sec=seqs)
-        for cb in cbs:
-            cb.on_step(step, step_metrics)
-        if log_every and (step % log_every == 0 or step == steps - 1):
-            # stdout JSON lines — the launcher-harness contract the example
-            # scripts (and their tests) have always exposed
-            print(json.dumps({
-                "step": step, "loss": round(loss, 4),
-                "seq_per_sec": round(seqs, 2),
-                "grad_norm": round(grad_norm, 4),
-            }), flush=True)
-        if eval_fn is not None and (step + 1) % eval_every == 0:
-            ev = eval_fn(params, eval_data(step))
-            eval_loss = float(ev["loss"])
-            eval_history.append((step + 1, eval_loss))
+            seqs = thr.step()
+            grad_norm = float(m["grad_norm"])
             if scalars:
-                scalars.scalars(step, eval_loss=eval_loss)
+                scalars.scalars(step, loss=loss, grad_norm=grad_norm,
+                                seq_per_sec=seqs)
+            step_metrics = dict(m)
+            step_metrics.update(loss=loss, grad_norm=grad_norm, seq_per_sec=seqs)
             for cb in cbs:
-                cb.on_eval(step + 1, {"eval_loss": eval_loss})
-        if ckpt_dir and ckpt_every and (step + 1) % ckpt_every == 0 \
-                and step + 1 < steps:
-            path = save_checkpoint(ckpt_dir, f"step_{step + 1}", params, opt_state,
-                                   user_content={"step": step + 1},
-                                   num_kept_ckpts=keep_ckpts, async_save=async_save,
-                                   save_dtype=ckpt_save_dtype)
-            last_saved_step = step + 1
-            for cb in cbs:
-                cb.on_checkpoint(step + 1, path)
-        if any(cb.should_stop for cb in cbs):
-            final_step = step + 1
-            logger.info("callback requested stop after step %d", final_step)
-            break
+                cb.on_step(step, step_metrics)
+            if log_every and (step % log_every == 0 or step == steps - 1):
+                # stdout JSON lines — the launcher-harness contract the example
+                # scripts (and their tests) have always exposed
+                print(json.dumps({
+                    "step": step, "loss": round(loss, 4),
+                    "seq_per_sec": round(seqs, 2),
+                    "grad_norm": round(grad_norm, 4),
+                }), flush=True)
+            if eval_fn is not None and (step + 1) % eval_every == 0:
+                ev = eval_fn(params, eval_data(step))
+                eval_loss = float(ev["loss"])
+                eval_history.append((step + 1, eval_loss))
+                if scalars:
+                    scalars.scalars(step, eval_loss=eval_loss)
+                for cb in cbs:
+                    cb.on_eval(step + 1, {"eval_loss": eval_loss})
+            if ckpt_dir and ckpt_every and (step + 1) % ckpt_every == 0 \
+                    and step + 1 < steps:
+                path = save_checkpoint(ckpt_dir, f"step_{step + 1}", params, opt_state,
+                                       user_content={"step": step + 1},
+                                       num_kept_ckpts=keep_ckpts, async_save=async_save,
+                                       save_dtype=ckpt_save_dtype)
+                last_saved_step = step + 1
+                for cb in cbs:
+                    cb.on_checkpoint(step + 1, path)
+            if signal_seen:
+                final_step = step + 1
+                logger.info("stopping on signal %s after step %d (checkpoint "
+                            "follows)", signal_seen[0], final_step)
+                break
+            if any(cb.should_stop for cb in cbs):
+                final_step = step + 1
+                logger.info("callback requested stop after step %d", final_step)
+                break
 
-    ran_any = start_step < steps
-    if not ran_any:
-        # resumed past the end: nothing to train, nothing to overwrite — the
-        # existing final checkpoint and metrics file stay authoritative
-        logger.info("resume step %d >= steps %d: nothing to do", start_step, steps)
-    if ckpt_dir and ran_any:
-        if last_saved_step != final_step:
-            # skip when an early stop landed exactly on a cadence save — a
-            # rewrite would rmtree the just-written tag and double-notify
-            path = save_checkpoint(ckpt_dir, f"step_{final_step}", params, opt_state,
-                                   user_content={"step": final_step},
-                                   num_kept_ckpts=keep_ckpts,
-                                   save_dtype=ckpt_save_dtype)
-            wait_for_checkpoint()
-            for cb in cbs:
-                cb.on_checkpoint(final_step, path)
-        else:
-            wait_for_checkpoint()  # cadence save may be async: make it durable
+        ran_any = start_step < steps
+        if not ran_any:
+            # resumed past the end: nothing to train, nothing to overwrite — the
+            # existing final checkpoint and metrics file stay authoritative
+            logger.info("resume step %d >= steps %d: nothing to do", start_step, steps)
+        if ckpt_dir and ran_any:
+            if last_saved_step != final_step:
+                # skip when an early stop landed exactly on a cadence save — a
+                # rewrite would rmtree the just-written tag and double-notify
+                path = save_checkpoint(ckpt_dir, f"step_{final_step}", params, opt_state,
+                                       user_content={"step": final_step},
+                                       num_kept_ckpts=keep_ckpts,
+                                       save_dtype=ckpt_save_dtype)
+                wait_for_checkpoint()
+                for cb in cbs:
+                    cb.on_checkpoint(final_step, path)
+            else:
+                wait_for_checkpoint()  # cadence save may be async: make it durable
+    finally:
+        if prev_handlers:
+            import signal as _signal
+
+            for _sig, _h in prev_handlers.items():
+                # None = previous handler was installed by non-Python code
+                # (signal.signal returned None); nothing restorable
+                if _h is not None:
+                    _signal.signal(_sig, _h)
     if scalars:
         scalars.close()
     if metrics is not None and ran_any:

@@ -318,6 +318,91 @@ def test_fit_callbacks_observe_every_cadence_event(config, tmp_path):
     assert ckpts == [3]  # one save, one notification — no double write
 
 
+_SIGNAL_WORKER = '''
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+sys.path.insert(0, sys.argv[2])
+sys.path.insert(0, os.path.join(sys.argv[2], "tests"))
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.trainer import fit, initialize_parallel_model, \\
+    initialize_parallel_optimizer, default_batch_spec
+from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, causal_lm_loss
+
+nxd.initialize_model_parallel(tensor_parallel_size=2)
+config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3,
+                             compute_dtype="float32")
+cfg = LlamaConfig.tiny(sequence_parallel=False, remat="none",
+                       dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16)
+model = initialize_parallel_model(config, lambda: LlamaForCausalLM(cfg),
+                                  (jnp.zeros((1, 16), jnp.int32),))
+opt = initialize_parallel_optimizer(config, model)
+ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, cfg.vocab_size)
+data = lambda step: {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+res = fit(config, model, opt, data, steps=100000, loss_fn=causal_lm_loss,
+          batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()},
+          ckpt_dir=sys.argv[1], log_every=1, checkpoint_on_signal=True)
+print(f"SIGNAL-FIT-DONE steps_run={res.steps_run}", flush=True)
+'''
+
+
+def test_fit_checkpoint_on_sigterm(tmp_path):
+    """Preemption safety: a SIGTERM mid-run finishes the in-flight step,
+    writes the final checkpoint, and returns normally — so a TPU-pod
+    maintenance event becomes a clean resume instead of lost work."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_SIGNAL_WORKER)
+    ckpt = str(tmp_path / "ck")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    # stdout/stderr to FILES: a pipe the test isn't draining could fill and
+    # deadlock the worker mid-warning before it ever prints a step line
+    out_path, err_path = tmp_path / "out.log", tmp_path / "err.log"
+    with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, str(worker), ckpt, repo],
+            stdout=out_f, stderr=err_f, text=True, env=env,
+        )
+        # wait until training visibly progresses (a step log line); fail
+        # fast if the worker dies first
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if '"step"' in out_path.read_text():
+                break
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"worker exited rc={proc.returncode} before training:\n"
+                    f"{err_path.read_text()[-3000:]}")
+            time.sleep(0.2)
+        else:
+            proc.kill()
+            raise AssertionError(
+                f"worker never reached a training step:\n"
+                f"{err_path.read_text()[-3000:]}")
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise AssertionError("worker did not stop after SIGTERM")
+    out, err = out_path.read_text(), err_path.read_text()
+    assert proc.returncode == 0, err[-3000:]
+    assert "SIGNAL-FIT-DONE" in out
+    # the final checkpoint landed, tagged with the actual last step
+    tags = [d for d in os.listdir(ckpt) if d.startswith("step_")]
+    assert tags, os.listdir(ckpt)
+    saved_step = max(int(t.split("_")[1]) for t in tags)
+    assert 0 < saved_step < 100000
+
+
 def test_fit_interrupted_resume_identical_trajectory(config, tmp_path):
     """'Done' criterion: an interrupted+resumed fit reproduces the
     uninterrupted run's loss trajectory exactly (params, optimizer state,
