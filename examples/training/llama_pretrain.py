@@ -76,6 +76,10 @@ def parse_args():
     p.add_argument("--ckpt-bf16", action="store_true",
                    help="downcast the model payload to bfloat16 on save "
                    "(half-size checkpoints; optimizer masters stay fp32)")
+    p.add_argument("--ckpt-on-signal", action="store_true",
+                   help="on SIGTERM/SIGINT, finish the current step, write "
+                   "the final checkpoint, and exit cleanly (preemption-safe "
+                   "training; pair with --resume on restart)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics-file", default=None, help="JSON results file")
     p.add_argument("--timeline", default=None, help="Chrome-trace output path")
@@ -85,6 +89,8 @@ def parse_args():
     p.add_argument("--virtual-devices", type=int, default=None,
                    help="force an N-device virtual CPU mesh (dev/test runs)")
     args = p.parse_args()
+    if args.ckpt_on_signal and not args.ckpt_dir:
+        p.error("--ckpt-on-signal requires --ckpt-dir")
     if args.loss_chunk and args.pp > 1:
         p.error("--loss-chunk has no effect with --pp > 1: the pipeline "
                 "engine owns the head+loss (its last stage computes per-"
@@ -261,6 +267,7 @@ def main():
         ckpt_every=args.ckpt_every,
         keep_ckpts=args.keep_ckpts,
         ckpt_save_dtype=jnp.bfloat16 if args.ckpt_bf16 else None,
+        checkpoint_on_signal=args.ckpt_on_signal,
         resume=args.resume,
         scalar_dir=args.scalar_dir,
         metrics=metrics,

@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import signal as _signal
+import threading
 from typing import Any, Callable, Iterable, Optional
 
 import jax
@@ -162,8 +164,14 @@ def fit(
         preemption into a clean ``resume=True`` restart instead of losing
         the work since the last cadence save.  Requires ``ckpt_dir``.
     """
-    if checkpoint_on_signal and not ckpt_dir:
-        raise ValueError("checkpoint_on_signal requires ckpt_dir")
+    if checkpoint_on_signal:
+        if not ckpt_dir:
+            raise ValueError("checkpoint_on_signal requires ckpt_dir")
+        if threading.current_thread() is not threading.main_thread():
+            raise ValueError(
+                "checkpoint_on_signal requires the main thread (Python "
+                "signal handlers cannot be installed elsewhere); run fit() "
+                "on the main thread or drop the flag")
     step_fn = make_train_step(
         config, model, optimizer, loss_fn, batch_spec=batch_spec,
         grad_accum_steps=grad_accum_steps,
@@ -213,8 +221,6 @@ def fit(
     prev_handlers = {}
     signal_seen: list = []
     if checkpoint_on_signal:
-        import signal as _signal
-
         def _on_signal(signum, frame):
             # only append to a list (async-signal-safe — no logging/IO:
             # a reentrant stderr write would raise inside the handler and
@@ -222,11 +228,12 @@ def fit(
             # loop logs when it observes the flag.  Restore the previous
             # handlers immediately so a SECOND signal terminates normally —
             # a preemptor's escalation must never be swallowed while the
-            # final checkpoint drains.
+            # final checkpoint drains.  A None previous handler (installed
+            # by non-Python code, unrecoverable from Python) restores
+            # SIG_DFL — default termination beats a swallowed signal.
             signal_seen.append(signum)
             for s, h in prev_handlers.items():
-                if h is not None:
-                    _signal.signal(s, h)
+                _signal.signal(s, h if h is not None else _signal.SIG_DFL)
 
         for sig in (_signal.SIGTERM, _signal.SIGINT):
             prev_handlers[sig] = _signal.signal(sig, _on_signal)
@@ -316,14 +323,11 @@ def fit(
             else:
                 wait_for_checkpoint()  # cadence save may be async: make it durable
     finally:
-        if prev_handlers:
-            import signal as _signal
-
-            for _sig, _h in prev_handlers.items():
-                # None = previous handler was installed by non-Python code
-                # (signal.signal returned None); nothing restorable
-                if _h is not None:
-                    _signal.signal(_sig, _h)
+        # None = previous handler came from non-Python code and cannot be
+        # re-installed from Python: SIG_DFL beats leaving OUR handler
+        # appending to a list nothing reads anymore
+        for _sig, _h in prev_handlers.items():
+            _signal.signal(_sig, _h if _h is not None else _signal.SIG_DFL)
     if scalars:
         scalars.close()
     if metrics is not None and ran_any:
