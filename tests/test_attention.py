@@ -39,6 +39,26 @@ def test_flash_forward_matches_dense(causal, gqa):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
+def test_flash_bf16_matches_fp32_reference():
+    """The TPU bench ladder's hot rungs run bf16 operands with fp32
+    accumulation (preferred_element_type): the kernel's bf16 path must
+    track the fp32 dense oracle within bf16 resolution — a dtype-handling
+    bug here would silently poison every silicon measurement."""
+    B, HKV, S, D = 2, 2, 64, 16
+    q, k, v = _qkv(jax.random.PRNGKey(9), B, HKV * 2, HKV, S, S, D)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, causal=True, block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    ref = mha_reference(q, k, v, causal=True)  # fp32 oracle
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=2e-2, atol=2e-2)
+    # gradients flow at bf16 without NaN/inf
+    g = jax.grad(lambda a: jnp.sum(
+        flash_attention(a, kb, vb, True, None, 16, 16).astype(jnp.float32) ** 2
+    ))(qb)
+    assert g.dtype == jnp.bfloat16 and np.isfinite(np.asarray(g, np.float32)).all()
+
+
 def test_flash_decode_offset():
     """T > S: queries occupy the last S positions of the kv timeline."""
     B, H, S, T, D = 1, 2, 8, 32, 8
