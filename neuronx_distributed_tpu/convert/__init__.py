@@ -19,4 +19,6 @@ from neuronx_distributed_tpu.convert.hf import (  # noqa: F401
     llama_params_to_hf,
     llama_stack_layers,
     llama_unstack_layers,
+    mistral_params_from_hf,
+    mistral_params_to_hf,
 )

@@ -432,3 +432,13 @@ def gpt_neox_params_from_pipelined(pparams: Mapping[str, Any], layer_rows) -> Di
     for i, row in enumerate(layer_rows):
         out[f"layer_{i}"] = jax.tree.map(lambda x, r=row: x[r], stacked)
     return {"params": out}
+
+
+# ---------------------------------------------------------------------------
+# Mistral: the HF layout is byte-identical to Llama's (same module names,
+# same fused-projection shapes; the sliding window is config-only), so the
+# Llama converters serve the Mistral family directly.
+# ---------------------------------------------------------------------------
+
+mistral_params_from_hf = llama_params_from_hf
+mistral_params_to_hf = llama_params_to_hf

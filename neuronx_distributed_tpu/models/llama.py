@@ -73,6 +73,12 @@ class LlamaConfig:
     # biases on the q/k/v projections (Qwen2's one architectural delta from
     # Llama; everything else — GQA, SwiGLU, RMSNorm, RoPE — is shared)
     qkv_bias: bool = False
+    # Mistral-style causal sliding-window attention: query at position p
+    # attends keys in [p - sliding_window + 1, p].  On the flash path the
+    # band is enforced in-kernel with out-of-band KV blocks skipped in the
+    # grid (O(S*W) attention); on the dense path it joins the causal mask.
+    # Composes with cp via cp_impl="ulysses"; the ring schedules reject it.
+    sliding_window: Optional[int] = None
     remat: str = "selective"  # none | selective | full
     # "dense": GSPMD einsum core (CPU-friendly; always used for cached decode).
     # "flash": pallas flash kernel under shard_map; rings KV over the cp axis
@@ -159,6 +165,16 @@ class LlamaConfig:
             qkv_bias=True, rms_eps=1e-6), **overrides})
 
     @staticmethod
+    def mistral_7b(**overrides) -> "LlamaConfig":
+        """Mistral-7B-v0.1: Llama architecture + GQA kv8 + 4096-token
+        sliding-window attention (the SWA reference family; the window is
+        the one architectural delta from Llama)."""
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8,
+            max_seq_len=32768, sliding_window=4096), **overrides})
+
+    @staticmethod
     def mixtral_8x7b(**overrides) -> "LlamaConfig":
         """Mixtral-8x7B-shaped MoE config (8 experts, top-2) — the
         expert-parallel flagship shape; beyond the reference, which has no
@@ -195,12 +211,18 @@ def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def _causal_mask(q_len: int, kv_len: int, q_offset) -> jax.Array:
+def _causal_mask(q_len: int, kv_len: int, q_offset, window=None) -> jax.Array:
     """Boolean [q_len, kv_len] mask, True = attend; q position i (global
-    ``i + q_offset``) attends kv positions <= its own."""
+    ``i + q_offset``) attends kv positions <= its own — and, with a sliding
+    ``window``, no further back than ``window - 1`` positions."""
+    if window is not None and window < 1:
+        raise ValueError(f"sliding window must be >= 1, got {window}")
     q_pos = jnp.arange(q_len)[:, None] + q_offset
     kv_pos = jnp.arange(kv_len)[None, :]
-    return kv_pos <= q_pos
+    mask = kv_pos <= q_pos
+    if window is not None:
+        mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+    return mask
 
 
 class CoreAttention(nn.Module):
@@ -242,7 +264,7 @@ class CoreAttention(nn.Module):
                 return ring_attention(
                     q, k, v, causal=True, segment_ids=segment_ids,
                     layout="zigzag" if cfg.cp_zigzag else "contiguous",
-                    cp_impl=cfg.cp_impl,
+                    cp_impl=cfg.cp_impl, window=cfg.sliding_window,
                 )
         if cfg.attention_impl == "flash" and allow_flash and segment_ids is None:
             from neuronx_distributed_tpu.ops.ring_attention import ring_attention
@@ -254,7 +276,7 @@ class CoreAttention(nn.Module):
             return ring_attention(
                 q, k, v, causal=True,
                 layout="zigzag" if cfg.cp_zigzag else "contiguous",
-                cp_impl=cfg.cp_impl,
+                cp_impl=cfg.cp_impl, window=cfg.sliding_window,
             )
         B, S, NQ, D = q.shape
         T = k.shape[1]
@@ -266,7 +288,7 @@ class CoreAttention(nn.Module):
         # double-means-fp32 trick, modeling_llama_nxd.py:211)
         scores = jnp.einsum("bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(D).astype(jnp.float32)
-        mask = _causal_mask(S, T, q_offset)[None, None, None]
+        mask = _causal_mask(S, T, q_offset, cfg.sliding_window)[None, None, None]
         if kv_valid is not None:
             # per-example key validity [B, T] (left-padded serving batches,
             # the reference's padded HF batches, neuron_modeling_llama.py:437-465)

@@ -83,16 +83,25 @@ def _block_sizes(s: int, t: int, block_q: int, block_k: int) -> Tuple[int, int]:
 
 def mha_reference(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
-    sm_scale: Optional[float] = None,
+    sm_scale: Optional[float] = None, window: Optional[int] = None,
 ) -> jax.Array:
-    """Dense oracle used by the tests (same math, full score matrix)."""
+    """Dense oracle used by the tests (same math, full score matrix).
+    ``window`` is the causal sliding window: query at position p attends
+    keys in ``[p - window + 1, p]`` (Mistral-style SWA)."""
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     G = q.shape[1] // k.shape[1]
     scale = (q.shape[-1] ** -0.5) if sm_scale is None else sm_scale
     kk = jnp.repeat(k, G, axis=1)
     vv = jnp.repeat(v, G, axis=1)
     s = jnp.einsum("bhsd,bhtd->bhst", q, kk, preferred_element_type=jnp.float32) * scale
     if causal:
-        mask = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool), k.shape[2] - q.shape[2])
+        # same arange-comparison form as every other band-mask site
+        q_pos = jnp.arange(q.shape[2])[:, None] + (k.shape[2] - q.shape[2])
+        kv_pos = jnp.arange(k.shape[2])[None, :]
+        mask = kv_pos <= q_pos
+        if window is not None:
+            mask = jnp.logical_and(mask, kv_pos > q_pos - window)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhst,bhtd->bhsd", p.astype(v.dtype), vv, preferred_element_type=q.dtype)
@@ -127,7 +136,7 @@ def _segment_mask(qseg_ref, kseg_ref, block_q, block_k):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_offset,
-                qseg_ref=None, kseg_ref=None):
+                qseg_ref=None, kseg_ref=None, window=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -137,11 +146,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal: skip blocks entirely above the diagonal (kv start > last q pos)
+    # causal: skip blocks entirely above the diagonal (kv start > last q pos);
+    # with a sliding window also those entirely left of the band (kv end <
+    # the first q row's lowest visible key)
     first_q = qi * block_q + kv_offset  # q positions offset into kv timeline
     run = jnp.logical_or(
         not causal, ki * block_k <= first_q + block_q - 1
     )
+    if window is not None:
+        run = jnp.logical_and(
+            run, (ki + 1) * block_k - 1 >= first_q - (window - 1)
+        )
 
     @pl.when(run)
     def _body():
@@ -158,6 +173,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(kpos > qpos - window, s, NEG_INF)
         if qseg_ref is not None:
             s = jnp.where(_segment_mask(qseg_ref, kseg_ref, block_q, block_k), s, NEG_INF)
 
@@ -199,7 +216,7 @@ def _seg_operands(q_seg, kv_seg, B, S, T, bq, bk):
 
 
 def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-              q_seg=None, kv_seg=None):
+              q_seg=None, kv_seg=None, window=None):
     B, HQ, S, D = q.shape
     _, HKV, T, _ = k.shape
     G = HQ // HKV
@@ -207,6 +224,8 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     scale = (D ** -0.5) if sm_scale is None else sm_scale
     nq, nk = S // bq, T // bk
     kv_offset = T - S  # q positions sit at the end of the kv timeline
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
 
     if pltpu is None:  # pragma: no cover - CPU builds always ship pltpu today
         raise RuntimeError("pallas TPU namespace unavailable")
@@ -222,7 +241,7 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         _fwd_kernel(q_r, k_r, v_r, o_r, lse_r, m_s, l_s, a_s,
                     sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
                     num_kv_blocks=nk, kv_offset=kv_offset,
-                    qseg_ref=qs_r, kseg_ref=ks_r)
+                    qseg_ref=qs_r, kseg_ref=ks_r, window=window)
 
     scratch = [
         # m / l lane-replicated, acc in fp32
@@ -268,7 +287,7 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_offset,
-               qseg_ref=None, kseg_ref=None):
+               qseg_ref=None, kseg_ref=None, window=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -278,6 +297,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
 
     first_q = qi * block_q + kv_offset
     run = jnp.logical_or(not causal, ki * block_k <= first_q + block_q - 1)
+    if window is not None:
+        run = jnp.logical_and(run, (ki + 1) * block_k - 1 >= first_q - (window - 1))
 
     @pl.when(run)
     def _body():
@@ -296,6 +317,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(kpos > qpos - window, s, NEG_INF)
         if qseg_ref is not None:
             s = jnp.where(_segment_mask(qseg_ref, kseg_ref, block_q, block_k), s, NEG_INF)
         p = jnp.exp(s - lse)
@@ -315,7 +338,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                 dk_scr, dv_scr,
                 *, sm_scale, causal, block_q, block_k, num_q_blocks, kv_offset,
-                qseg_ref=None, kseg_ref=None):
+                qseg_ref=None, kseg_ref=None, window=None):
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -326,6 +349,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     first_q = qi * block_q + kv_offset
     run = jnp.logical_or(not causal, ki * block_k <= first_q + block_q - 1)
+    if window is not None:
+        run = jnp.logical_and(run, (ki + 1) * block_k - 1 >= first_q - (window - 1))
 
     @pl.when(run)
     def _body():
@@ -344,6 +369,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(kpos > qpos - window, s, NEG_INF)
         if qseg_ref is not None:
             s = jnp.where(_segment_mask(qseg_ref, kseg_ref, block_q, block_k), s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk] fp32
@@ -366,7 +393,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 
 def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, interpret,
-              q_seg=None, kv_seg=None):
+              q_seg=None, kv_seg=None, window=None):
     """Backward kernels; ``delta_rows [B,HQ,S]`` is the softmax correction term
     (``rowsum(dO*O)``, minus the lse cotangent when one exists — see
     :func:`flash_attention_with_lse`)."""
@@ -395,7 +422,7 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
         _dq_kernel(q_r, k_r, v_r, do_r, lse_r, d_r, dq_r, a_s,
                    sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
                    num_kv_blocks=nk, kv_offset=kv_offset,
-                   qseg_ref=qs_r, kseg_ref=ks_r)
+                   qseg_ref=qs_r, kseg_ref=ks_r, window=window)
 
     dq_in_specs = [
         pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -432,7 +459,7 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
         _dkv_kernel(q_r, k_r, v_r, do_r, lse_r, d_r, dk_r, dv_r, dks, dvs,
                     sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
                     num_q_blocks=nq, kv_offset=kv_offset,
-                    qseg_ref=qs_r, kseg_ref=ks_r)
+                    qseg_ref=qs_r, kseg_ref=ks_r, window=window)
 
     dkv_in_specs = [
         pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
@@ -481,7 +508,7 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -491,28 +518,37 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Fused blockwise attention: ``q [B, HQ, S, D]``, ``k/v [B, HKV, T, D]``
     (``HQ`` a multiple of ``HKV``) → ``[B, HQ, S, D]``.
 
     With ``causal=True`` and ``T > S`` the queries occupy the *last* ``S``
     positions of the kv timeline (the decode/chunked-prefill convention).
-    ``interpret`` defaults to auto: pallas interpreter off-TPU."""
-    o, _ = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, _auto_interpret(interpret))
+    ``interpret`` defaults to auto: pallas interpreter off-TPU.
+
+    ``window`` (causal only) is Mistral-style sliding-window attention:
+    query at position p attends keys in ``[p - window + 1, p]``.  KV blocks
+    entirely left of the band are skipped in the grid the same way causal
+    blocks above the diagonal are, so long-sequence SWA costs
+    O(S * window), not O(S^2)."""
+    o, _ = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                     _auto_interpret(interpret), window=window)
     return o
 
 
-def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, _auto_interpret(interpret))
+def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                       _auto_interpret(interpret), window=window)
     return o, (q, k, v, o, lse)
 
 
-def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, do):
     q, k, v, o, lse = res
     delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     dq, dk, dv = _bwd_impl(
         q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
-        _auto_interpret(interpret),
+        _auto_interpret(interpret), window=window,
     )
     return dq, dk, dv
 
@@ -520,7 +556,7 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_with_lse(
     q: jax.Array,
     k: jax.Array,
@@ -530,6 +566,7 @@ def flash_attention_with_lse(
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """:func:`flash_attention` that also returns the per-row logsumexp
     ``[B, HQ, S]`` (fp32) — the combinable partial form needed by ring
@@ -541,23 +578,25 @@ def flash_attention_with_lse(
     ``delta = rowsum(dO*O)`` correction — so the same kernels serve both entry
     points.
     """
-    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, _auto_interpret(interpret))
+    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                       _auto_interpret(interpret), window=window)
     return o, lse[..., 0]
 
 
-def _fa_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, _auto_interpret(interpret))
+def _fa_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+    o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                       _auto_interpret(interpret), window=window)
     return (o, lse[..., 0]), (q, k, v, o, lse)
 
 
-def _fa_lse_bwd(causal, sm_scale, block_q, block_k, interpret, res, cts):
+def _fa_lse_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, cts):
     q, k, v, o, lse = res
     do, dlse = cts
     delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta_rows = delta_rows - dlse.astype(jnp.float32)
     dq, dk, dv = _bwd_impl(
         q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
-        _auto_interpret(interpret),
+        _auto_interpret(interpret), window=window,
     )
     return dq, dk, dv
 
@@ -576,7 +615,7 @@ def _float0_like(x):
     return _np.zeros(x.shape, jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def flash_attention_segmented(
     q: jax.Array,
     k: jax.Array,
@@ -588,6 +627,7 @@ def flash_attention_segmented(
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """:func:`flash_attention` with document-segment masking — the packed-
     pretraining hot path (``data.packing``): queries attend only keys of the
@@ -598,24 +638,30 @@ def flash_attention_segmented(
     IGNORE labels already drop — same confinement the dense path has).
 
     A separate entry point (not a kwarg on :func:`flash_attention`) so the
-    unsegmented kernels' compiled artifacts stay byte-identical."""
+    unsegmented kernels' compiled artifacts stay byte-identical.
+
+    ``window`` (causal only) composes the Mistral sliding-window band with
+    the document mask — a key never attends across documents OR further
+    than ``window - 1`` positions back."""
     o, _ = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                     _auto_interpret(interpret), q_segment_ids, kv_segment_ids)
+                     _auto_interpret(interpret), q_segment_ids, kv_segment_ids,
+                     window=window)
     return o
 
 
-def _fa_seg_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k, interpret):
+def _fa_seg_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+                interpret, window):
     o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                       _auto_interpret(interpret), q_seg, kv_seg)
+                       _auto_interpret(interpret), q_seg, kv_seg, window=window)
     return o, (q, k, v, q_seg, kv_seg, o, lse)
 
 
-def _fa_seg_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+def _fa_seg_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, do):
     q, k, v, q_seg, kv_seg, o, lse = res
     delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     dq, dk, dv = _bwd_impl(
         q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
-        _auto_interpret(interpret), q_seg, kv_seg,
+        _auto_interpret(interpret), q_seg, kv_seg, window=window,
     )
     return dq, dk, dv, _float0_like(q_seg), _float0_like(kv_seg)
 
@@ -623,7 +669,7 @@ def _fa_seg_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 flash_attention_segmented.defvjp(_fa_seg_fwd, _fa_seg_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def flash_attention_segmented_with_lse(
     q: jax.Array,
     k: jax.Array,
@@ -635,6 +681,7 @@ def flash_attention_segmented_with_lse(
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """:func:`flash_attention_segmented` that also returns the per-row
     logsumexp ``[B, HQ, S]`` (fp32) — the combinable partial form ring
@@ -647,25 +694,26 @@ def flash_attention_segmented_with_lse(
     lse cotangent into the delta correction exactly as
     :func:`flash_attention_with_lse` does."""
     o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                       _auto_interpret(interpret), q_segment_ids, kv_segment_ids)
+                       _auto_interpret(interpret), q_segment_ids, kv_segment_ids,
+                       window=window)
     return o, lse[..., 0]
 
 
 def _fa_seg_lse_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
-                    interpret):
+                    interpret, window):
     o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                       _auto_interpret(interpret), q_seg, kv_seg)
+                       _auto_interpret(interpret), q_seg, kv_seg, window=window)
     return (o, lse[..., 0]), (q, k, v, q_seg, kv_seg, o, lse)
 
 
-def _fa_seg_lse_bwd(causal, sm_scale, block_q, block_k, interpret, res, cts):
+def _fa_seg_lse_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, cts):
     q, k, v, q_seg, kv_seg, o, lse = res
     do, dlse = cts
     delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta_rows = delta_rows - dlse.astype(jnp.float32)
     dq, dk, dv = _bwd_impl(
         q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
-        _auto_interpret(interpret), q_seg, kv_seg,
+        _auto_interpret(interpret), q_seg, kv_seg, window=window,
     )
     return dq, dk, dv, _float0_like(q_seg), _float0_like(kv_seg)
 
