@@ -64,7 +64,11 @@ LADDER = [
     ("tpu", "dense", 2, "selective", "mean"),
     ("cpu", "dense", 2, "none", "mean"),
 ]
-ATTEMPT_TIMEOUT_S = 900
+# The 2026-07-31 healthy window measured >24-minute cold compiles on the big
+# train-step programs (remote compile service, zero local CPU) — 900s killed
+# rungs mid-compile.  With the persistent cache warm an attempt needs
+# seconds, so the long budget only ever bites on the first cold program.
+ATTEMPT_TIMEOUT_S = 2400
 PROBE_TIMEOUT_S = 420
 RETRY_SLEEP_S = 20
 

@@ -40,20 +40,25 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 DEFAULT_RESULTS = os.path.join(REPO, "docs", "tpu_watch_results.jsonl")
 
-# Ladder measured when healthy, best-first.  Mirrors bench.py's TPU rungs;
-# the watcher runs ALL of them (not first-success-wins) so a single healthy
-# window yields the full batch/remat/loss picture.
+# Ladder measured when healthy.  Round-5 lesson (04:00Z window): the tunnel's
+# compile service can take >25 min on the big train-step programs — rung
+# order is cheapest-compile-first so a short window still banks (a) an
+# end-to-end validated number and (b) persistent-cache entries, before the
+# expensive money rungs.  (flash,8,selective,mean) is the round-3-proven
+# program; the chunked b16 rungs are the >=0.35-MFU vehicles.
 MEASURE = [
-    ("flash", 16, "none", "chunked:512"),
+    ("dense", 2, "selective", "mean"),       # canary: smallest program
+    ("flash", 8, "selective", "mean"),       # round-3 headline config
+    ("flash", 16, "none", "chunked:512"),    # money rung
     ("flash", 16, "selective", "chunked:512"),
     ("flash", 8, "none", "chunked:512"),
-    ("flash", 8, "none", "mean"),
-    ("flash", 8, "selective", "mean"),
-    ("dense", 8, "selective", "mean"),
 ]
 
 PROBE_TIMEOUT_S = 180
-MEASURE_TIMEOUT_S = 1500
+# Must cover a cold compile of the biggest rung: the 2026-07-31 window showed
+# >24 min compiles with zero local CPU (remote compile service); 1500s killed
+# two rungs mid-compile and threw the window away.
+MEASURE_TIMEOUT_S = 2700
 
 
 def utcnow() -> str:
@@ -153,15 +158,23 @@ def main() -> int:
     args = p.parse_args()
 
     extra_done = False
+    succeeded: set = set()
     cycle = 0
     while True:
         cycle += 1
         ok, msg = probe()
         append(args.results, {"kind": "probe", "ok": ok, "detail": msg})
         if ok:
-            for attn, batch, remat, loss in MEASURE:
-                rec = measure(attn, batch, remat, loss)
+            for rung in MEASURE:
+                # a rung that already produced a number this watcher run is
+                # banked — don't re-burn window time on it; unmeasured rungs
+                # get every healthy window until they land
+                if rung in succeeded:
+                    continue
+                rec = measure(*rung)
                 append(args.results, rec)
+                if rec.get("ok"):
+                    succeeded.add(rung)
             if not extra_done:
                 run_extra_jobs(args.results)
                 extra_done = True
