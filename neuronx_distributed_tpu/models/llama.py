@@ -211,18 +211,9 @@ def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def _causal_mask(q_len: int, kv_len: int, q_offset, window=None) -> jax.Array:
-    """Boolean [q_len, kv_len] mask, True = attend; q position i (global
-    ``i + q_offset``) attends kv positions <= its own — and, with a sliding
-    ``window``, no further back than ``window - 1`` positions."""
-    if window is not None and window < 1:
-        raise ValueError(f"sliding window must be >= 1, got {window}")
-    q_pos = jnp.arange(q_len)[:, None] + q_offset
-    kv_pos = jnp.arange(kv_len)[None, :]
-    mask = kv_pos <= q_pos
-    if window is not None:
-        mask = jnp.logical_and(mask, kv_pos > q_pos - window)
-    return mask
+# the one shared causal(+sliding-window) mask definition — the dense core
+# must agree with the kernel oracle by construction, not by parallel edits
+from neuronx_distributed_tpu.ops.flash_attention import band_mask as _causal_mask  # noqa: E402
 
 
 class CoreAttention(nn.Module):

@@ -81,6 +81,24 @@ def _block_sizes(s: int, t: int, block_q: int, block_k: int) -> Tuple[int, int]:
     return fit(s, block_q), fit(t, block_k)
 
 
+def band_mask(q_len: int, kv_len: int, q_offset=0,
+              window: Optional[int] = None) -> jax.Array:
+    """Boolean ``[q_len, kv_len]`` causal(+sliding-window) mask, True =
+    attend: q position i (global ``i + q_offset``) attends kv positions
+    ``<=`` its own, and — with ``window`` — no further back than
+    ``window - 1`` positions.  The ONE band-mask definition shared by the
+    dense model core, the dense chunk oracle, and :func:`mha_reference`
+    (the pallas kernels apply the same inequalities blockwise)."""
+    if window is not None and window < 1:
+        raise ValueError(f"sliding window must be >= 1, got {window}")
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    mask = kv_pos <= q_pos
+    if window is not None:
+        mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+    return mask
+
+
 def mha_reference(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
     sm_scale: Optional[float] = None, window: Optional[int] = None,
@@ -96,12 +114,7 @@ def mha_reference(
     vv = jnp.repeat(v, G, axis=1)
     s = jnp.einsum("bhsd,bhtd->bhst", q, kk, preferred_element_type=jnp.float32) * scale
     if causal:
-        # same arange-comparison form as every other band-mask site
-        q_pos = jnp.arange(q.shape[2])[:, None] + (k.shape[2] - q.shape[2])
-        kv_pos = jnp.arange(k.shape[2])[None, :]
-        mask = kv_pos <= q_pos
-        if window is not None:
-            mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+        mask = band_mask(q.shape[2], k.shape[2], k.shape[2] - q.shape[2], window)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhst,bhtd->bhsd", p.astype(v.dtype), vv, preferred_element_type=q.dtype)

@@ -43,6 +43,7 @@ from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_tpu.ops.flash_attention import (
     NEG_INF,
+    band_mask,
     flash_attention_segmented,
     flash_attention_segmented_with_lse,
     flash_attention_with_lse,
@@ -70,11 +71,7 @@ def _dense_chunk_attn(q, k, v, causal: bool, sm_scale: float,
     vv = jnp.repeat(v, G, axis=1)
     s = jnp.einsum("bhsd,bhtd->bhst", q, kk, preferred_element_type=jnp.float32) * sm_scale
     if causal:
-        q_pos = jnp.arange(q.shape[2])[:, None] + (k.shape[2] - q.shape[2])
-        kv_pos = jnp.arange(k.shape[2])[None, :]
-        mask = kv_pos <= q_pos
-        if window is not None:
-            mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+        mask = band_mask(q.shape[2], k.shape[2], k.shape[2] - q.shape[2], window)
         s = jnp.where(mask[None, None], s, NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B,HQ,S]
     p = jnp.exp(s - lse[..., None])

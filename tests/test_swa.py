@@ -226,6 +226,34 @@ def test_llama_swa_flash_matches_dense(devices8):
     )
 
 
+def test_swa_cached_decode_matches_teacher_forcing(devices8):
+    """Serving with a sliding window: the cached decode path (dense core +
+    band mask over the full cache) must reproduce the cacheless model's
+    greedy continuation at every step.  window=5 < generated length, so the
+    band genuinely bites mid-decode."""
+    from conftest import sharded_params
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.trace import InferenceConfig, ParallelInferenceModel
+
+    initialize_model_parallel(tensor_parallel_size=8, devices=devices8)
+    cfg = LlamaConfig.tiny(
+        sequence_parallel=False, dtype=jnp.float32, param_dtype=jnp.float32,
+        max_seq_len=32, remat="none", sliding_window=5,
+    )
+    module = LlamaForCausalLM(cfg)
+    params = sharded_params(
+        module.init(jax.random.PRNGKey(12), jnp.zeros((2, 8), jnp.int32)))
+    model = ParallelInferenceModel(
+        module, params,
+        InferenceConfig(batch_size=2, context_len=8, max_total_len=16))
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (2, 8), 0, cfg.vocab_size)
+    out = model.generate(prompt, max_new_tokens=6)
+    full_logits = jax.jit(module.apply)(params, out)
+    for t in range(8, 14):
+        pred = np.asarray(jnp.argmax(full_logits[:, t - 1, :], axis=-1))
+        np.testing.assert_array_equal(pred, np.asarray(out[:, t]), err_msg=f"pos {t}")
+
+
 def test_llama_swa_changes_logits(devices8):
     """The window must actually change attention for sequences longer than
     the window (guards against the flag silently not reaching the core)."""
