@@ -68,6 +68,16 @@ class LlamaConfig:
     head_dim: Optional[int] = None
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
+    # Llama-3.1-style "llama3" RoPE frequency scaling for long-context
+    # checkpoints: factor > 1 enables it.  Low-frequency components (long
+    # wavelengths, > orig_len/low_freq_factor) are slowed by `factor`;
+    # high-frequency ones (wavelength < orig_len/high_freq_factor) are kept;
+    # the band between interpolates smoothly.  Scalar fields rather than a
+    # dict so the frozen config stays hashable for flax.
+    rope_scaling_factor: float = 1.0
+    rope_scaling_low_freq_factor: float = 1.0
+    rope_scaling_high_freq_factor: float = 4.0
+    rope_scaling_original_max_seq: int = 8192
     rms_eps: float = 1e-5
     sequence_parallel: bool = True
     # biases on the q/k/v projections (Qwen2's one architectural delta from
@@ -132,6 +142,15 @@ class LlamaConfig:
     def head_dim_(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
 
+    @property
+    def rope_scaling_(self):
+        """``(factor, low, high, original_max_seq)`` or None when off."""
+        if self.rope_scaling_factor == 1.0:
+            return None
+        return (self.rope_scaling_factor, self.rope_scaling_low_freq_factor,
+                self.rope_scaling_high_freq_factor,
+                self.rope_scaling_original_max_seq)
+
     @staticmethod
     def llama2_7b(**overrides) -> "LlamaConfig":
         return LlamaConfig(**{**dict(
@@ -165,6 +184,18 @@ class LlamaConfig:
             qkv_bias=True, rms_eps=1e-6), **overrides})
 
     @staticmethod
+    def llama31_8b(**overrides) -> "LlamaConfig":
+        """Llama-3.1-8B: the 3.0 layout + "llama3" RoPE scaling (factor 8,
+        128k context); max_seq_len defaults to 8192 here — raise it (and
+        shard the sequence over cp) for genuine long-context runs."""
+        return LlamaConfig(**{**dict(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+            rope_scaling_factor=8.0, rope_scaling_low_freq_factor=1.0,
+            rope_scaling_high_freq_factor=4.0,
+            rope_scaling_original_max_seq=8192), **overrides})
+
+    @staticmethod
     def mistral_7b(**overrides) -> "LlamaConfig":
         """Mistral-7B-v0.1: Llama architecture + GQA kv8 + 4096-token
         sliding-window attention (the SWA reference family; the window is
@@ -192,10 +223,39 @@ class LlamaConfig:
             num_layers=2, num_heads=8, num_kv_heads=8, max_seq_len=128), **overrides})
 
 
-def rope_sin_cos(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+def llama3_scale_freqs(
+    inv_freq: jax.Array,
+    factor: float,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_seq: int = 8192,
+) -> jax.Array:
+    """Llama-3.1 "llama3" RoPE frequency scaling (the published NTK-by-parts
+    rule, HF ``rope_scaling={"rope_type": "llama3", ...}``): components
+    whose wavelength exceeds ``original_max_seq / low_freq_factor`` are
+    slowed by ``factor``; those below ``original_max_seq /
+    high_freq_factor`` are untouched; the band between interpolates
+    linearly in ``original_max_seq / wavelength``."""
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_wl = original_max_seq / low_freq_factor
+    high_wl = original_max_seq / high_freq_factor
+    smooth = (original_max_seq / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    mid = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    scaled = jnp.where(wavelen > low_wl, inv_freq / factor, mid)
+    return jnp.where(wavelen < high_wl, inv_freq, scaled)
+
+
+def rope_sin_cos(positions: jax.Array, head_dim: int, theta: float,
+                 scaling=None) -> Tuple[jax.Array, jax.Array]:
     """RoPE tables in fp32 for the given positions ``[...s]`` →
-    ``(sin, cos)`` of shape ``[..., s, head_dim/2]``."""
+    ``(sin, cos)`` of shape ``[..., s, head_dim/2]``.  ``scaling`` is the
+    optional Llama-3.1 tuple ``(factor, low_freq_factor, high_freq_factor,
+    original_max_seq)``."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling is not None:
+        inv_freq = llama3_scale_freqs(inv_freq, *scaling)
     angles = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.sin(angles), jnp.cos(angles)
 
@@ -317,7 +377,7 @@ class LlamaAttention(nn.Module):
             param_dtype=cfg.param_dtype,
             name="qkv",
         )(x)
-        sin, cos = rope_sin_cos(positions, D, cfg.rope_theta)
+        sin, cos = rope_sin_cos(positions, D, cfg.rope_theta, cfg.rope_scaling_)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 

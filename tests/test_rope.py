@@ -1,0 +1,102 @@
+"""Llama-3.1 "llama3" RoPE frequency-scaling tests.
+
+The ground truth is Hugging Face transformers' published implementation
+(`modeling_rope_utils.ROPE_INIT_FUNCTIONS["llama3"]`, available in the baked
+image) — the same function that produced the Llama-3.1 checkpoints' training
+phases, so matching it bit-for-bit is what makes imported 3.1 weights
+behave.  A hand-derived oracle backs it up in case the transformers version
+drifts.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.models.llama import (
+    LlamaConfig,
+    llama3_scale_freqs,
+    rope_sin_cos,
+)
+
+FACTOR, LOW, HIGH, ORIG = 8.0, 1.0, 4.0, 8192
+
+
+def _base_inv_freq(head_dim, theta):
+    return 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+
+
+def test_llama3_scaling_matches_transformers():
+    try:
+        from transformers import LlamaConfig as HFLlamaConfig
+        from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+    except ImportError:
+        pytest.skip("transformers rope utils unavailable")
+    hf_cfg = HFLlamaConfig(
+        hidden_size=4096, num_attention_heads=32, rope_theta=500000.0,
+        rope_scaling={"rope_type": "llama3", "factor": FACTOR,
+                      "low_freq_factor": LOW, "high_freq_factor": HIGH,
+                      "original_max_position_embeddings": ORIG},
+    )
+    inv_hf, attention_scaling = ROPE_INIT_FUNCTIONS["llama3"](hf_cfg, device="cpu")
+    assert attention_scaling == 1.0  # llama3 scaling never rescales attention
+    ours = llama3_scale_freqs(
+        jnp.asarray(_base_inv_freq(128, 500000.0), jnp.float32),
+        FACTOR, LOW, HIGH, ORIG,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours), np.asarray(inv_hf, dtype=np.float32), rtol=1e-6, atol=0
+    )
+
+
+def test_llama3_scaling_band_structure():
+    """High-frequency components untouched, low-frequency slowed by exactly
+    `factor`, everything monotonically between."""
+    base = jnp.asarray(_base_inv_freq(128, 500000.0), jnp.float32)
+    scaled = llama3_scale_freqs(base, FACTOR, LOW, HIGH, ORIG)
+    wavelen = 2.0 * np.pi / np.asarray(base)
+    keep = wavelen < ORIG / HIGH
+    slow = wavelen > ORIG / LOW
+    np.testing.assert_array_equal(np.asarray(scaled)[keep], np.asarray(base)[keep])
+    np.testing.assert_allclose(
+        np.asarray(scaled)[slow], np.asarray(base)[slow] / FACTOR, rtol=1e-6)
+    mid = ~keep & ~slow
+    assert (np.asarray(scaled)[mid] <= np.asarray(base)[mid]).all()
+    assert (np.asarray(scaled)[mid] >= np.asarray(base)[mid] / FACTOR).all()
+
+
+def test_rope_sin_cos_scaling_wiring():
+    """factor == 1.0 keeps the exact unscaled tables; the llama31 preset's
+    tables differ at long positions but agree at position 0."""
+    pos = jnp.arange(64)
+    s0, c0 = rope_sin_cos(pos, 128, 500000.0)
+    cfg_off = LlamaConfig.llama3_8b()
+    assert cfg_off.rope_scaling_ is None
+    cfg_on = LlamaConfig.llama31_8b()
+    assert cfg_on.rope_scaling_ == (FACTOR, LOW, HIGH, ORIG)
+    s1, c1 = rope_sin_cos(pos, 128, 500000.0, cfg_on.rope_scaling_)
+    np.testing.assert_array_equal(np.asarray(s1[0]), np.asarray(s0[0]))  # pos 0
+    assert float(jnp.abs(s1 - s0).max()) > 1e-3  # scaling actually bites
+
+
+def test_llama31_model_runs_and_differs():
+    """Tiny model with 3.1 scaling: finite logits, different from unscaled
+    at positions past the interpolation knee."""
+    import jax
+
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+
+    base = dict(sequence_parallel=False, dtype=jnp.float32,
+                param_dtype=jnp.float32, max_seq_len=64, rope_theta=10000.0)
+    # tiny head_dim keeps wavelengths short; shrink ORIG so the band bites
+    # within 64 positions
+    cfg_s = LlamaConfig.tiny(rope_scaling_factor=4.0,
+                             rope_scaling_original_max_seq=32, **base)
+    cfg_n = LlamaConfig.tiny(**base)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 64), 0, cfg_s.vocab_size)
+    model_s = LlamaForCausalLM(cfg_s)
+    model_n = LlamaForCausalLM(cfg_n)
+    params = model_n.init(jax.random.PRNGKey(1), ids)
+    ls = model_s.apply(params, ids)
+    ln = model_n.apply(params, ids)
+    assert np.isfinite(np.asarray(ls)).all()
+    assert float(jnp.abs(ls - ln).max()) > 1e-4
