@@ -72,53 +72,59 @@ def llama_unstack_layers(params: Mapping[str, Any], num_layers: int) -> Dict[str
     return {"params": out} if "params" in params else out
 
 
+def _decoder_layer_from_hf(sd: Mapping[str, np.ndarray], p: str, cfg,
+                           norm_offset: float = 0.0) -> Dict[str, Any]:
+    """One HF Llama-layout decoder layer (prefix ``p``) → the shared
+    ``LlamaBlock`` param subtree.  ``norm_offset`` folds Gemma's ``(1+w)``
+    RMSNorm convention into the stored weight."""
+    H, D = cfg.hidden_size, cfg.head_dim_
+    NQ, NKV = cfg.num_heads, cfg.num_kv_heads
+    qkv = {
+        "q_kernel": sd[p + "self_attn.q_proj.weight"].T.reshape(H, NQ, D),
+        "k_kernel": sd[p + "self_attn.k_proj.weight"].T.reshape(H, NKV, D),
+        "v_kernel": sd[p + "self_attn.v_proj.weight"].T.reshape(H, NKV, D),
+    }
+    if getattr(cfg, "qkv_bias", False):
+        # Qwen2: biased q/k/v projections
+        qkv["q_bias"] = sd[p + "self_attn.q_proj.bias"].reshape(NQ, D)
+        qkv["k_bias"] = sd[p + "self_attn.k_proj.bias"].reshape(NKV, D)
+        qkv["v_bias"] = sd[p + "self_attn.v_proj.bias"].reshape(NKV, D)
+    elif p + "self_attn.q_proj.bias" in sd:
+        raise ValueError(
+            "HF checkpoint carries QKV biases (Qwen2-style) but the "
+            "config has qkv_bias=False — converting would silently zero "
+            "them; build the config with qkv_bias=True"
+        )
+    return {
+        "attn": {
+            "qkv": qkv,
+            "o_proj": {"kernel": sd[p + "self_attn.o_proj.weight"].T},
+        },
+        "mlp": {
+            "gate_up": {
+                "kernel": np.stack(
+                    [sd[p + "mlp.gate_proj.weight"].T, sd[p + "mlp.up_proj.weight"].T],
+                    axis=1,
+                )  # [H, 2, I]
+            },
+            "down": {"kernel": sd[p + "mlp.down_proj.weight"].T},
+        },
+        "input_norm": {"weight": sd[p + "input_layernorm.weight"] + norm_offset},
+        "post_attn_norm": {"weight": sd[p + "post_attention_layernorm.weight"] + norm_offset},
+    }
+
+
 def llama_params_from_hf(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
     """HF ``LlamaForCausalLM.state_dict()`` → framework param tree for
     :class:`~..models.llama.LlamaForCausalLM` with config ``cfg`` (scanned
     layout when ``cfg.scan_layers``)."""
     sd = {k: _np(v) for k, v in state_dict.items()}
-    H, D = cfg.hidden_size, cfg.head_dim_
-    NQ, NKV, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
-
     model: Dict[str, Any] = {
         "embed": {"embedding": sd["model.embed_tokens.weight"]},
         "final_norm": {"weight": sd["model.norm.weight"]},
     }
     for i in range(cfg.num_layers):
-        p = f"model.layers.{i}."
-        qkv = {
-            "q_kernel": sd[p + "self_attn.q_proj.weight"].T.reshape(H, NQ, D),
-            "k_kernel": sd[p + "self_attn.k_proj.weight"].T.reshape(H, NKV, D),
-            "v_kernel": sd[p + "self_attn.v_proj.weight"].T.reshape(H, NKV, D),
-        }
-        if getattr(cfg, "qkv_bias", False):
-            # Qwen2: biased q/k/v projections
-            qkv["q_bias"] = sd[p + "self_attn.q_proj.bias"].reshape(NQ, D)
-            qkv["k_bias"] = sd[p + "self_attn.k_proj.bias"].reshape(NKV, D)
-            qkv["v_bias"] = sd[p + "self_attn.v_proj.bias"].reshape(NKV, D)
-        elif p + "self_attn.q_proj.bias" in sd:
-            raise ValueError(
-                "HF checkpoint carries QKV biases (Qwen2-style) but the "
-                "config has qkv_bias=False — converting would silently zero "
-                "them; build the config with qkv_bias=True"
-            )
-        model[f"layer_{i}"] = {
-            "attn": {
-                "qkv": qkv,
-                "o_proj": {"kernel": sd[p + "self_attn.o_proj.weight"].T},
-            },
-            "mlp": {
-                "gate_up": {
-                    "kernel": np.stack(
-                        [sd[p + "mlp.gate_proj.weight"].T, sd[p + "mlp.up_proj.weight"].T],
-                        axis=1,
-                    )  # [H, 2, I]
-                },
-                "down": {"kernel": sd[p + "mlp.down_proj.weight"].T},
-            },
-            "input_norm": {"weight": sd[p + "input_layernorm.weight"]},
-            "post_attn_norm": {"weight": sd[p + "post_attention_layernorm.weight"]},
-        }
+        model[f"layer_{i}"] = _decoder_layer_from_hf(sd, f"model.layers.{i}.", cfg)
     lm_head = sd.get("lm_head.weight")
     if lm_head is None:  # tied-embedding HF checkpoints omit it
         lm_head = sd["model.embed_tokens.weight"]
@@ -442,3 +448,57 @@ def gpt_neox_params_from_pipelined(pparams: Mapping[str, Any], layer_rows) -> Di
 
 mistral_params_from_hf = llama_params_from_hf
 mistral_params_to_hf = llama_params_to_hf
+
+
+# ---------------------------------------------------------------------------
+# Gemma: Llama-layout layers + tied embedding head + (1 + w) RMSNorm
+# ---------------------------------------------------------------------------
+
+
+def gemma_params_from_hf(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
+    """HF ``GemmaForCausalLM.state_dict()`` → framework param tree for
+    :class:`~..models.gemma.GemmaForCausalLM`.
+
+    HF Gemma's RMSNorm computes ``x * (1 + weight)``; the framework's
+    computes ``x * weight`` — every norm weight gets ``+1`` folded in here
+    (bit-equivalent in fp32: the sum is formed once, outside the graph).
+    The LM head is the tied embedding table, so no head tensor exists in
+    either layout."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    block_cfg = cfg.block_config()
+    tree: Dict[str, Any] = {
+        "embed": {"embedding": sd["model.embed_tokens.weight"]},
+        "final_norm": {"weight": sd["model.norm.weight"] + 1.0},
+    }
+    for i in range(cfg.num_layers):
+        tree[f"layer_{i}"] = _decoder_layer_from_hf(
+            sd, f"model.layers.{i}.", block_cfg, norm_offset=1.0)
+    return {"params": tree}
+
+
+def gemma_params_to_hf(params: Mapping[str, Any], cfg) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`gemma_params_from_hf` (framework → HF state dict,
+    norm weights shifted back by ``-1``)."""
+    tree = params.get("params", params)
+    H = cfg.hidden_size
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(tree["embed"]["embedding"]),
+        "model.norm.weight": _np(tree["final_norm"]["weight"]) - 1.0,
+    }
+    for i in range(cfg.num_layers):
+        lyr = tree[f"layer_{i}"]
+        p = f"model.layers.{i}."
+        qkv = lyr["attn"]["qkv"]
+        gu = _np(lyr["mlp"]["gate_up"]["kernel"])  # [H, 2, I]
+        out.update({
+            p + "self_attn.q_proj.weight": _np(qkv["q_kernel"]).reshape(H, -1).T,
+            p + "self_attn.k_proj.weight": _np(qkv["k_kernel"]).reshape(H, -1).T,
+            p + "self_attn.v_proj.weight": _np(qkv["v_kernel"]).reshape(H, -1).T,
+            p + "self_attn.o_proj.weight": _np(lyr["attn"]["o_proj"]["kernel"]).T,
+            p + "mlp.gate_proj.weight": gu[:, 0, :].T,
+            p + "mlp.up_proj.weight": gu[:, 1, :].T,
+            p + "mlp.down_proj.weight": _np(lyr["mlp"]["down"]["kernel"]).T,
+            p + "input_layernorm.weight": _np(lyr["input_norm"]["weight"]) - 1.0,
+            p + "post_attention_layernorm.weight": _np(lyr["post_attn_norm"]["weight"]) - 1.0,
+        })
+    return out

@@ -11,6 +11,10 @@ from neuronx_distributed_tpu.models.bert import (
     BertForPreTraining,
     BertModel,
 )
+from neuronx_distributed_tpu.models.gemma import (
+    GemmaConfig,
+    GemmaForCausalLM,
+)
 from neuronx_distributed_tpu.models.gpt_neox import (
     GPTNeoXConfig,
     GPTNeoXForCausalLM,
@@ -28,6 +32,8 @@ __all__ = [
     "BertConfig",
     "BertForPreTraining",
     "BertModel",
+    "GemmaConfig",
+    "GemmaForCausalLM",
     "GPTNeoXConfig",
     "GPTNeoXForCausalLM",
     "LlamaConfig",

@@ -1,0 +1,151 @@
+"""Gemma family (Gemma-1 2B/7B) — tied-embedding decoder on the shared
+Llama block stack.
+
+Architecture deltas from Llama (all expressed as composition, no new
+parallel primitives):
+
+- **GeGLU MLP**: tanh-approximate gelu gate (``LlamaConfig.mlp_activation=
+  "gelu_tanh"``) instead of SiLU;
+- **embedding scaling**: hidden states scaled by ``sqrt(hidden_size)``
+  after the embedding lookup (cast to the compute dtype, matching HF's
+  ``normalizer`` exactly);
+- **tied LM head**: logits come from ``ParallelEmbedding.attend`` — literal
+  param reuse of the vocab-sharded table (the reference framework handles
+  tying via shared-weight process groups, ``pipeline/partition.py:225-250``;
+  here it is the same array);
+- **(1 + w) RMSNorm convention**: HF Gemma computes ``x * (1 + weight)``;
+  the converter folds the ``+1`` into the stored weight so the framework's
+  standard :class:`~..parallel.norm.RMSNorm` is bit-equivalent;
+- ``head_dim`` decoupled from ``hidden_size / num_heads`` (256 at both
+  scales) — already first-class in the block stack.
+
+The KV-cache protocol matches :class:`~.llama.LlamaForCausalLM`
+(``apply(params, ids, positions, caches, offset, kv_valid=...)``), so the
+serving engine (:mod:`~..trace.engine`) drives Gemma unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from neuronx_distributed_tpu.models.common import maybe_remat
+from neuronx_distributed_tpu.models.llama import LlamaBlock, LlamaConfig
+from neuronx_distributed_tpu.parallel.layers import (
+    ParallelEmbedding,
+    shard_activation,
+    trailing_spec,
+)
+from neuronx_distributed_tpu.parallel.norm import RMSNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmaConfig:
+    vocab_size: int = 256000
+    hidden_size: int = 3072
+    intermediate_size: int = 24576
+    num_layers: int = 28
+    num_heads: int = 16
+    num_kv_heads: int = 16
+    head_dim: int = 256
+    max_seq_len: int = 8192
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    sequence_parallel: bool = True
+    remat: str = "selective"
+    attention_impl: str = "dense"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def block_config(self) -> LlamaConfig:
+        """The shared decoder-block config (GeGLU selected here)."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads,
+            head_dim=self.head_dim,
+            max_seq_len=self.max_seq_len,
+            rope_theta=self.rope_theta,
+            rms_eps=self.rms_eps,
+            sequence_parallel=self.sequence_parallel,
+            remat=self.remat,
+            attention_impl=self.attention_impl,
+            mlp_activation="gelu_tanh",
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+
+    @staticmethod
+    def gemma_2b(**overrides) -> "GemmaConfig":
+        """Gemma-2B: MQA (1 kv head), head_dim 256."""
+        return GemmaConfig(**{**dict(
+            hidden_size=2048, intermediate_size=16384, num_layers=18,
+            num_heads=8, num_kv_heads=1), **overrides})
+
+    @staticmethod
+    def gemma_7b(**overrides) -> "GemmaConfig":
+        return GemmaConfig(**overrides)
+
+    @staticmethod
+    def tiny(**overrides) -> "GemmaConfig":
+        return GemmaConfig(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=8, num_kv_heads=2, head_dim=16,
+            max_seq_len=128), **overrides})
+
+
+class GemmaForCausalLM(nn.Module):
+    """Tied-embedding causal LM over the shared block stack."""
+
+    config: GemmaConfig
+
+    @nn.compact
+    def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
+                 kv_valid=None, segment_ids=None):
+        cfg = self.config
+        bcfg = self.config.block_config()
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+
+        emb = ParallelEmbedding(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            sequence_parallel_output=cfg.sequence_parallel and kv_caches is None,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="embed",
+        )
+        h = emb(ids)
+        # HF Gemma: hidden *= tensor(sqrt(H), dtype=hidden.dtype) — the cast
+        # happens BEFORE the multiply, so match it exactly
+        h = h * jnp.asarray(cfg.hidden_size ** 0.5, h.dtype)
+
+        block_cls = maybe_remat(LlamaBlock, cfg.remat)
+        new_caches = []
+        for i in range(cfg.num_layers):
+            cache = kv_caches[i] if kv_caches is not None else None
+            if kv_caches is not None:
+                h, c = LlamaBlock(bcfg, name=f"layer_{i}")(
+                    h, positions, cache, cache_offset, kv_valid, segment_ids)
+            else:
+                h, c = block_cls(bcfg, name=f"layer_{i}")(
+                    h, positions, None, 0, kv_valid, segment_ids)
+            new_caches.append(c)
+        h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    name="final_norm")(h)
+        if cfg.sequence_parallel and kv_caches is None:
+            # gather the sequence back before the tied head matmul
+            h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))
+        logits = emb.attend(h)
+        return (logits, new_caches) if kv_caches is not None else logits
+
+    def hidden(self, ids, positions=None, kv_valid=None, segment_ids=None):
+        raise NotImplementedError(
+            "Gemma's chunked-loss-head protocol would need the tied table "
+            "inside the loss chunk; use causal_lm_loss (mean) for Gemma")

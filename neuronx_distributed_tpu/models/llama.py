@@ -83,6 +83,9 @@ class LlamaConfig:
     # biases on the q/k/v projections (Qwen2's one architectural delta from
     # Llama; everything else — GQA, SwiGLU, RMSNorm, RoPE — is shared)
     qkv_bias: bool = False
+    # gated-MLP activation: "silu" (Llama/Mistral SwiGLU) or "gelu_tanh"
+    # (Gemma GeGLU — tanh-approximate gelu, HF ``gelu_pytorch_tanh``)
+    mlp_activation: str = "silu"
     # Mistral-style causal sliding-window attention: query at position p
     # attends keys in [p - sliding_window + 1, p].  On the flash path the
     # band is enforced in-kernel with out-of-band KV blocks skipped in the
@@ -434,7 +437,12 @@ class LlamaMLP(nn.Module):
             name="gate_up",
         )(x)
         gate, up = gate_up[..., 0, :], gate_up[..., 1, :]
-        h = jax.nn.silu(gate) * up
+        if cfg.mlp_activation == "silu":
+            h = jax.nn.silu(gate) * up
+        elif cfg.mlp_activation == "gelu_tanh":
+            h = jax.nn.gelu(gate, approximate=True) * up
+        else:
+            raise ValueError(f"unknown mlp_activation {cfg.mlp_activation!r}")
         return RowParallelLinear(
             features=cfg.hidden_size,
             use_bias=False,

@@ -1,0 +1,123 @@
+"""Gemma family tests: HF logits parity (ground truth: transformers'
+GemmaForCausalLM torch forward), tied-head wiring, converter roundtrip,
+and a sharded train step.
+
+Same methodology as test_hf_convert.py — build a tiny random-init HF model,
+convert its state dict, compare logits on the 8-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.convert import gemma_params_from_hf, gemma_params_to_hf
+from neuronx_distributed_tpu.models.gemma import GemmaConfig, GemmaForCausalLM
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _tiny_pair():
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        hidden_act="gelu_pytorch_tanh", hidden_activation="gelu_pytorch_tanh",
+    )
+    cfg = GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=8, num_kv_heads=2, head_dim=16, max_seq_len=64,
+        rms_eps=1e-6, sequence_parallel=False, remat="none",
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    return hf_cfg, cfg
+
+
+def test_gemma_logits_parity(devices8):
+    hf_cfg, cfg = _tiny_pair()
+    torch.manual_seed(0)
+    hf = transformers.GemmaForCausalLM(hf_cfg).eval().float()
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2)
+    params = jax.tree.map(jnp.asarray, gemma_params_from_hf(hf.state_dict(), cfg))
+    model = GemmaForCausalLM(cfg)
+    got = jax.jit(model.apply)(params, jnp.asarray(ids.numpy()))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma_converter_roundtrip(devices8):
+    hf_cfg, cfg = _tiny_pair()
+    torch.manual_seed(1)
+    hf = transformers.GemmaForCausalLM(hf_cfg).eval().float()
+    sd = {k: v for k, v in hf.state_dict().items()}
+    back = gemma_params_to_hf(gemma_params_from_hf(sd, cfg), cfg)
+    # lm_head.weight is tied (absent from both layouts); everything else
+    # must roundtrip exactly
+    want_keys = {k for k in sd if not k.endswith("lm_head.weight")}
+    assert set(back) == want_keys
+    for k in want_keys:
+        np.testing.assert_allclose(
+            back[k], sd[k].numpy(), rtol=1e-6, atol=1e-6, err_msg=k)
+
+
+def test_gemma_tied_head(devices8):
+    """The head really is the embedding table: perturbing one embedding row
+    moves that vocab column's logits everywhere."""
+    from flax import linen as nn
+
+    _, cfg = _tiny_pair()
+    nxd.initialize_model_parallel(tensor_parallel_size=2)
+    model = GemmaForCausalLM(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, cfg.vocab_size)
+    params = nn.unbox(model.init(jax.random.PRNGKey(1), ids))
+    base = model.apply(params, ids)
+    bumped = jax.tree_util.tree_map(lambda x: x, params)
+    emb = bumped["params"]["embed"]["embedding"]
+    bumped["params"]["embed"]["embedding"] = emb.at[7].add(1.0)
+    out = model.apply(bumped, ids)
+    # column 7 changes at every position; (token-7-free input keeps other
+    # columns' changes to zero only at positions not attending token 7 —
+    # just assert column 7 moved)
+    assert float(jnp.abs(out[..., 7] - base[..., 7]).max()) > 1e-3
+
+
+def test_gemma_train_step_loss_decreases(devices8):
+    from neuronx_distributed_tpu.models import causal_lm_loss
+    from neuronx_distributed_tpu.trainer import (
+        default_batch_spec,
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+        make_train_step,
+    )
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2)
+    cfg = GemmaConfig.tiny(sequence_parallel=True, remat="none",
+                           dtype=jnp.float32, param_dtype=jnp.float32)
+    config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3)
+    model = initialize_parallel_model(
+        config, lambda: GemmaForCausalLM(cfg), (jnp.zeros((1, 64), jnp.int32),))
+    opt = initialize_parallel_optimizer(config, model)
+    step = make_train_step(
+        config, model, opt, causal_lm_loss,
+        batch_spec={"ids": default_batch_spec(), "labels": default_batch_spec()})
+    ids = jax.random.randint(jax.random.PRNGKey(2), (8, 64), 0, cfg.vocab_size)
+    data = {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+    params, state = model.params, opt.state
+    losses = []
+    for i in range(8):
+        params, state, m = step(params, state, data, jax.random.PRNGKey(i))
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_gemma_presets():
+    assert GemmaConfig.gemma_2b().num_kv_heads == 1  # MQA
+    assert GemmaConfig.gemma_7b().head_dim == 256
+    assert GemmaConfig.tiny().block_config().mlp_activation == "gelu_tanh"
