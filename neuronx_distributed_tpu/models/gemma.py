@@ -60,6 +60,11 @@ class GemmaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
+    @property
+    def head_dim_(self) -> int:
+        """Protocol-compat with LlamaConfig (the serving engine reads it)."""
+        return self.head_dim
+
     def block_config(self) -> LlamaConfig:
         """The shared decoder-block config (GeGLU selected here)."""
         return LlamaConfig(

@@ -117,6 +117,28 @@ def test_gemma_train_step_loss_decreases(devices8):
     assert losses[-1] < losses[0] - 0.3, losses
 
 
+def test_gemma_cached_decode_matches_teacher_forcing(devices8):
+    """The serving engine drives Gemma through the shared KV-cache protocol:
+    cached greedy decode == the cacheless model's argmax continuation."""
+    from neuronx_distributed_tpu.trace import InferenceConfig, ParallelInferenceModel
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2)
+    _, cfg = _tiny_pair()
+    module = GemmaForCausalLM(cfg)
+    ids0 = jnp.zeros((2, 8), jnp.int32)
+    from conftest import sharded_params
+    params = sharded_params(module.init(jax.random.PRNGKey(3), ids0))
+    model = ParallelInferenceModel(
+        module, params,
+        InferenceConfig(batch_size=2, context_len=8, max_total_len=16))
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 0, cfg.vocab_size)
+    out = model.generate(prompt, max_new_tokens=6)
+    full_logits = jax.jit(module.apply)(params, out)
+    for t in range(8, 14):
+        pred = np.asarray(jnp.argmax(full_logits[:, t - 1, :], axis=-1))
+        np.testing.assert_array_equal(pred, np.asarray(out[:, t]), err_msg=f"pos {t}")
+
+
 def test_gemma_presets():
     assert GemmaConfig.gemma_2b().num_kv_heads == 1  # MQA
     assert GemmaConfig.gemma_7b().head_dim == 256
