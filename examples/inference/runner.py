@@ -185,7 +185,7 @@ def main():
             sp.add_argument("--model", required=True, help="saved artifact dir")
         else:
             sp.add_argument("--preset", default="tiny",
-                            choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b", "qwen2_7b"])
+                            choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b", "llama31_8b", "qwen2_7b", "mistral_7b"])
             sp.add_argument("--tp", type=int, default=1)
             sp.add_argument("--batch-size", type=int, default=1)
             sp.add_argument("--context-len", type=int, default=128)
@@ -215,7 +215,7 @@ def main():
     sp = sub.add_parser("spec-decode", help="speculative decoding: verify + time vs plain greedy")
     common(sp)
     sp.add_argument("--draft-preset", default="tiny",
-                    choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b", "qwen2_7b"],
+                    choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b", "llama31_8b", "qwen2_7b", "mistral_7b"],
                     help="draft model preset (should be much smaller than the target)")
     sp.add_argument("--spec-k", type=int, default=4, help="draft tokens per round")
     sp.set_defaults(fn=cmd_spec_decode)
