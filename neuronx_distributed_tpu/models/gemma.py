@@ -39,6 +39,7 @@ from neuronx_distributed_tpu.parallel.layers import (
     shard_activation,
     trailing_spec,
 )
+from neuronx_distributed_tpu.parallel.mesh import SEQUENCE_AXES
 from neuronx_distributed_tpu.parallel.norm import RMSNorm
 
 
@@ -106,51 +107,73 @@ class GemmaConfig:
 
 
 class GemmaForCausalLM(nn.Module):
-    """Tied-embedding causal LM over the shared block stack."""
+    """Tied-embedding causal LM over the shared block stack.
+
+    setup-style so :meth:`hidden` / :meth:`head` (the chunked-loss-head
+    protocol, ``models.common.make_causal_lm_loss_sum``) can reuse the same
+    tied table the forward uses; the list attribute ``layer`` reproduces the
+    ``layer_i`` param paths the converter writes."""
 
     config: GemmaConfig
 
-    @nn.compact
-    def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
-                 kv_valid=None, segment_ids=None):
+    def setup(self):
         cfg = self.config
-        bcfg = self.config.block_config()
-        if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
-
-        emb = ParallelEmbedding(
+        bcfg = cfg.block_config()
+        self.embed = ParallelEmbedding(
             num_embeddings=cfg.vocab_size,
             features=cfg.hidden_size,
-            sequence_parallel_output=cfg.sequence_parallel and kv_caches is None,
+            # SP entry constraint applied per-phase in _backbone (decode
+            # keeps the sequence unsharded)
+            sequence_parallel_output=False,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
-            name="embed",
         )
-        h = emb(ids)
+        # nn.remat forward cost is zero without a grad, so one wrapped class
+        # serves both the train and cached-decode paths
+        block_cls = maybe_remat(LlamaBlock, cfg.remat)
+        self.layer = [block_cls(bcfg) for _ in range(cfg.num_layers)]
+        self.final_norm = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                                  param_dtype=cfg.param_dtype)
+
+    def _backbone(self, ids, positions, kv_caches, cache_offset, kv_valid,
+                  segment_ids):
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+        h = self.embed(ids)
+        if cfg.sequence_parallel and kv_caches is None:
+            h = shard_activation(
+                h, trailing_spec(h.ndim, seq=SEQUENCE_AXES, last=None))
         # HF Gemma: hidden *= tensor(sqrt(H), dtype=hidden.dtype) — the cast
         # happens BEFORE the multiply, so match it exactly
         h = h * jnp.asarray(cfg.hidden_size ** 0.5, h.dtype)
-
-        block_cls = maybe_remat(LlamaBlock, cfg.remat)
         new_caches = []
-        for i in range(cfg.num_layers):
+        for i, block in enumerate(self.layer):
             cache = kv_caches[i] if kv_caches is not None else None
-            if kv_caches is not None:
-                h, c = LlamaBlock(bcfg, name=f"layer_{i}")(
-                    h, positions, cache, cache_offset, kv_valid, segment_ids)
-            else:
-                h, c = block_cls(bcfg, name=f"layer_{i}")(
-                    h, positions, None, 0, kv_valid, segment_ids)
+            h, c = block(h, positions, cache,
+                         cache_offset if kv_caches is not None else 0,
+                         kv_valid, segment_ids)
             new_caches.append(c)
-        h = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                    name="final_norm")(h)
+        h = self.final_norm(h)
         if cfg.sequence_parallel and kv_caches is None:
             # gather the sequence back before the tied head matmul
             h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))
-        logits = emb.attend(h)
+        return h, new_caches
+
+    def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
+                 kv_valid=None, segment_ids=None):
+        h, new_caches = self._backbone(
+            ids, positions, kv_caches, cache_offset, kv_valid, segment_ids)
+        logits = self.embed.attend(h)
         return (logits, new_caches) if kv_caches is not None else logits
 
     def hidden(self, ids, positions=None, kv_valid=None, segment_ids=None):
-        raise NotImplementedError(
-            "Gemma's chunked-loss-head protocol would need the tied table "
-            "inside the loss chunk; use causal_lm_loss (mean) for Gemma")
+        """Backbone only: final-norm hidden states ``[B, S, H]`` — the input
+        the chunked loss head consumes."""
+        h, _ = self._backbone(ids, positions, None, 0, kv_valid, segment_ids)
+        return h
+
+    def head(self, h):
+        """Vocab-sharded logits for a (chunk of) hidden states via the tied
+        table."""
+        return self.embed.attend(h)

@@ -139,6 +139,29 @@ def test_gemma_cached_decode_matches_teacher_forcing(devices8):
         np.testing.assert_array_equal(pred, np.asarray(out[:, t]), err_msg=f"pos {t}")
 
 
+def test_gemma_chunked_loss_head_matches_mean_loss(devices8):
+    """The chunked loss head (hidden()/head() protocol) must agree with the
+    full-logits mean loss through the tied table."""
+    from neuronx_distributed_tpu.models import (
+        causal_lm_loss,
+        make_causal_lm_loss_sum,
+    )
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2)
+    _, cfg = _tiny_pair()
+    model = GemmaForCausalLM(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(5), (2, 32), 0, cfg.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(6), ids)
+    batch = {"ids": ids, "labels": labels}
+
+    mean_loss = causal_lm_loss(model, params, batch, jax.random.PRNGKey(0))
+    sum_loss_fn = make_causal_lm_loss_sum(chunk_size=8)
+    loss_sum, tok = sum_loss_fn(model, params, batch, jax.random.PRNGKey(0))
+    np.testing.assert_allclose(
+        float(loss_sum) / float(tok), float(mean_loss), rtol=1e-5, atol=1e-6)
+
+
 def test_gemma_presets():
     assert GemmaConfig.gemma_2b().num_kv_heads == 1  # MQA
     assert GemmaConfig.gemma_7b().head_dim == 256
