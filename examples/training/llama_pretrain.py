@@ -34,7 +34,7 @@ import numpy as np
 def parse_args():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--preset", default="tiny",
-                   choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b", "qwen2_7b", "mixtral_8x7b"])
+                   choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b", "llama31_8b", "qwen2_7b", "mistral_7b", "mixtral_8x7b"])
     p.add_argument("--tp", type=int, default=1, help="tensor parallel degree")
     p.add_argument("--pp", type=int, default=1, help="pipeline parallel degree")
     p.add_argument("--microbatches", type=int, default=1,
