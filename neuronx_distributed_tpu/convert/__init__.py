@@ -13,6 +13,8 @@ from neuronx_distributed_tpu.convert.hf import (  # noqa: F401
     bert_params_to_hf,
     gemma_params_from_hf,
     gemma_params_to_hf,
+    gemma2_params_from_hf,
+    gemma2_params_to_hf,
     gpt_neox_params_from_hf,
     gpt_neox_params_from_pipelined,
     gpt_neox_params_to_hf,

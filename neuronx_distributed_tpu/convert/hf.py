@@ -502,3 +502,58 @@ def gemma_params_to_hf(params: Mapping[str, Any], cfg) -> Dict[str, np.ndarray]:
             p + "post_attention_layernorm.weight": _np(lyr["post_attn_norm"]["weight"]) - 1.0,
         })
     return out
+
+
+def gemma2_params_from_hf(state_dict: Mapping[str, Any], cfg) -> Dict[str, Any]:
+    """HF ``Gemma2ForCausalLM.state_dict()`` → framework param tree for
+    :class:`~..models.gemma.Gemma2ForCausalLM` (tied head; every RMSNorm —
+    including the two feedforward sandwich norms — gets the ``+1`` fold)."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    block_cfg = cfg.block_config(sliding=False)  # layout-only use
+    tree: Dict[str, Any] = {
+        "embed": {"embedding": sd["model.embed_tokens.weight"]},
+        "final_norm": {"weight": sd["model.norm.weight"] + 1.0},
+    }
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        lyr = _decoder_layer_from_hf(sd, p, block_cfg, norm_offset=1.0)
+        lyr["pre_ffw_norm"] = {
+            "weight": sd[p + "pre_feedforward_layernorm.weight"] + 1.0}
+        lyr["post_ffw_norm"] = {
+            "weight": sd[p + "post_feedforward_layernorm.weight"] + 1.0}
+        # in Gemma-2 post_attention_layernorm is the post-attn sandwich norm
+        # (same name the framework block uses), already mapped by the helper
+        tree[f"layer_{i}"] = lyr
+    return {"params": tree}
+
+
+def gemma2_params_to_hf(params: Mapping[str, Any], cfg) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`gemma2_params_from_hf`."""
+    tree = params.get("params", params)
+    H = cfg.hidden_size
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _np(tree["embed"]["embedding"]),
+        "model.norm.weight": _np(tree["final_norm"]["weight"]) - 1.0,
+    }
+    for i in range(cfg.num_layers):
+        lyr = tree[f"layer_{i}"]
+        p = f"model.layers.{i}."
+        qkv = lyr["attn"]["qkv"]
+        gu = _np(lyr["mlp"]["gate_up"]["kernel"])  # [H, 2, I]
+        out.update({
+            p + "self_attn.q_proj.weight": _np(qkv["q_kernel"]).reshape(H, -1).T,
+            p + "self_attn.k_proj.weight": _np(qkv["k_kernel"]).reshape(H, -1).T,
+            p + "self_attn.v_proj.weight": _np(qkv["v_kernel"]).reshape(H, -1).T,
+            p + "self_attn.o_proj.weight": _np(lyr["attn"]["o_proj"]["kernel"]).T,
+            p + "mlp.gate_proj.weight": gu[:, 0, :].T,
+            p + "mlp.up_proj.weight": gu[:, 1, :].T,
+            p + "mlp.down_proj.weight": _np(lyr["mlp"]["down"]["kernel"]).T,
+            p + "input_layernorm.weight": _np(lyr["input_norm"]["weight"]) - 1.0,
+            p + "post_attention_layernorm.weight":
+                _np(lyr["post_attn_norm"]["weight"]) - 1.0,
+            p + "pre_feedforward_layernorm.weight":
+                _np(lyr["pre_ffw_norm"]["weight"]) - 1.0,
+            p + "post_feedforward_layernorm.weight":
+                _np(lyr["post_ffw_norm"]["weight"]) - 1.0,
+        })
+    return out

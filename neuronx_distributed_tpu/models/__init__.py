@@ -12,6 +12,8 @@ from neuronx_distributed_tpu.models.bert import (
     BertModel,
 )
 from neuronx_distributed_tpu.models.gemma import (
+    Gemma2Config,
+    Gemma2ForCausalLM,
     GemmaConfig,
     GemmaForCausalLM,
 )
@@ -34,6 +36,8 @@ __all__ = [
     "BertModel",
     "GemmaConfig",
     "GemmaForCausalLM",
+    "Gemma2Config",
+    "Gemma2ForCausalLM",
     "GPTNeoXConfig",
     "GPTNeoXForCausalLM",
     "LlamaConfig",

@@ -33,7 +33,12 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from neuronx_distributed_tpu.models.common import maybe_remat
-from neuronx_distributed_tpu.models.llama import LlamaBlock, LlamaConfig
+from neuronx_distributed_tpu.models.llama import (
+    LlamaAttention,
+    LlamaBlock,
+    LlamaConfig,
+    LlamaMLP,
+)
 from neuronx_distributed_tpu.parallel.layers import (
     ParallelEmbedding,
     shard_activation,
@@ -177,3 +182,198 @@ class GemmaForCausalLM(nn.Module):
         """Vocab-sharded logits for a (chunk of) hidden states via the tied
         table."""
         return self.embed.attend(h)
+
+
+# ---------------------------------------------------------------------------
+# Gemma-2: hybrid local/global attention, softcapped logits, sandwich norms
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Gemma2Config:
+    """Gemma-2 (2B/9B/27B): the Gemma recipe plus
+
+    - **hybrid attention**: even layers use a 4096-token sliding window,
+      odd layers are global (HF ``layer_types`` alternation);
+    - **logit softcapping**: attention scores pass ``50·tanh(s/50)``
+      in-kernel (``ops.flash_attention`` ``softcap``), final logits
+      ``30·tanh(s/30)``;
+    - **sandwich norms**: RMSNorm before AND after each sublayer
+      (input/post-attention, pre/post-feedforward);
+    - **decoupled attention scale**: ``query_pre_attn_scalar ** -0.5``
+      (equals head_dim for 2B/9B, differs on 27B).
+    """
+
+    vocab_size: int = 256000
+    hidden_size: int = 2304
+    intermediate_size: int = 9216
+    num_layers: int = 26
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    head_dim: int = 256
+    query_pre_attn_scalar: float = 256.0
+    attn_softcap: float = 50.0
+    final_softcap: float = 30.0
+    sliding_window: int = 4096
+    max_seq_len: int = 8192
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    sequence_parallel: bool = True
+    remat: str = "selective"
+    attention_impl: str = "dense"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim
+
+    def block_config(self, sliding: bool) -> LlamaConfig:
+        """Block config for one layer; ``sliding`` selects the local-window
+        variant (even layers in HF's ``layer_types`` alternation)."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads,
+            head_dim=self.head_dim,
+            max_seq_len=self.max_seq_len,
+            rope_theta=self.rope_theta,
+            rms_eps=self.rms_eps,
+            sequence_parallel=self.sequence_parallel,
+            remat=self.remat,
+            attention_impl=self.attention_impl,
+            mlp_activation="gelu_tanh",
+            sliding_window=self.sliding_window if sliding else None,
+            attn_softcap=self.attn_softcap,
+            attn_scale=self.query_pre_attn_scalar ** -0.5,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+
+    @staticmethod
+    def gemma2_2b(**overrides) -> "Gemma2Config":
+        return Gemma2Config(**overrides)
+
+    @staticmethod
+    def gemma2_9b(**overrides) -> "Gemma2Config":
+        return Gemma2Config(**{**dict(
+            hidden_size=3584, intermediate_size=14336, num_layers=42,
+            num_heads=16, num_kv_heads=8), **overrides})
+
+    @staticmethod
+    def gemma2_27b(**overrides) -> "Gemma2Config":
+        # the one scale where the attention scale decouples from head_dim
+        return Gemma2Config(**{**dict(
+            hidden_size=4608, intermediate_size=36864, num_layers=46,
+            num_heads=32, num_kv_heads=16, head_dim=128,
+            query_pre_attn_scalar=144.0), **overrides})
+
+    @staticmethod
+    def tiny(**overrides) -> "Gemma2Config":
+        return Gemma2Config(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=8, num_kv_heads=2, head_dim=16,
+            query_pre_attn_scalar=16.0, sliding_window=16,
+            max_seq_len=128), **overrides})
+
+
+class Gemma2Block(nn.Module):
+    """Sandwich-norm decoder block (HF ``Gemma2DecoderLayer.forward``):
+    ``x + post_norm(attn(in_norm(x)))`` then
+    ``x + post_ffw_norm(mlp(pre_ffw_norm(x)))`` — reusing the shared
+    attention/MLP modules; the block config carries the per-layer window."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, kv_cache=None, cache_offset=0,
+                 kv_valid=None, segment_ids=None):
+        cfg = self.config
+
+        def norm(name):
+            return RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                           param_dtype=cfg.param_dtype, name=name)
+
+        h, new_cache = LlamaAttention(cfg, name="attn")(
+            norm("input_norm")(x), positions, kv_cache, cache_offset,
+            kv_valid, segment_ids)
+        x = x + norm("post_attn_norm")(h)
+        h = LlamaMLP(cfg, name="mlp")(norm("pre_ffw_norm")(x))
+        x = x + norm("post_ffw_norm")(h)
+        if cfg.sequence_parallel:
+            from neuronx_distributed_tpu.parallel.mesh import SEQUENCE_AXES as _SEQ
+
+            x = shard_activation(x, trailing_spec(x.ndim, seq=_SEQ, last=None))
+        return x, new_cache
+
+
+class Gemma2ForCausalLM(nn.Module):
+    """Tied-embedding Gemma-2 causal LM with hybrid local/global layers and
+    softcapped final logits; same serving/chunked-loss protocols as
+    :class:`GemmaForCausalLM`."""
+
+    config: Gemma2Config
+
+    def setup(self):
+        cfg = self.config
+        self.embed = ParallelEmbedding(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            sequence_parallel_output=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+        )
+        # HF layer_types alternation: even layers sliding, odd global
+        self.layer = [
+            maybe_remat(Gemma2Block, cfg.remat)(cfg.block_config(i % 2 == 0))
+            for i in range(cfg.num_layers)
+        ]
+        self.final_norm = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                                  param_dtype=cfg.param_dtype)
+
+    def _backbone(self, ids, positions, kv_caches, cache_offset, kv_valid,
+                  segment_ids):
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+        h = self.embed(ids)
+        if cfg.sequence_parallel and kv_caches is None:
+            h = shard_activation(
+                h, trailing_spec(h.ndim, seq=SEQUENCE_AXES, last=None))
+        h = h * jnp.asarray(cfg.hidden_size ** 0.5, h.dtype)
+        new_caches = []
+        for i, block in enumerate(self.layer):
+            cache = kv_caches[i] if kv_caches is not None else None
+            h, c = block(h, positions, cache,
+                         cache_offset if kv_caches is not None else 0,
+                         kv_valid, segment_ids)
+            new_caches.append(c)
+        h = self.final_norm(h)
+        if cfg.sequence_parallel and kv_caches is None:
+            h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))
+        return h, new_caches
+
+    def _logits(self, h):
+        logits = self.embed.attend(h)
+        cap = self.config.final_softcap
+        if cap:
+            logits = (cap * jnp.tanh(logits.astype(jnp.float32) / cap)).astype(
+                logits.dtype)
+        return logits
+
+    def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
+                 kv_valid=None, segment_ids=None):
+        h, new_caches = self._backbone(
+            ids, positions, kv_caches, cache_offset, kv_valid, segment_ids)
+        logits = self._logits(h)
+        return (logits, new_caches) if kv_caches is not None else logits
+
+    def hidden(self, ids, positions=None, kv_valid=None, segment_ids=None):
+        h, _ = self._backbone(ids, positions, None, 0, kv_valid, segment_ids)
+        return h
+
+    def head(self, h):
+        return self._logits(h)

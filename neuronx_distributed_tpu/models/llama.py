@@ -86,6 +86,12 @@ class LlamaConfig:
     # gated-MLP activation: "silu" (Llama/Mistral SwiGLU) or "gelu_tanh"
     # (Gemma GeGLU — tanh-approximate gelu, HF ``gelu_pytorch_tanh``)
     mlp_activation: str = "silu"
+    # attention-score knobs (Gemma-2 family): softcap applies
+    # ``cap * tanh(s / cap)`` to scaled scores pre-mask; attn_scale
+    # overrides the default 1/sqrt(head_dim) (HF ``query_pre_attn_scalar``
+    # ** -0.5 when it differs from head_dim, e.g. Gemma-2-27B)
+    attn_softcap: Optional[float] = None
+    attn_scale: Optional[float] = None
     # Mistral-style causal sliding-window attention: query at position p
     # attends keys in [p - sliding_window + 1, p].  On the flash path the
     # band is enforced in-kernel with out-of-band KV blocks skipped in the
@@ -319,6 +325,7 @@ class CoreAttention(nn.Module):
                     q, k, v, causal=True, segment_ids=segment_ids,
                     layout="zigzag" if cfg.cp_zigzag else "contiguous",
                     cp_impl=cfg.cp_impl, window=cfg.sliding_window,
+                    sm_scale=cfg.attn_scale, softcap=cfg.attn_softcap,
                 )
         if cfg.attention_impl == "flash" and allow_flash and segment_ids is None:
             from neuronx_distributed_tpu.ops.ring_attention import ring_attention
@@ -331,6 +338,7 @@ class CoreAttention(nn.Module):
                 q, k, v, causal=True,
                 layout="zigzag" if cfg.cp_zigzag else "contiguous",
                 cp_impl=cfg.cp_impl, window=cfg.sliding_window,
+                sm_scale=cfg.attn_scale, softcap=cfg.attn_softcap,
             )
         B, S, NQ, D = q.shape
         T = k.shape[1]
@@ -341,7 +349,11 @@ class CoreAttention(nn.Module):
         # fp32 softmax (explicit-dtype replacement for the reference's
         # double-means-fp32 trick, modeling_llama_nxd.py:211)
         scores = jnp.einsum("bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32)
-        scores = scores / jnp.sqrt(D).astype(jnp.float32)
+        scale = (jnp.float32(cfg.attn_scale) if cfg.attn_scale is not None
+                 else 1.0 / jnp.sqrt(D).astype(jnp.float32))
+        scores = scores * scale
+        if cfg.attn_softcap is not None:
+            scores = cfg.attn_softcap * jnp.tanh(scores / cfg.attn_softcap)
         mask = _causal_mask(S, T, q_offset, cfg.sliding_window)[None, None, None]
         if kv_valid is not None:
             # per-example key validity [B, T] (left-padded serving batches,

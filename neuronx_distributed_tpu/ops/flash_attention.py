@@ -102,10 +102,12 @@ def band_mask(q_len: int, kv_len: int, q_offset=0,
 def mha_reference(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
     sm_scale: Optional[float] = None, window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Dense oracle used by the tests (same math, full score matrix).
     ``window`` is the causal sliding window: query at position p attends
-    keys in ``[p - window + 1, p]`` (Mistral-style SWA)."""
+    keys in ``[p - window + 1, p]`` (Mistral-style SWA); ``softcap`` is
+    Gemma-2-style logit softcapping (``cap * tanh(s / cap)`` pre-mask)."""
     if window is not None and (not causal or window < 1):
         raise ValueError("window requires causal=True and window >= 1")
     G = q.shape[1] // k.shape[1]
@@ -113,6 +115,8 @@ def mha_reference(
     kk = jnp.repeat(k, G, axis=1)
     vv = jnp.repeat(v, G, axis=1)
     s = jnp.einsum("bhsd,bhtd->bhst", q, kk, preferred_element_type=jnp.float32) * scale
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
     if causal:
         mask = band_mask(q.shape[2], k.shape[2], k.shape[2] - q.shape[2], window)
         s = jnp.where(mask, s, NEG_INF)
@@ -149,7 +153,7 @@ def _segment_mask(qseg_ref, kseg_ref, block_q, block_k):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_offset,
-                qseg_ref=None, kseg_ref=None, window=None):
+                qseg_ref=None, kseg_ref=None, window=None, softcap=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -182,6 +186,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [bq, bk] fp32
+        if softcap is not None:
+            # Gemma-2-style logit softcapping, applied BEFORE masking (the
+            # mask's NEG_INF must stay -inf-like, not get squashed to ±cap)
+            s = softcap * jnp.tanh(s / softcap)
         if causal:
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -229,7 +237,9 @@ def _seg_operands(q_seg, kv_seg, B, S, T, bq, bk):
 
 
 def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-              q_seg=None, kv_seg=None, window=None):
+              q_seg=None, kv_seg=None, window=None, softcap=None):
+    if softcap is not None and softcap <= 0.0:
+        raise ValueError(f"softcap must be > 0, got {softcap}")
     B, HQ, S, D = q.shape
     _, HKV, T, _ = k.shape
     G = HQ // HKV
@@ -254,7 +264,7 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         _fwd_kernel(q_r, k_r, v_r, o_r, lse_r, m_s, l_s, a_s,
                     sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
                     num_kv_blocks=nk, kv_offset=kv_offset,
-                    qseg_ref=qs_r, kseg_ref=ks_r, window=window)
+                    qseg_ref=qs_r, kseg_ref=ks_r, window=window, softcap=softcap)
 
     scratch = [
         # m / l lane-replicated, acc in fp32
@@ -300,7 +310,7 @@ def _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_offset,
-               qseg_ref=None, kseg_ref=None, window=None):
+               qseg_ref=None, kseg_ref=None, window=None, softcap=None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -326,6 +336,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
+        if softcap is not None:
+            t = jnp.tanh(s / softcap)
+            s = softcap * t
         if causal:
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -338,7 +351,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
+        ds = p * (dp - delta)
+        if softcap is not None:
+            # chain through the cap: d(cap*tanh(s0/cap))/ds0 = 1 - tanh^2
+            ds = ds * (1.0 - t * t)
+        ds = (ds * sm_scale).astype(k.dtype)
         acc_scr[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -351,7 +368,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                 dk_scr, dv_scr,
                 *, sm_scale, causal, block_q, block_k, num_q_blocks, kv_offset,
-                qseg_ref=None, kseg_ref=None, window=None):
+                qseg_ref=None, kseg_ref=None, window=None, softcap=None):
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -378,6 +395,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
+        if softcap is not None:
+            t = jnp.tanh(s / softcap)
+            s = softcap * t
         if causal:
             qpos = first_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -394,7 +414,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)  # [bq, bk]
+        ds = p * (dp - delta)
+        if softcap is not None:
+            ds = ds * (1.0 - t * t)  # chain through the cap (see _dq_kernel)
+        ds = (ds * sm_scale).astype(q.dtype)  # [bq, bk]
         dk_scr[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # ds^T @ q -> [bk, D]
@@ -406,7 +429,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 
 def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, interpret,
-              q_seg=None, kv_seg=None, window=None):
+              q_seg=None, kv_seg=None, window=None, softcap=None):
     """Backward kernels; ``delta_rows [B,HQ,S]`` is the softmax correction term
     (``rowsum(dO*O)``, minus the lse cotangent when one exists — see
     :func:`flash_attention_with_lse`)."""
@@ -435,7 +458,7 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
         _dq_kernel(q_r, k_r, v_r, do_r, lse_r, d_r, dq_r, a_s,
                    sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
                    num_kv_blocks=nk, kv_offset=kv_offset,
-                   qseg_ref=qs_r, kseg_ref=ks_r, window=window)
+                   qseg_ref=qs_r, kseg_ref=ks_r, window=window, softcap=softcap)
 
     dq_in_specs = [
         pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -472,7 +495,7 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
         _dkv_kernel(q_r, k_r, v_r, do_r, lse_r, d_r, dk_r, dv_r, dks, dvs,
                     sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
                     num_q_blocks=nq, kv_offset=kv_offset,
-                    qseg_ref=qs_r, kseg_ref=ks_r, window=window)
+                    qseg_ref=qs_r, kseg_ref=ks_r, window=window, softcap=softcap)
 
     dkv_in_specs = [
         pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
@@ -521,7 +544,7 @@ def _bwd_impl(q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k, 
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -532,6 +555,7 @@ def flash_attention(
     block_k: int = 512,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Fused blockwise attention: ``q [B, HQ, S, D]``, ``k/v [B, HKV, T, D]``
     (``HQ`` a multiple of ``HKV``) → ``[B, HQ, S, D]``.
@@ -544,24 +568,30 @@ def flash_attention(
     query at position p attends keys in ``[p - window + 1, p]``.  KV blocks
     entirely left of the band are skipped in the grid the same way causal
     blocks above the diagonal are, so long-sequence SWA costs
-    O(S * window), not O(S^2)."""
+    O(S * window), not O(S^2).
+
+    ``softcap`` is Gemma-2-style logit softcapping: scaled scores pass
+    through ``cap * tanh(s / cap)`` before masking; the backward kernels
+    chain through the cap analytically."""
     o, _ = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                     _auto_interpret(interpret), window=window)
+                     _auto_interpret(interpret), window=window, softcap=softcap)
     return o
 
 
-def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window,
+            softcap):
     o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                       _auto_interpret(interpret), window=window)
+                       _auto_interpret(interpret), window=window, softcap=softcap)
     return o, (q, k, v, o, lse)
 
 
-def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, do):
+def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, window, softcap,
+            res, do):
     q, k, v, o, lse = res
     delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     dq, dk, dv = _bwd_impl(
         q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
-        _auto_interpret(interpret), window=window,
+        _auto_interpret(interpret), window=window, softcap=softcap,
     )
     return dq, dk, dv
 
@@ -569,7 +599,7 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, do):
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention_with_lse(
     q: jax.Array,
     k: jax.Array,
@@ -580,6 +610,7 @@ def flash_attention_with_lse(
     block_k: int = 512,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """:func:`flash_attention` that also returns the per-row logsumexp
     ``[B, HQ, S]`` (fp32) — the combinable partial form needed by ring
@@ -592,24 +623,26 @@ def flash_attention_with_lse(
     points.
     """
     o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                       _auto_interpret(interpret), window=window)
+                       _auto_interpret(interpret), window=window, softcap=softcap)
     return o, lse[..., 0]
 
 
-def _fa_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window):
+def _fa_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window,
+                softcap):
     o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                       _auto_interpret(interpret), window=window)
+                       _auto_interpret(interpret), window=window, softcap=softcap)
     return (o, lse[..., 0]), (q, k, v, o, lse)
 
 
-def _fa_lse_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, cts):
+def _fa_lse_bwd(causal, sm_scale, block_q, block_k, interpret, window, softcap,
+                res, cts):
     q, k, v, o, lse = res
     do, dlse = cts
     delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta_rows = delta_rows - dlse.astype(jnp.float32)
     dq, dk, dv = _bwd_impl(
         q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
-        _auto_interpret(interpret), window=window,
+        _auto_interpret(interpret), window=window, softcap=softcap,
     )
     return dq, dk, dv
 
@@ -628,7 +661,7 @@ def _float0_like(x):
     return _np.zeros(x.shape, jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def flash_attention_segmented(
     q: jax.Array,
     k: jax.Array,
@@ -641,6 +674,7 @@ def flash_attention_segmented(
     block_k: int = 512,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """:func:`flash_attention` with document-segment masking — the packed-
     pretraining hot path (``data.packing``): queries attend only keys of the
@@ -655,26 +689,29 @@ def flash_attention_segmented(
 
     ``window`` (causal only) composes the Mistral sliding-window band with
     the document mask — a key never attends across documents OR further
-    than ``window - 1`` positions back."""
+    than ``window - 1`` positions back.  ``softcap`` composes too (Gemma-2
+    hybrid layers are segmented + banded + capped)."""
     o, _ = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
                      _auto_interpret(interpret), q_segment_ids, kv_segment_ids,
-                     window=window)
+                     window=window, softcap=softcap)
     return o
 
 
 def _fa_seg_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
-                interpret, window):
+                interpret, window, softcap):
     o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                       _auto_interpret(interpret), q_seg, kv_seg, window=window)
+                       _auto_interpret(interpret), q_seg, kv_seg, window=window,
+                       softcap=softcap)
     return o, (q, k, v, q_seg, kv_seg, o, lse)
 
 
-def _fa_seg_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, do):
+def _fa_seg_bwd(causal, sm_scale, block_q, block_k, interpret, window, softcap,
+                res, do):
     q, k, v, q_seg, kv_seg, o, lse = res
     delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     dq, dk, dv = _bwd_impl(
         q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
-        _auto_interpret(interpret), q_seg, kv_seg, window=window,
+        _auto_interpret(interpret), q_seg, kv_seg, window=window, softcap=softcap,
     )
     return dq, dk, dv, _float0_like(q_seg), _float0_like(kv_seg)
 
@@ -682,7 +719,7 @@ def _fa_seg_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, do):
 flash_attention_segmented.defvjp(_fa_seg_fwd, _fa_seg_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def flash_attention_segmented_with_lse(
     q: jax.Array,
     k: jax.Array,
@@ -695,6 +732,7 @@ def flash_attention_segmented_with_lse(
     block_k: int = 512,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """:func:`flash_attention_segmented` that also returns the per-row
     logsumexp ``[B, HQ, S]`` (fp32) — the combinable partial form ring
@@ -708,25 +746,27 @@ def flash_attention_segmented_with_lse(
     :func:`flash_attention_with_lse` does."""
     o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
                        _auto_interpret(interpret), q_segment_ids, kv_segment_ids,
-                       window=window)
+                       window=window, softcap=softcap)
     return o, lse[..., 0]
 
 
 def _fa_seg_lse_fwd(q, k, v, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
-                    interpret, window):
+                    interpret, window, softcap):
     o, lse = _fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
-                       _auto_interpret(interpret), q_seg, kv_seg, window=window)
+                       _auto_interpret(interpret), q_seg, kv_seg, window=window,
+                       softcap=softcap)
     return (o, lse[..., 0]), (q, k, v, q_seg, kv_seg, o, lse)
 
 
-def _fa_seg_lse_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, cts):
+def _fa_seg_lse_bwd(causal, sm_scale, block_q, block_k, interpret, window,
+                    softcap, res, cts):
     q, k, v, q_seg, kv_seg, o, lse = res
     do, dlse = cts
     delta_rows = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta_rows = delta_rows - dlse.astype(jnp.float32)
     dq, dk, dv = _bwd_impl(
         q, k, v, lse, do, delta_rows, causal, sm_scale, block_q, block_k,
-        _auto_interpret(interpret), q_seg, kv_seg, window=window,
+        _auto_interpret(interpret), q_seg, kv_seg, window=window, softcap=softcap,
     )
     return dq, dk, dv, _float0_like(q_seg), _float0_like(kv_seg)
 

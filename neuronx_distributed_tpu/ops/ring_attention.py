@@ -63,13 +63,16 @@ logger = get_logger(__name__)
 
 
 def _dense_chunk_attn(q, k, v, causal: bool, sm_scale: float,
-                      window: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
+                      window: Optional[int] = None,
+                      softcap: Optional[float] = None) -> Tuple[jax.Array, jax.Array]:
     """Dense per-chunk attention returning ``(o, lse)``; q ``[B,HQ,S,D]``,
     k/v ``[B,HKV,T,D]``.  fp32 softmax; used off-TPU and as the test oracle."""
     G = q.shape[1] // k.shape[1]
     kk = jnp.repeat(k, G, axis=1)
     vv = jnp.repeat(v, G, axis=1)
     s = jnp.einsum("bhsd,bhtd->bhst", q, kk, preferred_element_type=jnp.float32) * sm_scale
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
     if causal:
         mask = band_mask(q.shape[2], k.shape[2], k.shape[2] - q.shape[2], window)
         s = jnp.where(mask[None, None], s, NEG_INF)
@@ -91,27 +94,29 @@ def _combine(o1, lse1, o2, lse2):
 def _ring_shard(
     q, k, v, *, cp: int, causal: bool, sm_scale: float, use_flash: bool,
     block_q: int, block_k: int, interpret: Optional[bool], segs=None,
-    window: Optional[int] = None,
+    window: Optional[int] = None, softcap: Optional[float] = None,
 ):
     """Per-shard body; q ``[B,HQ,S/cp,D]``, k/v ``[B,HKV,S/cp,D]`` local
     chunks.  With ``segs [B, S/cp]`` (packed documents; VERDICT r4 #4)
     every chunk call masks cross-document scores via the segmented kernel
     and the KV segment ids rotate with the KV pair; causal+flash only
     (enforced in :func:`ring_attention`).  ``window`` (sliding-window band)
-    only reaches here at cp == 1 (enforced upstream)."""
+    only reaches here at cp == 1 (enforced upstream); ``softcap`` is
+    score-local so it rides every chunk call unchanged."""
 
     def chunk(qc, kc, vc, diag: bool, kseg=None):
         if segs is not None:
             return flash_attention_segmented_with_lse(
                 qc, kc, vc, segs, kseg, diag and causal, sm_scale,
-                block_q, block_k, interpret, window
+                block_q, block_k, interpret, window, softcap
             )
         if use_flash:
             return flash_attention_with_lse(
                 qc, kc, vc, diag and causal, sm_scale, block_q, block_k,
-                interpret, window
+                interpret, window, softcap
             )
-        return _dense_chunk_attn(qc, kc, vc, diag and causal, sm_scale, window)
+        return _dense_chunk_attn(qc, kc, vc, diag and causal, sm_scale, window,
+                                 softcap)
 
     if cp == 1:
         o, _ = chunk(q, k, v, True, segs)
@@ -191,6 +196,7 @@ def zigzag_unpermute(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:
 def _ring_shard_zigzag(
     q, k, v, *, cp: int, sm_scale: float, use_flash: bool,
     block_q: int, block_k: int, interpret: Optional[bool], segs=None,
+    softcap: Optional[float] = None,
 ):
     """Causal zigzag ring body; local q/k/v ``[B, H, 2C, D]`` hold the
     chunk pair (a=idx, b=2cp-1-idx), a in rows [:C], b in rows [C:].
@@ -206,13 +212,15 @@ def _ring_shard_zigzag(
     def chunk(qc, kc, vc, diag: bool, qseg=None, kseg=None):
         if segs is not None:
             return flash_attention_segmented_with_lse(
-                qc, kc, vc, qseg, kseg, diag, sm_scale, block_q, block_k, interpret
+                qc, kc, vc, qseg, kseg, diag, sm_scale, block_q, block_k,
+                interpret, None, softcap
             )
         if use_flash:
             return flash_attention_with_lse(
-                qc, kc, vc, diag, sm_scale, block_q, block_k, interpret
+                qc, kc, vc, diag, sm_scale, block_q, block_k, interpret,
+                None, softcap
             )
-        return _dense_chunk_attn(qc, kc, vc, diag, sm_scale)
+        return _dense_chunk_attn(qc, kc, vc, diag, sm_scale, None, softcap)
 
     C = q.shape[2] // 2
     qa, qb = q[:, :, :C], q[:, :, C:]
@@ -289,7 +297,7 @@ def _ring_shard_zigzag(
 def _ulysses_shard(
     q, k, v, *, cp: int, causal: bool, sm_scale: float, use_flash: bool,
     block_q: int, block_k: int, interpret: Optional[bool], segs=None,
-    window: Optional[int] = None,
+    window: Optional[int] = None, softcap: Optional[float] = None,
 ):
     """Per-shard body; local kernel layout q ``[B, HQ_l, S/cp, D]``,
     k/v ``[B, HKV_l, S/cp, D]``.  With ``segs [B, S/cp]`` (packed documents)
@@ -306,15 +314,15 @@ def _ulysses_shard(
         if segs is not None:
             return flash_attention_segmented(
                 qc, kc, vc, segs_full, segs_full, causal, sm_scale,
-                block_q, block_k, interpret, window
+                block_q, block_k, interpret, window, softcap
             )
         if use_flash:
             o, _ = flash_attention_with_lse(
                 qc, kc, vc, causal, sm_scale, block_q, block_k, interpret,
-                window
+                window, softcap
             )
             return o
-        o, _ = _dense_chunk_attn(qc, kc, vc, causal, sm_scale, window)
+        o, _ = _dense_chunk_attn(qc, kc, vc, causal, sm_scale, window, softcap)
         return o
 
     if cp == 1:
@@ -354,6 +362,7 @@ def ring_attention(
     cp_impl: str = "ring",
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Context-parallel attention in model layout: ``q [B, S, NQ, D]``,
     ``k/v [B, S, NKV, D]`` (``NQ`` a multiple of ``NKV``), sequence dim
@@ -391,6 +400,10 @@ def ring_attention(
     sees the full sequence after the all-to-all, so the banded kernel
     applies unmodified).  The ring schedules mask at chunk granularity and
     would need band-aware chunk visibility — rejected with guidance.
+
+    ``softcap`` (Gemma-2 logit softcapping) is score-local, so it composes
+    with EVERY decomposition — each chunk's partial softmax caps its own
+    scores and the lse combine is unchanged.
     """
     mesh = get_mesh()
     cp = mesh.shape[CONTEXT_AXIS]
@@ -492,41 +505,42 @@ def ring_attention(
                 return _ulysses_shard(
                     qs, ks, vs, cp=cp, causal=True, sm_scale=scale,
                     use_flash=True, block_q=block_q, block_k=block_k,
-                    interpret=interpret, segs=segs, window=window,
+                    interpret=interpret, segs=segs, window=window, softcap=softcap,
                 )
         elif layout == "zigzag" and cp > 1:
             def body(qs, ks, vs, segs):
                 return _ring_shard_zigzag(
                     qs, ks, vs, cp=cp, sm_scale=scale, use_flash=True,
                     block_q=block_q, block_k=block_k, interpret=interpret,
-                    segs=segs,
+                    segs=segs, softcap=softcap,
                 )
         else:
             def body(qs, ks, vs, segs):
                 return _ring_shard(
                     qs, ks, vs, cp=cp, causal=True, sm_scale=scale,
                     use_flash=True, block_q=block_q, block_k=block_k,
-                    interpret=interpret, segs=segs, window=window,
+                    interpret=interpret, segs=segs, window=window, softcap=softcap,
                 )
     elif cp_impl == "ulysses":
         def body(qs, ks, vs):
             return _ulysses_shard(
                 qs, ks, vs, cp=cp, causal=causal, sm_scale=scale,
                 use_flash=use_flash, block_q=block_q, block_k=block_k,
-                interpret=interpret, window=window,
+                interpret=interpret, window=window, softcap=softcap,
             )
     elif layout == "zigzag":
         def body(qs, ks, vs):
             return _ring_shard_zigzag(
                 qs, ks, vs, cp=cp, sm_scale=scale, use_flash=use_flash,
                 block_q=block_q, block_k=block_k, interpret=interpret,
+                softcap=softcap,
             )
     else:
         def body(qs, ks, vs):
             return _ring_shard(
                 qs, ks, vs, cp=cp, causal=causal, sm_scale=scale,
                 use_flash=use_flash, block_q=block_q, block_k=block_k,
-                interpret=interpret, window=window,
+                interpret=interpret, window=window, softcap=softcap,
             )
 
     # Nested shard_map (inside the PP engine) must receive the current
