@@ -1,0 +1,120 @@
+"""Token-exact perplexity evaluation over a token file.
+
+The eval counterpart to the pretrain launchers: streams a ``data.loader``
+token file through a jitted loss-sum step and reports
+``exp(sum loss / sum tokens)`` — the exact corpus perplexity, not a
+mean-of-batch-means (the same ``(loss_sum, tok)`` contract the trainer's
+grad accumulation uses).  Reference analogue: the eval loops the examples
+drive through ``NxDModel.run_eval`` (``trainer/model.py:30-39``).
+
+Usage:
+  python examples/eval_perplexity.py --data /tmp/tokens.bin --preset tiny \
+      --tp 2 --batch 8 --seq 128
+  python examples/eval_perplexity.py --data corpus.bin --preset llama2_7b \
+      --tp 8 --ckpt /ckpts/run1          # newest tag
+
+Prints ONE JSON line:
+  {"metric": "eval_perplexity", "value": ..., "loss": ..., "tokens": N}
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", required=True, help="token file (data.write_token_file)")
+    p.add_argument("--preset", default="tiny",
+                   choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b",
+                            "llama3_8b", "llama31_8b", "qwen2_7b", "mistral_7b",
+                            "mixtral_8x7b"])
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--max-batches", type=int, default=0, help="0 = whole file")
+    p.add_argument("--ckpt", default=None, help="checkpoint dir (orbax)")
+    p.add_argument("--tag", default=None, help="checkpoint tag (default newest)")
+    p.add_argument("--virtual-devices", type=int, default=None,
+                   help="force an N-device virtual CPU mesh (dev/test runs)")
+    args = p.parse_args()
+
+    if args.virtual_devices:
+        from neuronx_distributed_tpu.utils.common import ensure_virtual_devices
+
+        ensure_virtual_devices(args.virtual_devices)
+
+    import jax
+    import jax.numpy as jnp
+
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.data import TokenDataLoader, TokenDataset
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.models import causal_lm_loss_sum
+    from neuronx_distributed_tpu.trainer import (
+        default_batch_spec,
+        initialize_parallel_model,
+        load_checkpoint,
+    )
+
+    nxd.initialize_model_parallel(tensor_parallel_size=args.tp)
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = getattr(LlamaConfig, args.preset)(
+        max_seq_len=args.seq,
+        sequence_parallel=args.tp > 1,
+        remat="none",
+        attention_impl="flash" if on_tpu else "dense",
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    config = nxd.training_config(tensor_parallel_size=args.tp)
+    model = initialize_parallel_model(
+        config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, args.seq), jnp.int32),)
+    )
+    params = model.params
+    if args.ckpt:
+        model_state, _, _, _ = load_checkpoint(
+            args.ckpt, tag=args.tag, model_template=model)
+        params = model_state
+
+    from jax.sharding import NamedSharding
+
+    spec = NamedSharding(model.mesh, default_batch_spec())
+
+    @jax.jit
+    def eval_step(params, batch):
+        loss_sum, tok = causal_lm_loss_sum(model.module, params, batch, None)
+        return loss_sum.astype(jnp.float64 if jax.config.jax_enable_x64
+                               else jnp.float32), tok
+
+    ds = TokenDataset(args.data)
+    loader = TokenDataLoader(ds, args.batch, args.seq, seed=0)
+    total_sum, total_tok, batches = 0.0, 0, 0
+    for batch in loader:
+        batch = {k: jax.device_put(jnp.asarray(v), spec) for k, v in batch.items()}
+        loss_sum, tok = eval_step(params, batch)
+        total_sum += float(loss_sum)
+        total_tok += int(tok)
+        batches += 1
+        if args.max_batches and batches >= args.max_batches:
+            break
+    loader.close()
+    if total_tok == 0:
+        print(json.dumps({"metric": "eval_perplexity", "value": float("nan"),
+                          "loss": float("nan"), "tokens": 0}))
+        return 1
+    mean = total_sum / total_tok
+    import math
+
+    print(json.dumps({"metric": "eval_perplexity",
+                      "value": round(math.exp(mean), 4),
+                      "loss": round(mean, 6), "tokens": total_tok,
+                      "batches": batches}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
