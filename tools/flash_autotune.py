@@ -1,0 +1,107 @@
+"""Flash-attention block-size autotune at the bench shape.
+
+Times the pallas flash kernel (fwd and fwd+bwd) across block_q x block_k
+combinations on the attached backend and prints one JSON line per config
+plus a final ``best`` line.  Standalone kernel programs compile orders of
+magnitude faster than the full train step, so this fits in a short healthy
+tunnel window and its numbers justify (or refute) the 512x512 default the
+models use (`ops/flash_attention.py` block_q/block_k).
+
+Usage:
+    python tools/flash_autotune.py                 # bench shape, TPU
+    python tools/flash_autotune.py --cpu --tiny    # smoke (interpret mode)
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--heads", type=int, default=12)
+    p.add_argument("--kv-heads", type=int, default=12)
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--blocks", default="256,512,1024")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--cpu", action="store_true")
+    p.add_argument("--tiny", action="store_true", help="smoke shapes")
+    args = p.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.ops.flash_attention import flash_attention
+
+    if args.tiny:
+        args.batch, args.heads, args.kv_heads = 1, 2, 2
+        args.seq, args.head_dim, args.steps = 64, 16, 2
+        args.blocks = "16,32"
+
+    B, HQ, HKV, S, D = args.batch, args.heads, args.kv_heads, args.seq, args.head_dim
+    dtype = jnp.float32 if args.cpu else jnp.bfloat16
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, HQ, S, D), dtype)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, HKV, S, D), dtype)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, HKV, S, D), dtype)
+    # causal attention FLOPs: 2 matmuls x 2 flops, half the square
+    flops = 2 * 2 * B * HQ * S * S * D / 2
+
+    blocks = [int(b) for b in args.blocks.split(",")]
+    results = []
+    for bq, bk in itertools.product(blocks, blocks):
+        fwd = jax.jit(lambda a, b_, c, bq=bq, bk=bk: flash_attention(
+            a, b_, c, True, None, bq, bk))
+        grad = jax.jit(jax.grad(lambda a, b_, c, bq=bq, bk=bk: flash_attention(
+            a, b_, c, True, None, bq, bk).astype(jnp.float32).sum(), (0, 1, 2)))
+
+        def time_fn(f, *xs):
+            out = f(*xs)
+            jax.block_until_ready(out)
+            ts = []
+            for _ in range(args.steps):
+                t0 = time.perf_counter()
+                out = f(*xs)
+                jax.block_until_ready(out)
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+
+        try:
+            t_fwd = time_fn(fwd, q, k, v)
+            t_bwd = time_fn(grad, q, k, v)
+        except Exception as e:  # noqa: BLE001 — report and continue sweeping
+            rec = {"block_q": bq, "block_k": bk, "error": str(e)[:200]}
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+            continue
+        rec = {
+            "block_q": bq, "block_k": bk,
+            "fwd_ms": round(t_fwd * 1e3, 3),
+            "fwd_bwd_ms": round(t_bwd * 1e3, 3),
+            "fwd_tflops": round(flops / t_fwd / 1e12, 2),
+        }
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    ok = [r for r in results if "error" not in r]
+    if ok:
+        best = min(ok, key=lambda r: r["fwd_bwd_ms"])
+        print(json.dumps({"best": best,
+                          "device": jax.devices()[0].device_kind}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,10 @@ def run_extra_jobs(results_path: str) -> None:
     jobs = [
         ("tp_allreduce", [sys.executable, os.path.join(REPO, "tools", "ici_bench.py")]),
         ("serving_latency", [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")]),
+        # standalone kernel programs compile fast: block-size evidence fits
+        # any window even when the full train step's compile does not
+        ("flash_autotune", [sys.executable,
+                            os.path.join(REPO, "tools", "flash_autotune.py")]),
         # convergence evidence (VERDICT r4 #5): CPU-golden parity + 438M-class
         # single-chip curve, both machine-checked by testing.convergence
         ("convergence_parity", [sys.executable,
