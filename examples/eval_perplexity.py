@@ -28,10 +28,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--data", required=True, help="token file (data.write_token_file)")
+    p.add_argument("--family", default="llama",
+                   choices=["llama", "gemma", "gemma2"])
     p.add_argument("--preset", default="tiny",
-                   choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b",
-                            "llama3_8b", "llama31_8b", "qwen2_7b", "mistral_7b",
-                            "mixtral_8x7b"])
+                   help="config preset on the family's Config class "
+                        "(e.g. tiny, llama2_7b, gemma_7b, gemma2_9b)")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)
@@ -52,8 +53,14 @@ def main() -> int:
 
     import neuronx_distributed_tpu as nxd
     from neuronx_distributed_tpu.data import TokenDataLoader, TokenDataset
+    from neuronx_distributed_tpu.models import (
+        Gemma2Config,
+        Gemma2ForCausalLM,
+        GemmaConfig,
+        GemmaForCausalLM,
+        causal_lm_loss_sum,
+    )
     from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-    from neuronx_distributed_tpu.models import causal_lm_loss_sum
     from neuronx_distributed_tpu.trainer import (
         default_batch_spec,
         initialize_parallel_model,
@@ -62,7 +69,12 @@ def main() -> int:
 
     nxd.initialize_model_parallel(tensor_parallel_size=args.tp)
     on_tpu = jax.default_backend() == "tpu"
-    cfg = getattr(LlamaConfig, args.preset)(
+    cfg_cls, model_cls = {
+        "llama": (LlamaConfig, LlamaForCausalLM),
+        "gemma": (GemmaConfig, GemmaForCausalLM),
+        "gemma2": (Gemma2Config, Gemma2ForCausalLM),
+    }[args.family]
+    cfg = getattr(cfg_cls, args.preset)(
         max_seq_len=args.seq,
         sequence_parallel=args.tp > 1,
         remat="none",
@@ -72,7 +84,7 @@ def main() -> int:
     )
     config = nxd.training_config(tensor_parallel_size=args.tp)
     model = initialize_parallel_model(
-        config, lambda: LlamaForCausalLM(cfg), (jnp.zeros((1, args.seq), jnp.int32),)
+        config, lambda: model_cls(cfg), (jnp.zeros((1, args.seq), jnp.int32),)
     )
     params = model.params
     if args.ckpt:

@@ -56,3 +56,18 @@ def test_eval_perplexity_cli_matches_direct(tmp_path):
     # the CLI run re-initializes the same seed-0 model (deterministic init
     # under identical mesh/config), so the numbers must agree closely
     np.testing.assert_allclose(out["value"], want, rtol=1e-3)
+
+
+def test_eval_perplexity_cli_gemma2(tmp_path):
+    """Family dispatch: the hybrid-attention Gemma-2 tiny preset evaluates
+    end to end through the same CLI."""
+    from neuronx_distributed_tpu.data import write_token_file
+
+    rng = np.random.default_rng(1)
+    write_token_file(str(tmp_path / "t.bin"),
+                     rng.integers(0, 256, size=2048, dtype=np.int32))
+    proc = run_cli(_CLI, "--data", str(tmp_path / "t.bin"), "--family", "gemma2",
+                   "--preset", "tiny", "--tp", "2", "--batch", "4", "--seq", "32",
+                   "--virtual-devices", "8")
+    out = last_json_line(proc.stdout)
+    assert out["tokens"] > 0 and np.isfinite(out["value"]) and out["value"] > 1
