@@ -36,6 +36,12 @@ def build_model(args, preset=None, seed=None):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models import (
+        Gemma2Config,
+        Gemma2ForCausalLM,
+        GemmaConfig,
+        GemmaForCausalLM,
+    )
     from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from neuronx_distributed_tpu.parallel.mesh import (
         get_mesh, model_parallel_is_initialized,
@@ -52,14 +58,19 @@ def build_model(args, preset=None, seed=None):
                 f"model parallel already initialized with tp="
                 f"{get_tensor_parallel_size()}, but --tp {args.tp} requested")
     on_tpu = jax.default_backend() == "tpu"
-    cfg = getattr(LlamaConfig, preset or args.preset)(
+    cfg_cls, model_cls = {
+        "llama": (LlamaConfig, LlamaForCausalLM),
+        "gemma": (GemmaConfig, GemmaForCausalLM),
+        "gemma2": (Gemma2Config, Gemma2ForCausalLM),
+    }[getattr(args, "family", "llama")]
+    cfg = getattr(cfg_cls, preset or args.preset)(
         max_seq_len=args.max_total_len,
         sequence_parallel=False,
         remat="none",
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         param_dtype=jnp.float32,
     )
-    module = LlamaForCausalLM(cfg)
+    module = model_cls(cfg)
     ids0 = jnp.zeros((args.batch_size, args.context_len), jnp.int32)
     params = module.init(jax.random.PRNGKey(args.seed if seed is None else seed), ids0)
     specs = nn.get_partition_spec(params)
@@ -185,7 +196,9 @@ def main():
             sp.add_argument("--model", required=True, help="saved artifact dir")
         else:
             sp.add_argument("--preset", default="tiny",
-                            choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b", "llama31_8b", "qwen2_7b", "mistral_7b"])
+                            help="config preset on the family's Config class")
+            sp.add_argument("--family", default="llama",
+                            choices=["llama", "gemma", "gemma2"])
             sp.add_argument("--tp", type=int, default=1)
             sp.add_argument("--batch-size", type=int, default=1)
             sp.add_argument("--context-len", type=int, default=128)
@@ -215,8 +228,8 @@ def main():
     sp = sub.add_parser("spec-decode", help="speculative decoding: verify + time vs plain greedy")
     common(sp)
     sp.add_argument("--draft-preset", default="tiny",
-                    choices=["tiny", "llama2_7b", "llama2_13b", "llama2_70b", "llama3_8b", "llama31_8b", "qwen2_7b", "mistral_7b"],
-                    help="draft model preset (should be much smaller than the target)")
+                    help="draft model preset on the same family "
+                         "(should be much smaller than the target)")
     sp.add_argument("--spec-k", type=int, default=4, help="draft tokens per round")
     sp.set_defaults(fn=cmd_spec_decode)
 

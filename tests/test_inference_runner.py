@@ -27,3 +27,13 @@ def test_trace_infer_check_accuracy_roundtrip(tmp_path):
                    "--batch-size", "2", "--context-len", "32",
                    "--max-total-len", "64", "--virtual-devices", "8")
     assert last_json_line(proc.stdout) == {"inference_success": 1}
+
+
+def test_check_accuracy_gemma2_family():
+    """Family dispatch through the serving CLI: Gemma-2 tiny (hybrid
+    windows + softcaps) passes the cached-vs-teacher-forced check."""
+    proc = run_cli(_RUNNER, "check-accuracy", "--family", "gemma2",
+                   "--preset", "tiny", "--tp", "2", "--batch-size", "2",
+                   "--context-len", "32", "--max-total-len", "64",
+                   "--virtual-devices", "8")
+    assert last_json_line(proc.stdout) == {"inference_success": 1}
