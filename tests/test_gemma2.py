@@ -153,6 +153,27 @@ def test_gemma2_cached_decode_matches_teacher_forcing(devices8):
         np.testing.assert_array_equal(pred, np.asarray(out[:, t]), err_msg=f"pos {t}")
 
 
+def test_gemma2_chunked_loss_head_matches_mean_loss(devices8):
+    """hidden()/head() (with the final softcap inside head) equals the
+    full-logits mean loss."""
+    from neuronx_distributed_tpu.models import (
+        causal_lm_loss,
+        make_causal_lm_loss_sum,
+    )
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2)
+    _, cfg = _tiny_pair()
+    model = Gemma2ForCausalLM(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(5), (2, 32), 0, cfg.vocab_size)
+    batch = {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+    params = model.init(jax.random.PRNGKey(6), ids)
+    mean_loss = causal_lm_loss(model, params, batch, jax.random.PRNGKey(0))
+    loss_sum, tok = make_causal_lm_loss_sum(chunk_size=8)(
+        model, params, batch, jax.random.PRNGKey(0))
+    np.testing.assert_allclose(
+        float(loss_sum) / float(tok), float(mean_loss), rtol=1e-5, atol=1e-6)
+
+
 def test_gemma2_presets():
     assert Gemma2Config.gemma2_27b().query_pre_attn_scalar == 144.0
     assert Gemma2Config.gemma2_9b().num_kv_heads == 8
