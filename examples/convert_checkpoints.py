@@ -97,6 +97,14 @@ def _family(args):
         from neuronx_distributed_tpu.models.bert import BertConfig
 
         return build_cfg(BertConfig), C.bert_params_from_hf, C.bert_params_to_hf
+    if args.family == "gemma":
+        from neuronx_distributed_tpu.models.gemma import GemmaConfig
+
+        return build_cfg(GemmaConfig), C.gemma_params_from_hf, C.gemma_params_to_hf
+    if args.family == "gemma2":
+        from neuronx_distributed_tpu.models.gemma import Gemma2Config
+
+        return build_cfg(Gemma2Config), C.gemma2_params_from_hf, C.gemma2_params_to_hf
     raise ValueError(f"unknown family {args.family}")
 
 
@@ -168,7 +176,7 @@ def main():
     sub = p.add_subparsers(dest="cmd", required=True)
     for name, fn in (("to-framework", cmd_to_framework), ("to-hf", cmd_to_hf)):
         sp = sub.add_parser(name)
-        sp.add_argument("--family", required=True, choices=["llama", "gpt_neox", "bert"])
+        sp.add_argument("--family", required=True, choices=["llama", "gpt_neox", "bert", "gemma", "gemma2"])
         sp.add_argument("--config", default=None,
                         # a preset name (tiny, llama2_7b, ...) or a JSON file
                         # of config-field overrides
