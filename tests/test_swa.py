@@ -254,6 +254,32 @@ def test_swa_cached_decode_matches_teacher_forcing(devices8):
         np.testing.assert_array_equal(pred, np.asarray(out[:, t]), err_msg=f"pos {t}")
 
 
+def test_llama_swa_moe_flash_matches_dense(devices8):
+    """Mistral-MoE-shaped config: sliding window + expert-parallel MoE
+    compose — flash core matches the dense core for logits."""
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.trainer import initialize_parallel_model
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2, expert_parallel_size=2,
+                                  devices=devices8)
+    base = dict(sequence_parallel=False, dtype=jnp.float32,
+                param_dtype=jnp.float32, max_seq_len=32, sliding_window=10,
+                num_experts=4, moe_top_k=2, moe_dispatch="einsum")
+    cfg_d = LlamaConfig.tiny(attention_impl="dense", **base)
+    cfg_f = LlamaConfig.tiny(attention_impl="flash", **base)
+    ids = jax.random.randint(jax.random.PRNGKey(14), (2, 32), 0, cfg_d.vocab_size)
+    config = nxd.training_config(tensor_parallel_size=2, expert_parallel_size=2,
+                                 compute_dtype="float32")
+    model_d = initialize_parallel_model(
+        config, lambda: LlamaForCausalLM(cfg_d), (jnp.zeros((1, 32), jnp.int32),))
+    model_f = LlamaForCausalLM(cfg_f)
+    logits_d = jax.jit(model_d.module.apply)(model_d.params, ids)
+    logits_f = jax.jit(model_f.apply)(model_d.params, ids)
+    np.testing.assert_allclose(
+        np.asarray(logits_f), np.asarray(logits_d), rtol=2e-4, atol=2e-4)
+
+
 def test_llama_swa_changes_logits(devices8):
     """The window must actually change attention for sequences longer than
     the window (guards against the flag silently not reaching the core)."""
