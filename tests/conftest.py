@@ -39,7 +39,11 @@ SLOW_MODULES = {
     "test_convergence_sweep",
     "test_distributed_ckpt",
     "test_distributed_train",
+    "test_eval_perplexity",
+    "test_flash_fuzz",
     "test_fsdp",
+    "test_gemma",
+    "test_gemma2",
     "test_hf_convert",
     "test_hlo_collectives",
     "test_inference_runner",
@@ -50,6 +54,7 @@ SLOW_MODULES = {
     "test_moe",
     "test_northstar_dryrun",
     "test_rng_dropout",
+    "test_swa",
     "test_tpu_compiled",
     "test_trace",
     "test_trainer",
