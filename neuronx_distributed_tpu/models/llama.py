@@ -96,7 +96,9 @@ class LlamaConfig:
     # attends keys in [p - sliding_window + 1, p].  On the flash path the
     # band is enforced in-kernel with out-of-band KV blocks skipped in the
     # grid (O(S*W) attention); on the dense path it joins the causal mask.
-    # Composes with cp via cp_impl="ulysses"; the ring schedules reject it.
+    # Composes with cp: ulysses at any degree, and the contiguous ring when
+    # sliding_window <= S/cp — there ONE ppermute (the left neighbor)
+    # replaces the whole rotation, the long-context Mistral schedule.
     sliding_window: Optional[int] = None
     remat: str = "selective"  # none | selective | full
     # "dense": GSPMD einsum core (CPU-friendly; always used for cached decode).

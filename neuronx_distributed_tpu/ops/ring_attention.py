@@ -125,6 +125,32 @@ def _ring_shard(
     idx = jax.lax.axis_index(CONTEXT_AXIS)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
+    if window is not None:
+        # Sliding window with W <= C (= S/cp, enforced upstream): only the
+        # LEFT-NEIGHBOR chunk can intersect any query's band, so ONE
+        # ppermute replaces the (cp-1)-step rotation and a single kernel
+        # call on the [left | own] 2C timeline applies the exact global
+        # causal+band masks (q rows sit at kv_offset = C, so local
+        # j <= C + i - 0 and j > C + i - W reproduce the global
+        # inequalities).  Work is O(C·W) per device — the band makes the
+        # contiguous layout perfectly balanced, no zigzag needed.  Device
+        # 0's "left" chunk is device cp-1's (future tokens, wrapped): its
+        # keys are blocked via segment id 0 (the packing convention), which
+        # also carries the packed-document mask when ``segs`` is present.
+        left = jax.lax.ppermute(
+            (k, v) if segs is None else (k, v, segs), CONTEXT_AXIS, perm)
+        C = q.shape[2]
+        kk = jnp.concatenate([left[0], k], axis=2)
+        vv = jnp.concatenate([left[1], v], axis=2)
+        ones = jnp.ones((q.shape[0], C), jnp.int32)
+        qseg = segs if segs is not None else ones
+        lseg = left[2] if segs is not None else ones
+        lseg = jnp.where(idx == 0, 0, lseg)
+        kseg = jnp.concatenate([lseg, qseg], axis=1)
+        return flash_attention_segmented(
+            q, kk, vv, qseg, kseg, True, sm_scale, block_q, block_k,
+            interpret, window, softcap)
+
     # Prefetch step-1 KV before computing on the current chunk: the ppermute
     # and the diagonal-chunk flash kernel have no data dependence, so the ICI
     # transfer hides under the MXU work.  The accumulator stays fp32 across
@@ -396,10 +422,15 @@ def ring_attention(
 
     ``window`` (Mistral-style causal sliding window, see
     :func:`~neuronx_distributed_tpu.ops.flash_attention.flash_attention`)
-    is supported at cp == 1 and under ``cp_impl="ulysses"`` (each device
-    sees the full sequence after the all-to-all, so the banded kernel
-    applies unmodified).  The ring schedules mask at chunk granularity and
-    would need band-aware chunk visibility — rejected with guidance.
+    is supported at cp == 1; under ``cp_impl="ulysses"`` (each device sees
+    the full sequence after the all-to-all, so the banded kernel applies
+    unmodified); and under the contiguous ring when ``window <= S/cp`` —
+    there only the left-neighbor chunk intersects the band, so ONE
+    ``ppermute`` replaces the (cp-1)-step rotation and the band makes the
+    layout perfectly balanced (communication independent of cp, the
+    long-context Mistral training schedule).  Zigzag+window is rejected
+    (the band already balances the contiguous layout), as is
+    ``window > S/cp`` (use ulysses).
 
     ``softcap`` (Gemma-2 logit softcapping) is score-local, so it composes
     with EVERY decomposition — each chunk's partial softmax caps its own
@@ -460,12 +491,26 @@ def ring_attention(
             raise ValueError(
                 "window (sliding-window attention) requires causal=True and "
                 f"window >= 1, got causal={causal}, window={window}")
-        if cp > 1 and cp_impl != "ulysses":
-            raise ValueError(
-                "sliding-window attention under cp > 1 needs cp_impl='ulysses' "
-                "(full sequence per device after the all-to-all); the ring "
-                "schedules mask at chunk granularity and do not carry the band"
-            )
+        if cp > 1 and cp_impl == "ring":
+            if layout == "zigzag":
+                raise ValueError(
+                    "zigzag is a FULL-causal load-balancing layout; with a "
+                    "sliding window the contiguous ring is already balanced "
+                    "(every device does O(C*W) work) — use layout='contiguous'"
+                )
+            if window > S // cp:
+                raise ValueError(
+                    f"sliding window {window} exceeds the per-device chunk "
+                    f"{S // cp} (= S/cp): the one-neighbor ring schedule "
+                    "cannot see far enough back; lower cp, or use "
+                    "cp_impl='ulysses' (full sequence per device)"
+                )
+            if not use_flash:
+                raise ValueError(
+                    "sliding-window attention under the cp ring requires "
+                    "use_flash=True (the banded one-neighbor schedule runs "
+                    "through the segmented flash kernel)"
+                )
     if cp_impl == "ulysses":
         if layout == "zigzag" and cp > 1:
             raise ValueError(

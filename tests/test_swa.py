@@ -160,16 +160,94 @@ def test_swa_ulysses_matches_dense(devices8):
     )
 
 
+def test_swa_ring_matches_oracle(devices8):
+    """Sliding window under the contiguous ring (W <= S/cp): the
+    one-neighbor schedule — a single ppermute + one [left|own] 2C-timeline
+    kernel call — matches the global dense oracle for values and grads.
+    Device 0's wrapped 'left' chunk (future tokens) must contribute
+    nothing, which value parity pins."""
+    initialize_model_parallel(
+        tensor_parallel_size=2, context_parallel_size=4, devices=devices8
+    )
+    B, HKV, S, D, W = 1, 2, 64, 8, 12  # C = 16, W < C
+    q, k, v = _qkv(jax.random.PRNGKey(6), B, 4, HKV, S, S, D)
+    ref = mha_reference(q, k, v, causal=True, window=W)
+    fn = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, causal=True, block_q=16, block_k=16, window=W))
+    out = fn(_t(q), _t(k), _t(v))
+    np.testing.assert_allclose(
+        np.asarray(_t(out)), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    g_r = jax.grad(lambda a, b, c: jnp.sum(fn(_t(a), _t(b), _t(c)) ** 2),
+                   (0, 1, 2))(q, k, v)
+    g_o = jax.grad(lambda a, b, c: jnp.sum(
+        _t(mha_reference(a, b, c, causal=True, window=W)) ** 2), (0, 1, 2))(q, k, v)
+    for a, b, n in zip(g_r, g_o, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=f"d{n}")
+
+
+def test_swa_ring_window_equals_chunk(devices8):
+    """W == S/cp exactly (the Mistral-32k-at-cp-8 shape) also holds."""
+    initialize_model_parallel(
+        tensor_parallel_size=2, context_parallel_size=4, devices=devices8
+    )
+    B, HKV, S, D = 1, 2, 64, 8
+    W = 16  # == C
+    q, k, v = _qkv(jax.random.PRNGKey(16), B, 2, HKV, S, S, D)
+    ref = mha_reference(q, k, v, causal=True, window=W)
+    out = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, causal=True, block_q=16, block_k=16, window=W))(_t(q), _t(k), _t(v))
+    np.testing.assert_allclose(
+        np.asarray(_t(out)), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_swa_ring_packed_matches_oracle(devices8):
+    """Packed documents + sliding window + contiguous ring: the left-
+    neighbor schedule carries both the document mask and the band."""
+    initialize_model_parallel(
+        tensor_parallel_size=2, context_parallel_size=4, devices=devices8
+    )
+    B, HKV, S, D, W = 1, 2, 64, 8, 10
+    q, k, v = _qkv(jax.random.PRNGKey(17), B, 2, HKV, S, S, D)
+    seg_row = np.zeros(S, np.int32)
+    seg_row[:30] = 1
+    seg_row[30:58] = 2  # tail [58:] stays 0 = padding
+    segs = jnp.broadcast_to(jnp.asarray(seg_row), (B, S))
+
+    qpos = np.arange(S)[:, None]
+    kpos = np.arange(S)[None, :]
+    mask = (kpos <= qpos) & (kpos > qpos - W)
+    mask &= (seg_row[:, None] == seg_row[None, :]) & (seg_row > 0)[:, None]
+    kk = jnp.repeat(k, 1, axis=1)
+    s = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(D)
+    s = jnp.where(jnp.asarray(mask)[None, None], s, -1e30)
+    ref = jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(s, axis=-1), v)
+
+    out = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, causal=True, segment_ids=segs, block_q=16, block_k=16,
+        window=W))(_t(q), _t(k), _t(v))
+    out = _t(out)
+    live = seg_row > 0
+    np.testing.assert_allclose(
+        np.asarray(out)[:, :, live], np.asarray(ref)[:, :, live],
+        rtol=1e-5, atol=1e-5)
+
+
 def test_swa_ring_cp_raises(devices8):
-    """The ring schedules mask at chunk granularity and cannot carry the
-    band — reject with guidance instead of silently computing full causal."""
+    """Out-of-contract ring+window cases reject with guidance: W > S/cp
+    (one-neighbor schedule can't see far enough) and zigzag (band already
+    balances the contiguous layout)."""
     initialize_model_parallel(
         tensor_parallel_size=2, context_parallel_size=4, devices=devices8
     )
     B, HKV, S, D = 1, 2, 64, 8
     q, k, v = _qkv(jax.random.PRNGKey(6), B, 2, HKV, S, S, D)
     with pytest.raises(ValueError, match="ulysses"):
-        ring_attention(_t(q), _t(k), _t(v), causal=True, window=16)
+        ring_attention(_t(q), _t(k), _t(v), causal=True, window=17)  # > C=16
+    with pytest.raises(ValueError, match="contiguous"):
+        ring_attention(_t(q), _t(k), _t(v), causal=True, window=8,
+                       layout="zigzag")
 
 
 # ---------------------------------------------------------------------------
