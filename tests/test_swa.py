@@ -332,6 +332,40 @@ def test_swa_cached_decode_matches_teacher_forcing(devices8):
         np.testing.assert_array_equal(pred, np.asarray(out[:, t]), err_msg=f"pos {t}")
 
 
+def test_llama_swa_cp_ring_matches_dense(devices8):
+    """Model-level long-context SWA: tiny Llama with sliding_window on a
+    tp=2 x cp=2 mesh, flash (one-neighbor ring) vs the dense core."""
+    from conftest import sharded_params
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    initialize_model_parallel(
+        tensor_parallel_size=2, context_parallel_size=2, devices=devices8)
+    base = dict(sequence_parallel=True, dtype=jnp.float32,
+                param_dtype=jnp.float32, max_seq_len=32, sliding_window=10)
+    cfg_d = LlamaConfig.tiny(attention_impl="dense", **base)
+    cfg_f = LlamaConfig.tiny(attention_impl="flash", **base)
+    ids = jax.random.randint(jax.random.PRNGKey(18), (2, 32), 0, cfg_d.vocab_size)
+    model_d = LlamaForCausalLM(cfg_d)
+    model_f = LlamaForCausalLM(cfg_f)
+    params = sharded_params(model_d.init(jax.random.PRNGKey(19), ids))
+    logits_d = jax.jit(model_d.apply)(params, ids)
+    logits_f = jax.jit(model_f.apply)(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(logits_f), np.asarray(logits_d), rtol=2e-4, atol=2e-4)
+
+    def loss(m):
+        def f(p):
+            return jnp.mean(m.apply(p, ids).astype(jnp.float32) ** 2)
+        return f
+
+    g_d = jax.jit(jax.grad(loss(model_d)))(params)
+    g_f = jax.jit(jax.grad(loss(model_f)))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5),
+        g_d, g_f)
+
+
 def test_llama_swa_moe_flash_matches_dense(devices8):
     """Mistral-MoE-shaped config: sliding window + expert-parallel MoE
     compose — flash core matches the dense core for logits."""
