@@ -71,6 +71,16 @@ LADDER = [
 ATTEMPT_TIMEOUT_S = 2400
 PROBE_TIMEOUT_S = 420
 RETRY_SLEEP_S = 20
+# After two full-budget timeouts (cold compiles eating the window), do NOT
+# go straight to the CPU fallback: the watcher may have warmed OTHER rungs'
+# cache entries in an earlier window — replay exactly these two at a warm-
+# cache budget before giving up.  A warm rung completes in well under 600 s;
+# a cold one fails fast enough not to sink the run.
+RECOVERY_RUNGS = [
+    ("tpu", "flash", 8, "selective", "mean"),   # round-3 proven program
+    ("tpu", "dense", 2, "selective", "mean"),   # cheapest-compile canary
+]
+RECOVERY_TIMEOUT_S = 600
 
 
 def peak_flops_for(device) -> float:
@@ -288,38 +298,64 @@ def parent_main() -> int:
             time.sleep(RETRY_SLEEP_S)
 
     # Step 2: measurement ladder, first success wins.  Two timed-out TPU
-    # attempts disqualify the remaining TPU rungs (a hang, not an OOM).
+    # attempts stop the full-budget rungs (a compile-bound window, not an
+    # OOM) and fall through to the warm-cache recovery rungs below.
     tpu_timeouts = 0
-    for platform, attn, batch, remat, loss in LADDER:
-        if platform == "tpu" and (not tpu_ok or tpu_timeouts >= 2):
-            continue
+
+    def attempt(platform, attn, batch, remat, loss, timeout_s):
+        nonlocal last_err, tpu_timeouts
         env = dict(os.environ)
         if platform == "cpu":
             env["JAX_PLATFORMS"] = "cpu"
         proc = _run_child(
             [f"--platform={platform}", f"--attn={attn}", f"--batch={batch}",
              f"--remat={remat}", f"--loss={loss}"],
-            ATTEMPT_TIMEOUT_S, env,
+            timeout_s, env,
         )
         if proc is None:
-            last_err = f"{platform}/{attn}/b{batch}: timed out after {ATTEMPT_TIMEOUT_S}s"
+            last_err = f"{platform}/{attn}/b{batch}: timed out after {timeout_s}s"
             print(last_err, file=sys.stderr)
             if platform == "tpu":
                 tpu_timeouts += 1
-            continue
+            return None
         if proc.returncode == 0:
             for line in reversed(proc.stdout.strip().splitlines()):
                 line = line.strip()
                 if line.startswith("{"):
                     try:
-                        parsed = json.loads(line)
+                        return json.loads(line)
                     except json.JSONDecodeError:
                         continue
-                    print(json.dumps(parsed))
-                    return 0
         tail = (proc.stderr or "").strip().splitlines()[-12:]
         last_err = f"{platform}/{attn}/b{batch} rc={proc.returncode}: " + " | ".join(tail[-3:])
         print("\n".join(tail), file=sys.stderr)
+        return None
+
+    attempted = set()
+    for platform, attn, batch, remat, loss in LADDER:
+        if platform == "tpu" and (not tpu_ok or tpu_timeouts >= 2):
+            continue
+        if platform == "cpu" and tpu_ok and tpu_timeouts >= 2:
+            continue  # warm-cache recovery rungs first; cpu smoke last
+        attempted.add((platform, attn, batch, remat, loss))
+        parsed = attempt(platform, attn, batch, remat, loss, ATTEMPT_TIMEOUT_S)
+        if parsed is not None:
+            print(json.dumps(parsed))
+            return 0
+
+    if tpu_ok and tpu_timeouts >= 2:
+        for rung in RECOVERY_RUNGS:
+            if rung in attempted:
+                continue
+            parsed = attempt(*rung, RECOVERY_TIMEOUT_S)
+            if parsed is not None:
+                print(json.dumps(parsed))
+                return 0
+        # last resort: the CPU smoke line so the driver still gets a number
+        parsed = attempt("cpu", "dense", 2, "none", "mean", ATTEMPT_TIMEOUT_S)
+        if parsed is not None:
+            print(json.dumps(parsed))
+            return 0
     # Total failure: still emit one well-formed JSON line, rc 0.
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
