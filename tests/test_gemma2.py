@@ -174,6 +174,33 @@ def test_gemma2_chunked_loss_head_matches_mean_loss(devices8):
         float(loss_sum) / float(tok), float(mean_loss), rtol=1e-5, atol=1e-6)
 
 
+def test_gemma2_export_roundtrip(devices8, tmp_path):
+    """StableHLO save/load of the traced Gemma-2 serving pair: the loaded
+    artifact generates identical tokens (softcaps + hybrid windows survive
+    jax.export serialization)."""
+    from conftest import sharded_params
+    from neuronx_distributed_tpu.trace import (
+        InferenceConfig,
+        ParallelInferenceModel,
+        parallel_model_load,
+        parallel_model_save,
+    )
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2)
+    _, cfg = _tiny_pair()
+    module = Gemma2ForCausalLM(cfg)
+    params = sharded_params(
+        module.init(jax.random.PRNGKey(8), jnp.zeros((2, 8), jnp.int32)))
+    model = ParallelInferenceModel(
+        module, params,
+        InferenceConfig(batch_size=2, context_len=8, max_total_len=16))
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (2, 8), 0, cfg.vocab_size)
+    want = np.asarray(model.generate(prompt, 5))
+    path = parallel_model_save(str(tmp_path / "traced"), model)
+    got = np.asarray(parallel_model_load(path).generate(prompt, 5))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_gemma2_presets():
     assert Gemma2Config.gemma2_27b().query_pre_attn_scalar == 144.0
     assert Gemma2Config.gemma2_9b().num_kv_heads == 8
