@@ -392,6 +392,47 @@ def test_llama_swa_moe_flash_matches_dense(devices8):
         np.asarray(logits_f), np.asarray(logits_d), rtol=2e-4, atol=2e-4)
 
 
+def test_llama_swa_pipelined_matches_dense(devices8):
+    """Mistral under the PP engine: sliding_window rides the pipelined
+    blocks (pp=2 x tp=2, sync-1F1B) and the whole-schedule loss equals the
+    dense oracle with the same band."""
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        build_pipelined_llama,
+        causal_lm_loss,
+    )
+
+    nxd.initialize_model_parallel(
+        tensor_parallel_size=2, pipeline_parallel_size=2, devices=devices8)
+    cfg = LlamaConfig.tiny(
+        num_layers=4, num_heads=8, num_kv_heads=8, sequence_parallel=False,
+        remat="none", dtype=jnp.float32, param_dtype=jnp.float32,
+        max_seq_len=16, sliding_window=6)
+    pmodel = build_pipelined_llama(cfg, num_microbatches=2, seed=3)
+    ids = jax.random.randint(jax.random.PRNGKey(20), (4, 16), 0, cfg.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+    loss_sum, tok = jax.jit(pmodel.loss_fn)(pmodel.params, ids, labels)
+    pp_loss = float(loss_sum) / float(tok)
+
+    from test_pipeline import _dense_params_from_pipelined
+
+    dense = LlamaForCausalLM(cfg)
+    dparams = _dense_params_from_pipelined(pmodel, cfg)
+    dense_loss = float(jax.jit(lambda p: causal_lm_loss(
+        dense, p, {"ids": ids, "labels": labels}))(dparams))
+    assert pp_loss == pytest.approx(dense_loss, rel=2e-4), (pp_loss, dense_loss)
+
+    # and the window genuinely bites: an unwindowed dense loss differs
+    cfg_n = LlamaConfig.tiny(
+        num_layers=4, num_heads=8, num_kv_heads=8, sequence_parallel=False,
+        remat="none", dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=16)
+    plain_loss = float(jax.jit(lambda p: causal_lm_loss(
+        LlamaForCausalLM(cfg_n), p, {"ids": ids, "labels": labels}))(dparams))
+    assert abs(plain_loss - dense_loss) > 1e-5
+
+
 def test_llama_swa_changes_logits(devices8):
     """The window must actually change attention for sequences longer than
     the window (guards against the flag silently not reaching the core)."""
