@@ -303,6 +303,9 @@ def parent_main() -> int:
     tpu_timeouts = 0
 
     def attempt(platform, attn, batch, remat, loss, timeout_s):
+        """Returns ``(parsed_json_or_None, completed)``; ``completed`` is
+        False exactly when the child timed out (a completed child may still
+        have failed with rc != 0)."""
         nonlocal last_err, tpu_timeouts
         env = dict(os.environ)
         if platform == "cpu":
@@ -317,19 +320,19 @@ def parent_main() -> int:
             print(last_err, file=sys.stderr)
             if platform == "tpu":
                 tpu_timeouts += 1
-            return None
+            return None, False
         if proc.returncode == 0:
             for line in reversed(proc.stdout.strip().splitlines()):
                 line = line.strip()
                 if line.startswith("{"):
                     try:
-                        return json.loads(line)
+                        return json.loads(line), True
                     except json.JSONDecodeError:
                         continue
         tail = (proc.stderr or "").strip().splitlines()[-12:]
         last_err = f"{platform}/{attn}/b{batch} rc={proc.returncode}: " + " | ".join(tail[-3:])
         print("\n".join(tail), file=sys.stderr)
-        return None
+        return None, True
 
     attempted = set()
     for platform, attn, batch, remat, loss in LADDER:
@@ -337,8 +340,15 @@ def parent_main() -> int:
             continue
         if platform == "cpu" and tpu_ok and tpu_timeouts >= 2:
             continue  # warm-cache recovery rungs first; cpu smoke last
-        attempted.add((platform, attn, batch, remat, loss))
-        parsed = attempt(platform, attn, batch, remat, loss, ATTEMPT_TIMEOUT_S)
+        rung = (platform, attn, batch, remat, loss)
+        parsed, completed = attempt(*rung, ATTEMPT_TIMEOUT_S)
+        if completed:
+            # only COMPLETED rungs are banked: a rung that timed out stays
+            # eligible for the warm-cache recovery replay below — its compile
+            # is now cached, so the retry is exactly the cheap case the
+            # recovery pass exists for (ADVICE r5: both full-budget timeouts
+            # landing on recovery rungs used to skip the replay entirely)
+            attempted.add(rung)
         if parsed is not None:
             print(json.dumps(parsed))
             return 0
@@ -347,12 +357,12 @@ def parent_main() -> int:
         for rung in RECOVERY_RUNGS:
             if rung in attempted:
                 continue
-            parsed = attempt(*rung, RECOVERY_TIMEOUT_S)
+            parsed, _ = attempt(*rung, RECOVERY_TIMEOUT_S)
             if parsed is not None:
                 print(json.dumps(parsed))
                 return 0
         # last resort: the CPU smoke line so the driver still gets a number
-        parsed = attempt("cpu", "dense", 2, "none", "mean", ATTEMPT_TIMEOUT_S)
+        parsed, _ = attempt("cpu", "dense", 2, "none", "mean", ATTEMPT_TIMEOUT_S)
         if parsed is not None:
             print(json.dumps(parsed))
             return 0

@@ -15,6 +15,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import weakref
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
@@ -190,6 +191,7 @@ class TokenDataset:
         self._lib = _load_native()
         self._handle = None
         self._np_tokens = None
+        self._loaders: "weakref.WeakSet" = weakref.WeakSet()
         if self._lib is not None:
             self._handle = self._lib.nxd_open(path.encode())
             if not self._handle:
@@ -240,6 +242,11 @@ class TokenDataset:
 
     def close(self):
         if self._handle is not None:
+            # destroy live loaders FIRST: their prefetch threads read the
+            # dataset's mmap, so nxd_close before nxd_loader_destroy is a
+            # use-after-free (segfaulted under GC ordering in the wild)
+            for loader in list(self._loaders):
+                loader.close()
             self._lib.nxd_close(self._handle)
             self._handle = None
 
@@ -285,6 +292,7 @@ class TokenDataLoader:
                 prefetch_depth, num_threads)
             if not self._loader:
                 raise ValueError("native loader creation failed")
+            dataset._loaders.add(self)  # dataset.close() tears us down first
             self.num_batches = int(lib.nxd_loader_num_batches(self._loader))
         else:
             # globally uniform count (min share across ranks) so every dp

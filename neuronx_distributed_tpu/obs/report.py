@@ -1,0 +1,267 @@
+"""Run-report builder: merge every persisted telemetry artifact into one
+summary document.
+
+Inputs (all optional — the report covers whatever exists):
+
+- ``scalars.jsonl`` streams (the :class:`~..trainer.scalar_log.ScalarWriter`
+  stream and/or the registry dumps in an obs dir);
+- ``flight_record.json`` (the step flight recorder's last dump);
+- ``hlo_audit.jsonl`` (one record per compiled executable);
+- Chrome-trace timeline files (:class:`~..utils.timeline.Timeline` output).
+
+The output validates against ``obs.schemas.SCHEMAS["obs_report"]`` and has a
+markdown rendering for humans.  CLI: ``tools/obs_report.py``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+from neuronx_distributed_tpu.obs import FLIGHT_FILE, HLO_AUDIT_FILE, SCALARS_FILE
+from neuronx_distributed_tpu.obs.flight import read_flight
+from neuronx_distributed_tpu.obs.hlo_audit import read_audits
+from neuronx_distributed_tpu.obs.registry import read_histograms
+
+OBS_REPORT_SCHEMA = "obs_report_v1"
+
+
+def _read_scalar_file(path: str) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _parse_timeline(path: str) -> List[dict]:
+    """Parse a Timeline trace file: the Perfetto-tolerant JSON-array format
+    has a header '[' and one ``{...},`` object per line with no closing
+    bracket — fall back to line-wise parsing when strict JSON fails."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        return doc if isinstance(doc, list) else doc.get("traceEvents", [])
+    except json.JSONDecodeError:
+        events = []
+        for line in text.splitlines():
+            line = line.strip().rstrip(",")
+            if line.startswith("{"):
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        return events
+
+
+def _summarize_scalars(records: List[dict],
+                       histogram_names: frozenset = frozenset()) -> Dict[str, dict]:
+    """Per-tag stream summary.  Histogram-flattened tags (``/le_*`` edges
+    and the ``/count``/``/sum`` of any name in ``histogram_names``) are
+    skipped — they are reconstructed into the histograms section instead,
+    and min/max/mean over cumulative snapshots would be meaningless."""
+    skip = {f"{h}/{suffix}" for h in histogram_names
+            for suffix in ("count", "sum")}
+    by_tag: Dict[str, dict] = {}
+    for r in records:
+        tag = r.get("tag")
+        if tag is None or "/le_" in tag or tag in skip:
+            continue
+        s = by_tag.get(tag)
+        v, step = float(r["value"]), int(r["step"])
+        if s is None:
+            by_tag[tag] = {
+                "count": 1, "first_step": step, "last_step": step,
+                "last": v, "min": v, "max": v, "_sum": v,
+            }
+        else:
+            s["count"] += 1
+            s["_sum"] += v
+            s["min"] = min(s["min"], v)
+            s["max"] = max(s["max"], v)
+            if step >= s["last_step"]:
+                s["last_step"], s["last"] = step, v
+            s["first_step"] = min(s["first_step"], step)
+    for s in by_tag.values():
+        s["mean"] = s.pop("_sum") / s["count"]
+    return by_tag
+
+
+def _summarize_timeline(paths: Sequence[str]) -> dict:
+    events = instants = 0
+    dur_by_name: Dict[str, float] = {}
+    markers: List[dict] = []
+    for path in paths:
+        for e in _parse_timeline(path):
+            ph = e.get("ph")
+            if ph == "X":
+                events += 1
+                dur_by_name[e.get("name", "?")] = (
+                    dur_by_name.get(e.get("name", "?"), 0.0)
+                    + float(e.get("dur", 0.0)) / 1e3)
+            elif ph == "i":
+                instants += 1
+                if e.get("name", "").startswith("anomaly/"):
+                    markers.append({"name": e["name"],
+                                    "args": e.get("args", {})})
+    top = dict(sorted(dur_by_name.items(), key=lambda kv: -kv[1])[:20])
+    return {
+        "files": len(list(paths)),
+        "events": events,
+        "instants": instants,
+        "total_ms_by_name": top,
+        "anomaly_markers": markers[:50],
+    }
+
+
+def build_report(
+    run_dir: Optional[str] = None,
+    scalar_paths: Sequence[str] = (),
+    flight_path: Optional[str] = None,
+    hlo_audit_path: Optional[str] = None,
+    timeline_paths: Sequence[str] = (),
+    tail: int = 10,
+) -> dict:
+    """Merge the artifacts into one summary document.
+
+    ``run_dir`` seeds the default artifact locations (``scalars.jsonl``,
+    ``flight_record.json``, ``hlo_audit.jsonl`` and any ``*trace*.json``
+    inside it); the explicit path arguments add to / override them."""
+    scalar_paths = list(scalar_paths)
+    timeline_paths = list(timeline_paths)
+    if run_dir:
+        p = os.path.join(run_dir, SCALARS_FILE)
+        if os.path.exists(p) and p not in scalar_paths:
+            scalar_paths.append(p)
+        if flight_path is None:
+            q = os.path.join(run_dir, FLIGHT_FILE)
+            flight_path = q if os.path.exists(q) else None
+        if hlo_audit_path is None:
+            q = os.path.join(run_dir, HLO_AUDIT_FILE)
+            hlo_audit_path = q if os.path.exists(q) else None
+        for q in sorted(glob.glob(os.path.join(run_dir, "*trace*.json"))):
+            if q not in timeline_paths:
+                timeline_paths.append(q)
+
+    scalar_records: List[dict] = []
+    for p in scalar_paths:
+        scalar_records.extend(_read_scalar_file(p))
+
+    flight = None
+    if flight_path and os.path.exists(flight_path):
+        flight_doc = read_flight(flight_path)
+        flight = {
+            "reason": flight_doc["reason"],
+            "dumped_at": flight_doc["dumped_at"],
+            "steps_recorded": flight_doc["steps_recorded"],
+            "num_records": len(flight_doc["records"]),
+            "tail": flight_doc["records"][-tail:],
+            "warnings": flight_doc["warnings"],
+        }
+
+    audits = read_audits(hlo_audit_path) if (
+        hlo_audit_path and os.path.exists(hlo_audit_path)) else []
+
+    anomalies = list(flight["warnings"]) if flight else []
+    histograms = read_histograms(scalar_records)
+    report = {
+        "schema": OBS_REPORT_SCHEMA,
+        "generated_at": time.time(),
+        "run_dir": run_dir,
+        "sources": {
+            "scalars": scalar_paths,
+            "flight": flight_path,
+            "hlo_audit": hlo_audit_path,
+            "timelines": timeline_paths,
+        },
+        "scalars": _summarize_scalars(scalar_records, frozenset(histograms)),
+        "histograms": histograms,
+        "flight": flight,
+        "anomalies": anomalies,
+        "hlo_audits": audits,
+        "timeline": _summarize_timeline(timeline_paths),
+        "health": {
+            "anomaly_count": len(anomalies),
+            "total_collective_count": sum(
+                a.get("total_collective_count", 0) for a in audits),
+            "total_collective_bytes": sum(
+                a.get("total_collective_bytes", 0) for a in audits),
+        },
+    }
+    return report
+
+
+def render_markdown(report: dict) -> str:
+    """Human-readable rendering of :func:`build_report` output."""
+    lines = ["# Run report", ""]
+    h = report["health"]
+    lines.append(f"- anomalies: **{h['anomaly_count']}**")
+    lines.append(f"- collectives across audited programs: "
+                 f"{h['total_collective_count']} ops, "
+                 f"{h['total_collective_bytes']:,} bytes")
+    lines.append("")
+
+    if report["scalars"]:
+        lines += ["## Step metrics", "",
+                  "| tag | count | last | min | max | mean |",
+                  "|---|---|---|---|---|---|"]
+        for tag, s in sorted(report["scalars"].items()):
+            lines.append(
+                f"| {tag} | {s['count']} | {s['last']:.6g} | {s['min']:.6g} "
+                f"| {s['max']:.6g} | {s['mean']:.6g} |")
+        lines.append("")
+
+    if report["histograms"]:
+        lines += ["## Histograms", ""]
+        for name, hist in sorted(report["histograms"].items()):
+            lines.append(f"### {name}")
+            lines.append(f"count {hist['count']:.0f}, sum {hist['sum']:.6g}, "
+                         f"mean {hist['mean']:.6g}")
+            lines.append("")
+            lines.append("| le | cumulative |")
+            lines.append("|---|---|")
+            for le, cum in hist["buckets"].items():
+                lines.append(f"| {le} | {cum:.0f} |")
+            lines.append("")
+
+    if report["flight"]:
+        fl = report["flight"]
+        lines += ["## Flight recorder", "",
+                  f"dump reason `{fl['reason']}`, {fl['num_records']} records "
+                  f"held of {fl['steps_recorded']} steps recorded", ""]
+        for rec in fl["tail"]:
+            lines.append(f"- step {rec['step']}: " + ", ".join(
+                f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in rec.items() if k not in ("step", "time")))
+        lines.append("")
+
+    if report["anomalies"]:
+        lines += ["## Anomalies", ""]
+        for w in report["anomalies"]:
+            lines.append(f"- step {w['step']} [{w['detector']}]: {w['message']}")
+        lines.append("")
+
+    if report["hlo_audits"]:
+        lines += ["## HLO communication audits", ""]
+        for a in report["hlo_audits"]:
+            counts = {k: v for k, v in a["collective_counts"].items() if v}
+            lines.append(
+                f"- `{a['name']}`: {counts or 'no collectives'}; "
+                f"{a['total_collective_bytes']:,} bytes")
+        lines.append("")
+
+    tl = report["timeline"]
+    if tl["events"] or tl["instants"]:
+        lines += ["## Timeline", "",
+                  f"{tl['events']} events, {tl['instants']} instants "
+                  f"across {tl['files']} file(s)"]
+        for name, ms in tl["total_ms_by_name"].items():
+            lines.append(f"- {name}: {ms:.1f} ms total")
+        lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,102 @@
+"""Checked-in schemas for every JSONL/JSON artifact the framework emits.
+
+Downstream tooling (``tools/obs_report.py``, dashboards, the judge reading
+``docs/tpu_watch_results.jsonl``) parses these files; this module is the
+contract that keeps the formats stable.  A schema here is deliberately a
+floor, not a straitjacket: records may carry EXTRA keys (forward-compatible
+growth), but the required keys and their types may never change without a
+schema-version bump.  ``tests/test_artifact_schemas.py`` is the smoke test
+that re-validates every emitter against this list.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable
+
+_NUM = (int, float)
+
+# kind -> {field: type-or-tuple-of-types}; every field is required, extra
+# fields are allowed.
+SCHEMAS: Dict[str, Dict[str, Any]] = {
+    # one line of scalars.jsonl — written by trainer.scalar_log.ScalarWriter
+    # AND obs.registry.MetricRegistry.dump_jsonl
+    "scalars": {"step": int, "tag": str, "value": _NUM, "time": _NUM},
+    # flight_record.json top-level document (obs.flight.FlightRecorder.dump)
+    "flight_record": {
+        "schema": str, "reason": str, "dumped_at": _NUM, "capacity": int,
+        "steps_recorded": int, "records": list, "warnings": list,
+    },
+    # one entry of flight_record.json["records"]
+    "flight_step": {"step": int, "time": _NUM},
+    # one entry of flight_record.json["warnings"] (anomaly detectors)
+    "anomaly": {"step": int, "detector": str, "message": str, "time": _NUM},
+    # one line of hlo_audit.jsonl (obs.hlo_audit.comm_audit)
+    "hlo_audit": {
+        "schema": str, "name": str, "time": _NUM,
+        "collective_counts": dict, "collective_bytes": dict,
+        "total_collective_count": int, "total_collective_bytes": int,
+    },
+    # one line of docs/tpu_watch_results.jsonl (tools/tpu_watch.py append)
+    "tpu_watch": {"ts": str, "kind": str},
+    # tools/obs_report.py output document
+    "obs_report": {
+        "schema": str, "generated_at": _NUM, "scalars": dict,
+        "histograms": dict, "flight": (dict, type(None)),
+        "anomalies": list, "hlo_audits": list, "timeline": dict,
+    },
+}
+
+
+def validate_record(kind: str, record: dict, where: str = "") -> None:
+    """Raise ValueError when ``record`` violates the ``kind`` schema."""
+    schema = SCHEMAS.get(kind)
+    if schema is None:
+        raise ValueError(f"unknown artifact kind {kind!r} "
+                         f"(known: {sorted(SCHEMAS)})")
+    if not isinstance(record, dict):
+        raise ValueError(f"{where or kind}: record is {type(record).__name__}, "
+                         "expected object")
+    for field, types in schema.items():
+        if field not in record:
+            raise ValueError(f"{where or kind}: missing required field "
+                             f"{field!r} (present: {sorted(record)})")
+        v = record[field]
+        # bool is an int subclass but never a valid numeric metric value
+        if isinstance(v, bool) and bool not in (
+                types if isinstance(types, tuple) else (types,)):
+            raise ValueError(f"{where or kind}: field {field!r} is bool, "
+                             f"expected {types}")
+        if not isinstance(v, types):
+            raise ValueError(f"{where or kind}: field {field!r} is "
+                             f"{type(v).__name__}, expected {types}")
+
+
+def validate_jsonl(kind: str, path: str, max_records: int = 0) -> int:
+    """Validate every line of a JSONL artifact; returns the record count.
+    ``max_records`` bounds the scan (0 = all)."""
+    n = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: invalid JSON ({e})")
+            validate_record(kind, rec, where=f"{path}:{lineno}")
+            n += 1
+            if max_records and n >= max_records:
+                break
+    return n
+
+
+def validate_flight_document(doc: dict, where: str = "flight_record") -> None:
+    """Validate a flight-record document including its nested records and
+    warnings."""
+    validate_record("flight_record", doc, where)
+    for i, rec in enumerate(doc["records"]):
+        validate_record("flight_step", rec, f"{where}.records[{i}]")
+    for i, w in enumerate(doc["warnings"]):
+        validate_record("anomaly", w, f"{where}.warnings[{i}]")

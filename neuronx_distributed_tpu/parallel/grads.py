@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from neuronx_distributed_tpu.parallel.mesh import BATCH_AXES
+from neuronx_distributed_tpu.parallel.mesh import BATCH_AXES, manual_axis_size
 
 
 def get_grad_norm(grads, norm_type: float = 2.0) -> jax.Array:
@@ -72,7 +72,7 @@ def psum_over_data_parallel(grads, mean: bool = True):
     (the conjugate of the reference's ``bucket_allreduce_gradients``)."""
     n = 1
     for a in BATCH_AXES:
-        n *= lax.axis_size(a)
+        n *= manual_axis_size(a)
     reduced = jax.tree.map(lambda g: lax.psum(g, BATCH_AXES), grads)
     if mean:
         reduced = jax.tree.map(lambda g: g / n, reduced)

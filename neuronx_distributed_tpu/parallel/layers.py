@@ -57,12 +57,16 @@ def shard_activation(x: jax.Array, spec: P) -> jax.Array:
     ``pp`` manual) the constraint must be expressed against the *abstract*
     context mesh — a NamedSharding over the concrete mesh carries all-Auto
     axis types and is rejected by jax 0.9's canonicalization when any axis
-    is Manual in context."""
+    is Manual in context.  On older jax (< 0.5) there is no abstract-mesh
+    tracking; the concrete-mesh constraint is the classic behavior."""
     if not model_parallel_is_initialized():
         return x
-    abstract = jax.sharding.get_abstract_mesh()
-    if abstract.axis_names:  # inside jit/shard_map: use the context mesh
-        return jax.lax.with_sharding_constraint(x, NamedSharding(abstract, spec))
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract is not None:
+        abstract = get_abstract()
+        if abstract.axis_names:  # inside jit/shard_map: use the context mesh
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(abstract, spec))
     return jax.lax.with_sharding_constraint(x, NamedSharding(get_mesh(), spec))
 
 

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from neuronx_distributed_tpu.parallel.mesh import TENSOR_AXES
+from neuronx_distributed_tpu.parallel.mesh import TENSOR_AXES, manual_axis_size
 
 AxisNames = Union[str, Tuple[str, ...]]
 
@@ -53,7 +53,7 @@ def axis_size(axis_name: Optional[AxisNames] = None) -> int:
         ax = (ax,)
     size = 1
     for a in ax:
-        size *= lax.axis_size(a)
+        size *= manual_axis_size(a)
     return size
 
 
@@ -64,7 +64,7 @@ def axis_rank(axis_name: Optional[AxisNames] = None) -> jax.Array:
         ax = (ax,)
     rank = jnp.zeros((), dtype=jnp.int32)
     for a in ax:
-        rank = rank * lax.axis_size(a) + lax.axis_index(a)
+        rank = rank * manual_axis_size(a) + lax.axis_index(a)
     return rank
 
 

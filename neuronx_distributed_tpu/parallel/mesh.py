@@ -296,6 +296,15 @@ def _axis_size(mesh: Optional[Mesh], *axes: str) -> int:
     return int(math.prod(mesh.shape[a] for a in axes))
 
 
+def manual_axis_size(axis_name: str) -> int:
+    """Trace-time size of a manual (shard_map) axis, version-portable:
+    jax >= 0.5 has ``lax.axis_size``; older jax folds ``psum(1, axis)`` to
+    the same static constant."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return int(jax.lax.psum(1, axis_name))
+
+
 def get_tensor_parallel_size(mesh: Optional[Mesh] = None) -> int:
     """Full TP degree, kvr * tp (reference: ``get_tensor_model_parallel_size``)."""
     return _axis_size(mesh, *TENSOR_AXES)
@@ -326,7 +335,7 @@ def tensor_parallel_rank() -> jax.Array:
     """Traced TP rank; valid only inside shard_map over the global mesh."""
     kvr = jax.lax.axis_index(KV_REPLICA_AXIS)
     tp = jax.lax.axis_index(TENSOR_AXIS)
-    return kvr * jax.lax.axis_size(TENSOR_AXIS) + tp
+    return kvr * manual_axis_size(TENSOR_AXIS) + tp
 
 
 def named_sharding(*spec) -> NamedSharding:

@@ -227,6 +227,44 @@ def bubble_fraction(
     )
 
 
+def export_schedule_metrics(
+    registry,
+    num_microbatches: int,
+    num_stages: int,
+    schedule: str = "sync_1f1b",
+    num_chunks: int = 1,
+    prefix: str = "pipeline",
+) -> dict:
+    """Publish the schedule analytics this module computes as observability
+    gauges (``obs.MetricRegistry``), so a run's pipeline efficiency is part
+    of its persisted telemetry instead of a hand-run calculation.
+
+    Sets ``{prefix}/bubble_fraction``, the shape parameters, and — for the
+    engine timetables — tick count and stash sizes (the peak-activation
+    memory knob).  Returns the values set, keyed by gauge name."""
+    vals = {
+        f"{prefix}/num_microbatches": float(num_microbatches),
+        f"{prefix}/num_stages": float(num_stages),
+        f"{prefix}/num_chunks": float(num_chunks),
+        f"{prefix}/bubble_fraction": bubble_fraction(
+            num_microbatches, num_stages, schedule, num_chunks),
+    }
+    if schedule == "sync_1f1b":
+        tables = build_sync_slot_tables(num_microbatches, num_stages)
+        vals[f"{prefix}/num_slots"] = float(tables.num_slots)
+        vals[f"{prefix}/fwd_stash_size"] = float(tables.fwd_stash_size)
+        vals[f"{prefix}/bwd_stash_size"] = float(tables.bwd_stash_size)
+    elif schedule == "sync_interleaved":
+        tables = build_interleaved_sync_tables(
+            num_microbatches, num_stages, num_chunks)
+        vals[f"{prefix}/num_slots"] = float(tables.num_slots)
+        vals[f"{prefix}/fwd_stash_size"] = float(tables.stash_size)
+        vals[f"{prefix}/bwd_stash_size"] = float(tables.gstash_size)
+    for name, v in vals.items():
+        registry.gauge(name).set(v)
+    return vals
+
+
 def sync_1f1b_head_overhead(
     num_layers: int,
     num_stages: int,

@@ -129,10 +129,12 @@ def parallel_model_trace(
     )
     lowered = jitted.lower(*shapes)
     compiled = lowered.compile()
+    from neuronx_distributed_tpu.utils.profiling import cost_report
+
     logger.info(
         "traced %s: %s flops (per XLA cost analysis)",
         getattr(fn, "__name__", "fn"),
-        (compiled.cost_analysis() or {}).get("flops", "n/a"),
+        cost_report(compiled).get("flops", "n/a"),
     )
     return compiled
 
@@ -373,11 +375,18 @@ class _ServingBase:
         return jnp.concatenate(toks, axis=1)
 
     def benchmark(
-        self, max_new_tokens: int = 64, warmup: int = 1, prompt_ids=None
+        self, max_new_tokens: int = 64, warmup: int = 1, prompt_ids=None,
+        registry=None,
     ) -> dict:
         """Decode latency/throughput — the neuronperf-equivalent harness
         (reference ``examples/inference/benchmark.py:53-77``): per-token
-        p50/p99 ms, context-encode ms, tokens/s."""
+        p50/p99 ms, context-encode ms, tokens/s.
+
+        ``registry`` (an ``obs.MetricRegistry``) additionally feeds the
+        serving histograms: ``serving/ttft_ms`` (context encode — the
+        time-to-first-token component) and ``serving/decode_ms`` (per-token
+        step latency), so serving runs leave the same persisted telemetry
+        as training runs."""
         cfg = self.config
         B, C, T = cfg.batch_size, cfg.context_len, cfg.max_total_len
         if prompt_ids is None:
@@ -413,6 +422,13 @@ class _ServingBase:
             lat.append((time.perf_counter() - t0) * 1e3)
         lat_arr = np.asarray(lat)
         total_s = lat_arr.sum() / 1e3
+        if registry is not None:
+            from neuronx_distributed_tpu.obs import MS_BUCKETS
+
+            registry.histogram("serving/ttft_ms", MS_BUCKETS).observe(context_ms)
+            decode_hist = registry.histogram("serving/decode_ms", MS_BUCKETS)
+            for ms in lat:
+                decode_hist.observe(ms)
 
         # steady-state throughput: the fused scan loop (no host round-trips);
         # generate() includes the prefill, so subtract the measured context time

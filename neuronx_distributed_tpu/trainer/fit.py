@@ -31,6 +31,7 @@ import dataclasses
 import json
 import signal as _signal
 import threading
+import time
 from typing import Any, Callable, Iterable, Optional
 
 import jax
@@ -122,6 +123,7 @@ def fit(
     scalar_dir: Optional[str] = None,
     metrics: Optional[Any] = None,
     timeline: Optional[Any] = None,
+    obs: "Any | str | None" = None,
     flops_per_token: Optional[float] = None,
     peak_flops: Optional[float] = None,
     step_rng: bool = False,
@@ -149,6 +151,14 @@ def fit(
         on save (half-size checkpoints; optimizer masters stay fp32).
       metrics: a ``TrainingMetrics`` to fill with final summary numbers.
       timeline: a ``utils.Timeline`` for per-step host events.
+      obs: an :class:`~..obs.Observability` instance, or a directory path
+        (one is built there).  Wires the unified telemetry layer into the
+        loop: per-step flight records with the host/device/data-wait time
+        breakdown, anomaly detectors (NaN loss, loss spike, throughput
+        regression), a compile-time HLO collective audit of the train step,
+        registry dumps each ``log_every``, and a flight-record dump on
+        crash/SIGTERM and at exit.  ``tools/obs_report.py`` merges the
+        artifacts into one run summary.
       flops_per_token / peak_flops: enable the MFU summary metric.
       step_rng: pass a per-step PRNG key to the train step (dropout models);
         default None keeps deterministic-eval semantics.
@@ -203,6 +213,14 @@ def fit(
 
     scalars = ScalarWriter(scalar_dir) if scalar_dir else None
 
+    obs_rt = None
+    if obs is not None:
+        from neuronx_distributed_tpu.obs import Observability
+
+        obs_rt = obs if isinstance(obs, Observability) else Observability(
+            str(obs), timeline=timeline)
+    obs_audited = False
+
     thr: Optional[Throughput] = None
     tokens_per_batch = None
     eval_history: list = []
@@ -242,7 +260,9 @@ def fit(
     last_saved_step = -1
     try:
         for step in range(start_step, steps):
+            t_data = time.perf_counter()
             batch = next_batch(step)
+            data_wait_s = time.perf_counter() - t_data
             if thr is None:
                 leaves = jax.tree.leaves(batch)
                 bsz = leaves[0].shape[0]
@@ -252,16 +272,37 @@ def fit(
                 tokens_per_batch = bsz * two_d[0].shape[1] if two_d else None
                 thr = Throughput(bsz)
             rng = jax.random.fold_in(rng0, step) if step_rng else None
+            if obs_rt is not None and not obs_audited:
+                obs_audited = True
+                # one extra AOT lower+compile for the audit; the persistent
+                # compilation cache (when enabled) dedupes the XLA work
+                try:
+                    compiled = step_fn.lower(
+                        params, opt_state, batch, rng).compile()
+                    obs_rt.audit_executable("train_step", compiled)
+                except Exception as e:
+                    logger.warning("obs: train-step HLO audit failed: %s", e)
+            t0 = time.perf_counter()
             if timeline is not None:
                 with timeline.event("train_step"):
                     params, opt_state, m = step_fn(params, opt_state, batch, rng)
-                    loss = float(m["loss"])
+                    t_dispatch = time.perf_counter()
+                    loss = float(m["loss"])  # device sync
+                t_done = time.perf_counter()  # BEFORE the trace-file flush:
+                # step_time_s must compose identically with/without a timeline
                 timeline.mark_step_end(step)  # flushes the event buffer to disk
             else:
                 params, opt_state, m = step_fn(params, opt_state, batch, rng)
+                t_dispatch = time.perf_counter()
                 loss = float(m["loss"])
+                t_done = time.perf_counter()
             seqs = thr.step()
             grad_norm = float(m["grad_norm"])
+            if obs_rt is not None:
+                obs_rt.observe_step(
+                    step, loss=loss, grad_norm=grad_norm, seq_per_sec=seqs,
+                    step_time_s=t_done - t0, host_s=t_dispatch - t0,
+                    device_s=t_done - t_dispatch, data_wait_s=data_wait_s)
             if scalars:
                 scalars.scalars(step, loss=loss, grad_norm=grad_norm,
                                 seq_per_sec=seqs)
@@ -270,6 +311,8 @@ def fit(
             for cb in cbs:
                 cb.on_step(step, step_metrics)
             if log_every and (step % log_every == 0 or step == steps - 1):
+                if obs_rt is not None:
+                    obs_rt.dump_scalars(step)
                 # stdout JSON lines — the launcher-harness contract the example
                 # scripts (and their tests) have always exposed
                 print(json.dumps({
@@ -298,6 +341,10 @@ def fit(
                 final_step = step + 1
                 logger.info("stopping on signal %s after step %d (checkpoint "
                             "follows)", signal_seen[0], final_step)
+                if obs_rt is not None:
+                    # flight evidence lands BEFORE the final checkpoint drains
+                    # — a second (fatal) signal still leaves the dump behind
+                    obs_rt.dump_flight(f"signal_{signal_seen[0]}")
                 break
             if any(cb.should_stop for cb in cbs):
                 final_step = step + 1
@@ -322,6 +369,17 @@ def fit(
                     cb.on_checkpoint(final_step, path)
             else:
                 wait_for_checkpoint()  # cadence save may be async: make it durable
+    except BaseException as e:
+        if obs_rt is not None:
+            # the crash dump is the flight recorder's whole purpose: persist
+            # the last K steps before the exception unwinds the process — but
+            # a telemetry I/O failure (disk full, dir removed) must never
+            # mask the real training exception
+            try:
+                obs_rt.close(f"crash:{type(e).__name__}")
+            except Exception as dump_err:
+                logger.warning("obs: crash dump failed: %s", dump_err)
+        raise
     finally:
         # None = previous handler came from non-Python code and cannot be
         # re-installed from Python: SIG_DFL beats leaving OUR handler
@@ -330,6 +388,8 @@ def fit(
             _signal.signal(_sig, _h if _h is not None else _signal.SIG_DFL)
     if scalars:
         scalars.close()
+    if obs_rt is not None:
+        obs_rt.close(f"signal_{signal_seen[0]}" if signal_seen else "fit_end")
     if metrics is not None and ran_any:
         summary = {
             "final_loss": loss,

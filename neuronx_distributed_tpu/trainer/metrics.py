@@ -8,6 +8,7 @@ promoted from example code into the library."""
 from __future__ import annotations
 
 import json
+import os
 import time
 from collections import deque
 from typing import Optional
@@ -78,5 +79,10 @@ class TrainingMetrics:
         self.metrics.update(kwargs)
 
     def write(self) -> None:
-        with open(self.json_file, "w") as f:
+        # temp file + atomic rename: a crash mid-write (the exact moment the
+        # flight recorder exists to capture) can't leave a corrupt results
+        # JSON behind — the previous complete file survives instead
+        tmp = f"{self.json_file}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(self.metrics, f, indent=2)
+        os.replace(tmp, self.json_file)

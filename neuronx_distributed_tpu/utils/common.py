@@ -3,6 +3,42 @@
 from __future__ import annotations
 
 
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None, check_vma=False):
+    """Version-portable ``jax.shard_map``.
+
+    The codebase targets the jax >= 0.5 surface (``jax.shard_map`` with
+    ``axis_names`` naming the MANUAL axes and ``check_vma``); on older jax
+    the same call maps onto ``jax.experimental.shard_map.shard_map`` with
+    the complementary ``auto`` set and ``check_rep``.  One shim so every
+    call site (engine, ring attention, tests, benches) stays on the new
+    spelling."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kwargs = {"check_rep": check_vma}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            # 0.4-era partial-auto is incomplete in the XLA SPMD partitioner
+            # (PartitionId UNIMPLEMENTED errors, and some interleaved-engine
+            # programs abort the process outright) — refuse cleanly at trace
+            # time instead of letting XLA kill the run
+            raise NotImplementedError(
+                "partial-manual shard_map (manual axes "
+                f"{sorted(axis_names)} with auto axes {sorted(auto)}) "
+                f"requires jax >= 0.5; this environment has jax "
+                "without jax.shard_map")
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+
+
 def ensure_divisibility(numerator: int, denominator: int) -> None:
     if numerator % denominator != 0:
         raise ValueError(f"{numerator} is not divisible by {denominator}")

@@ -15,12 +15,19 @@ DEFAULT_PEAK_FLOPS = 197e12
 DEFAULT_HBM_BYTES_PER_S = 819e9
 
 
-def cost_report(compiled: Any) -> Dict[str, float]:
+def cost_report(compiled: Any, collectives: bool = False) -> Dict[str, Any]:
     """Summarize an executable from ``jax.jit(f).lower(...).compile()``:
     FLOPs, bytes accessed, and (when the backend reports it) the memory
-    breakdown in bytes."""
-    out: Dict[str, float] = {}
+    breakdown in bytes.
+
+    ``collectives=True`` additionally walks the program's HLO for collective
+    ops (counts + result-byte volumes per op kind, via
+    :mod:`~..obs.hlo_audit`) — the compile-time communication view the cost
+    analysis alone doesn't give."""
+    out: Dict[str, Any] = {}
     ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):  # jax < 0.5 returns [per-program dict]
+        ca = ca[0] if ca else {}
     for key in ("flops", "bytes accessed", "transcendentals"):
         if key in ca:
             out[key.replace(" ", "_")] = float(ca[key])
@@ -38,6 +45,16 @@ def cost_report(compiled: Any) -> Dict[str, float]:
             v = getattr(ma, attr, None)
             if v is not None:
                 out[attr] = float(v)
+    if collectives:
+        # late import: obs builds on this module's cost_report
+        from neuronx_distributed_tpu.obs.hlo_audit import (
+            collective_bytes,
+            collective_counts,
+        )
+
+        txt = compiled.as_text()
+        out["collective_counts"] = collective_counts(txt)
+        out["collective_bytes"] = collective_bytes(txt)
     return out
 
 

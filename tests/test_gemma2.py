@@ -17,12 +17,12 @@ torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
 
-def _tiny_pair(sliding_window=8):
+def _tiny_pair(sliding_window=8, query_pre_attn_scalar=16):
     hf_cfg = transformers.Gemma2Config(
         vocab_size=128, hidden_size=64, intermediate_size=96,
         num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=2,
         head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
-        rope_theta=10000.0, query_pre_attn_scalar=16,
+        rope_theta=10000.0, query_pre_attn_scalar=query_pre_attn_scalar,
         attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
         sliding_window=sliding_window,
         hidden_act="gelu_pytorch_tanh", hidden_activation="gelu_pytorch_tanh",
@@ -30,7 +30,8 @@ def _tiny_pair(sliding_window=8):
     )
     cfg = Gemma2Config(
         vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=4,
-        num_heads=8, num_kv_heads=2, head_dim=16, query_pre_attn_scalar=16.0,
+        num_heads=8, num_kv_heads=2, head_dim=16,
+        query_pre_attn_scalar=float(query_pre_attn_scalar),
         attn_softcap=50.0, final_softcap=30.0, sliding_window=sliding_window,
         max_seq_len=64, rms_eps=1e-6, sequence_parallel=False, remat="none",
         dtype=jnp.float32, param_dtype=jnp.float32,
@@ -53,6 +54,35 @@ def test_gemma2_logits_parity(devices8):
     model = Gemma2ForCausalLM(cfg)
     got = jax.jit(model.apply)(params, jnp.asarray(ids.numpy()))
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma2_logits_parity_decoupled_attn_scale(devices8):
+    """27B-style decoupled attention scale: head_dim=16 but
+    query_pre_attn_scalar=32, so attn_scale (1/sqrt(32)) differs from the
+    default 1/sqrt(head_dim) — an implementation that drops attn_scale
+    fails this parity on BOTH the dense and the flash path (ADVICE r5:
+    every prior functional test used scalar == head_dim, leaving the scale
+    numerically invisible).  seq 32 > window 8 keeps the hybrid local
+    layers genuinely banded."""
+    hf_cfg, cfg = _tiny_pair(query_pre_attn_scalar=32)
+    torch.manual_seed(7)
+    hf = transformers.Gemma2ForCausalLM(hf_cfg).eval().float()
+    ids = torch.randint(0, 128, (2, 32))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2)
+    cfg_d = Gemma2Config(**{**cfg.__dict__, "sequence_parallel": True})
+    assert cfg_d.block_config(sliding=False).attn_scale == pytest.approx(
+        32.0 ** -0.5)
+    params = jax.tree.map(jnp.asarray, gemma2_params_from_hf(hf.state_dict(), cfg_d))
+    jids = jnp.asarray(ids.numpy())
+    got_d = jax.jit(Gemma2ForCausalLM(cfg_d).apply)(params, jids)
+    np.testing.assert_allclose(np.asarray(got_d), want, rtol=2e-4, atol=2e-4)
+
+    cfg_f = Gemma2Config(**{**cfg_d.__dict__, "attention_impl": "flash"})
+    got_f = jax.jit(Gemma2ForCausalLM(cfg_f).apply)(params, jids)
+    np.testing.assert_allclose(np.asarray(got_f), want, rtol=5e-4, atol=5e-4)
 
 
 def test_gemma2_converter_roundtrip():

@@ -1,0 +1,503 @@
+"""Observability subsystem tests: registry serialization round-trip,
+histogram bucketing, anomaly detector trigger/no-trigger, flight-recorder
+ring + SIGTERM dump, HLO comm audit on a known TP matmul, and the
+end-to-end ``fit() -> tools/obs_report.py`` merge (the ISSUE 1 acceptance
+path)."""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.obs import (
+    MetricRegistry,
+    Observability,
+    comm_audit,
+    validate_record,
+)
+from neuronx_distributed_tpu.obs.flight import (
+    FlightRecorder,
+    LossSpikeDetector,
+    NanLossDetector,
+    ThroughputRegressionDetector,
+    default_detectors,
+    read_flight,
+)
+from neuronx_distributed_tpu.obs.registry import read_histograms
+from conftest import run_cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_scalar_roundtrip(tmp_path):
+    """Registry dump is the same schema ScalarWriter writes and
+    read_scalars reads; values survive the JSONL round trip exactly."""
+    from neuronx_distributed_tpu.trainer.scalar_log import read_scalars
+
+    reg = MetricRegistry()
+    reg.counter("steps_total").inc(5)
+    reg.gauge("train/loss").set(2.25)
+    h = reg.histogram("lat_ms", (1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0)
+
+    path = str(tmp_path / "scalars.jsonl")
+    reg.dump_jsonl(path, step=7)
+    back = read_scalars(str(tmp_path))
+    for rec in back:
+        validate_record("scalars", rec)
+    by_tag = {r["tag"]: r for r in back}
+    assert by_tag["steps_total"]["value"] == 5.0
+    assert by_tag["train/loss"]["value"] == 2.25
+    assert all(r["step"] == 7 for r in back)
+
+    hists = read_histograms(back)
+    assert hists["lat_ms"]["count"] == 2
+    assert hists["lat_ms"]["sum"] == 5.5
+    assert hists["lat_ms"]["buckets"] == {"1": 1.0, "10": 2.0, "inf": 2.0}
+
+
+def test_registry_get_or_create_and_kind_conflict():
+    reg = MetricRegistry()
+    assert reg.counter("c") is reg.counter("c")
+    with pytest.raises(ValueError):
+        reg.gauge("c")
+    h = reg.histogram("h", (1.0, 2.0))
+    assert reg.histogram("h", (1.0, 2.0)) is h
+    with pytest.raises(ValueError, match="boundaries"):
+        reg.histogram("h", (1.0, 2.0, 3.0))  # conflicting buckets must raise
+
+
+def test_histogram_bucketing():
+    """Prometheus semantics: boundaries are inclusive upper edges, one
+    implicit +Inf bucket, NaN observations are ignored."""
+    reg = MetricRegistry()
+    h = reg.histogram("h", (1.0, 10.0, 100.0))
+    for v in (0.5, 1.0, 5.0, 50.0, 500.0, float("nan")):
+        h.observe(v)
+    assert h.count == 5 and h.sum == 556.5
+    # raw (non-cumulative) bucket counts: (<=1, <=10, <=100, +Inf)
+    assert h.counts == [2, 1, 1, 1]
+    assert h.cumulative() == [(1.0, 2), (10.0, 3), (100.0, 4), (math.inf, 5)]
+    with pytest.raises(ValueError):
+        reg.histogram("bad", (10.0, 1.0))
+
+    text = reg.prometheus_text()
+    assert '# TYPE h histogram' in text
+    assert 'h_bucket{le="1"} 2' in text
+    assert 'h_bucket{le="+Inf"} 5' in text
+    assert "h_count 5" in text
+
+
+def test_prometheus_text_sanitizes_names():
+    reg = MetricRegistry()
+    reg.gauge("train/loss-ema").set(1.0)
+    assert "train_loss_ema 1" in reg.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# anomaly detectors + flight recorder
+# ---------------------------------------------------------------------------
+
+
+def _feed(fr, n, loss=2.0, step_time=0.1, start=0):
+    warns = []
+    for i in range(n):
+        warns += fr.record(start + i, loss=loss, step_time_s=step_time)
+    return warns
+
+
+def test_nan_detector_trigger_and_silent():
+    fr = FlightRecorder(capacity=64, detectors=[NanLossDetector()])
+    assert _feed(fr, 10) == []
+    w = fr.record(10, loss=float("nan"))
+    assert [x["detector"] for x in w] == ["nan_loss"]
+    w = fr.record(11, loss=float("inf"))
+    assert [x["detector"] for x in w] == ["nan_loss"]
+
+
+def test_loss_spike_detector_trigger_and_silent():
+    det = LossSpikeDetector(window=32, z_threshold=6.0, min_history=8)
+    fr = FlightRecorder(capacity=64, detectors=[det])
+    # gentle noise around 2.0: silent
+    for i in range(20):
+        assert fr.record(i, loss=2.0 + 0.01 * (i % 3)) == []
+    w = fr.record(20, loss=50.0)
+    assert [x["detector"] for x in w] == ["loss_spike"]
+    # too little history: silent even for a huge value
+    fr2 = FlightRecorder(capacity=64, detectors=[LossSpikeDetector()])
+    fr2.record(0, loss=2.0)
+    assert fr2.record(1, loss=1e9) == []
+
+
+def test_throughput_regression_detector_trigger_and_silent():
+    det = ThroughputRegressionDetector(window=16, factor=3.0, min_history=8)
+    fr = FlightRecorder(capacity=64, detectors=[det])
+    for i in range(12):
+        assert fr.record(i, loss=2.0, step_time_s=0.1) == []
+    # 2x the median: silent (below factor)
+    assert fr.record(12, loss=2.0, step_time_s=0.2) == []
+    w = fr.record(13, loss=2.0, step_time_s=0.9)
+    assert [x["detector"] for x in w] == ["throughput_regression"]
+
+
+def test_flight_ring_and_dump(tmp_path):
+    path = str(tmp_path / "flight_record.json")
+    fr = FlightRecorder(capacity=4, path=path, detectors=default_detectors())
+    for i in range(10):
+        fr.record(i, loss=2.0 - 0.01 * i, step_time_s=0.05)
+    out = fr.dump("unit_test")
+    assert out == path
+    doc = read_flight(path)
+    assert doc["reason"] == "unit_test"
+    assert doc["steps_recorded"] == 10
+    assert [r["step"] for r in doc["records"]] == [6, 7, 8, 9]  # last K only
+
+
+def test_flight_dump_is_strict_json(tmp_path):
+    """A NaN loss in the ring must not produce a bare NaN token — the dump
+    stays parseable by strict (non-Python) JSON implementations."""
+    path = str(tmp_path / "flight_record.json")
+    fr = FlightRecorder(capacity=8, path=path, detectors=default_detectors())
+    fr.record(0, loss=float("nan"))
+    fr.dump("strict")
+    text = open(path).read()
+
+    def no_const(c):  # pytest-side strict parser
+        raise AssertionError(f"non-strict JSON constant {c!r} in dump")
+
+    doc = json.loads(text, parse_constant=no_const)
+    assert doc["records"][0]["loss"] == "NaN"
+    assert doc["warnings"][0]["detector"] == "nan_loss"
+
+
+# ---------------------------------------------------------------------------
+# HLO comm audit
+# ---------------------------------------------------------------------------
+
+
+def test_collective_parse_counts_and_bytes():
+    txt = "\n".join([
+        "%ar.1 = f32[8,64]{1,0} all-reduce(f32[8,64]{1,0} %x), replica_groups={}",
+        # async start: (operand, result) tuple — only the RESULT is counted
+        "%ag = (f32[4]{0}, bf16[2,2]{1,0}) all-gather-start(f32[4]{0} %y)",
+        # async start with trailing u32[] context buffers (TPU form)
+        "%cp = (f32[16]{0}, f32[16]{0}, u32[], u32[]) "
+        "collective-permute-start(f32[16]{0} %w)",
+        "%ard = f32[8]{0} all-reduce-done(f32[8]{0} %ar2)",  # not counted
+        "%rs = u8[16]{0} reduce-scatter(u8[128]{0} %z)",
+    ])
+    rec = comm_audit(txt, name="crafted")
+    validate_record("hlo_audit", rec)
+    assert rec["collective_counts"] == {
+        "all-reduce": 1, "all-gather": 1, "reduce-scatter": 1,
+        "collective-permute": 1, "all-to-all": 0}
+    assert rec["collective_bytes"]["all-reduce"] == 8 * 64 * 4
+    assert rec["collective_bytes"]["all-gather"] == 2 * 2 * 2  # result only
+    assert rec["collective_bytes"]["collective-permute"] == 16 * 4
+    assert rec["collective_bytes"]["reduce-scatter"] == 16
+    assert rec["total_collective_count"] == 4
+
+
+def test_comm_audit_tp_matmul(devices8):
+    """A contraction-dim-sharded matmul with a replicated output must lower
+    to >= 1 all-reduce moving >= the output bytes — the known-answer case
+    for the audit walking a REAL compiled executable."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(devices8), ("x",))
+    sh = lambda *spec: NamedSharding(mesh, P(*spec))  # noqa: E731
+    a = jax.device_put(jnp.ones((8, 64), jnp.float32), sh(None, "x"))
+    b = jax.device_put(jnp.ones((64, 16), jnp.float32), sh("x", None))
+    compiled = (
+        jax.jit(lambda a, b: a @ b, out_shardings=sh(None, None))
+        .lower(a, b).compile()
+    )
+    rec = comm_audit(compiled, name="tp_matmul")
+    validate_record("hlo_audit", rec)
+    assert rec["collective_counts"]["all-reduce"] >= 1, rec["collective_counts"]
+    assert rec["collective_bytes"]["all-reduce"] >= 8 * 16 * 4
+    assert "cost" in rec  # contents are backend-dependent (CPU reports none)
+
+
+def test_cost_report_collectives_flag(devices8):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from neuronx_distributed_tpu.utils.profiling import cost_report
+
+    mesh = Mesh(np.asarray(devices8), ("x",))
+    x = jax.device_put(jnp.ones((64,), jnp.float32),
+                       NamedSharding(mesh, P("x")))
+    compiled = (
+        jax.jit(lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P()))
+        .lower(x).compile()
+    )
+    rep = cost_report(compiled, collectives=True)
+    assert "collective_counts" in rep and "collective_bytes" in rep
+    assert rep["collective_counts"]["all-reduce"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# pipeline gauge export
+# ---------------------------------------------------------------------------
+
+
+def test_export_schedule_metrics_gauges():
+    from neuronx_distributed_tpu.pipeline.scheduler import (
+        bubble_fraction,
+        export_schedule_metrics,
+    )
+
+    reg = MetricRegistry()
+    vals = export_schedule_metrics(reg, num_microbatches=8, num_stages=4)
+    assert vals["pipeline/bubble_fraction"] == pytest.approx(
+        bubble_fraction(8, 4, "sync_1f1b"))
+    assert reg.gauge("pipeline/num_slots").value == 8 + 2 * 3
+    snap = reg.snapshot()
+    assert snap["pipeline/num_microbatches"] == 8.0
+    # interleaved variant exports its stash sizes too
+    vals = export_schedule_metrics(
+        reg, 8, 4, schedule="sync_interleaved", num_chunks=2, prefix="ppv2")
+    assert 0 < vals["ppv2/bubble_fraction"] < 1
+    assert reg.gauge("ppv2/fwd_stash_size").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: fit() -> artifacts -> tools/obs_report.py
+# ---------------------------------------------------------------------------
+
+
+class _ObsLM(nn.Module):
+    """Tiny TP model whose loss can be poisoned through the batch: the
+    'bad' field is added to the loss, so a NaN batch entry produces the
+    injected-NaN-loss scenario the acceptance criterion names."""
+
+    vocab: int = 64
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, ids):
+        from neuronx_distributed_tpu.parallel.layers import (
+            ColumnParallelLinear,
+            ParallelEmbedding,
+            RowParallelLinear,
+        )
+
+        h = ParallelEmbedding(num_embeddings=self.vocab, features=self.hidden,
+                              dtype=jnp.float32)(ids)
+        h = ColumnParallelLinear(features=64, use_bias=False, dtype=jnp.float32)(h)
+        h = nn.gelu(h)
+        h = RowParallelLinear(features=self.hidden, use_bias=False,
+                              dtype=jnp.float32)(h)
+        return ColumnParallelLinear(features=self.vocab, use_bias=False,
+                                    gather_output=False, dtype=jnp.float32)(h)
+
+
+def _obs_loss(module, params, batch, rng):
+    from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy
+
+    logits = module.apply(params, batch["ids"])
+    return jnp.mean(parallel_cross_entropy(logits, batch["labels"])) \
+        + jnp.mean(batch["bad"])
+
+
+def _run_obs_fit(tmp_path, nan_from_step=None, steps=10):
+    from neuronx_distributed_tpu.trainer import (
+        default_batch_spec,
+        fit,
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+    )
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    nxd.initialize_model_parallel(tensor_parallel_size=2)
+    config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3,
+                                 compute_dtype="float32")
+    model = initialize_parallel_model(
+        config, _ObsLM, (jnp.zeros((1, 8), jnp.int32),))
+    opt = initialize_parallel_optimizer(config, model)
+
+    ids = jax.random.randint(jax.random.PRNGKey(0), (8, 8), 0, 64)
+
+    def data(step):
+        bad = float("nan") if (nan_from_step is not None
+                               and step >= nan_from_step) else 0.0
+        return {"ids": ids, "labels": jnp.roll(ids, -1, axis=1),
+                "bad": jnp.full((8,), bad, jnp.float32)}
+
+    obs_dir = str(tmp_path / "obs")
+    scalar_dir = str(tmp_path / "scalars")
+    timeline = Timeline(os.path.join(obs_dir, "host_trace.json"))
+    spec = default_batch_spec()
+    res = fit(config, model, opt, data, steps=steps, loss_fn=_obs_loss,
+              batch_spec={"ids": spec, "labels": spec, "bad": spec},
+              log_every=2, scalar_dir=scalar_dir, timeline=timeline,
+              obs=obs_dir)
+    timeline.mark_step_end()  # flush any trailing instants (anomaly markers)
+    return obs_dir, scalar_dir, res
+
+
+def _build_report_cli(tmp_path, obs_dir, scalar_dir):
+    out = str(tmp_path / "report.json")
+    md = str(tmp_path / "report.md")
+    run_cli(os.path.join(REPO, "tools", "obs_report.py"),
+            "--run-dir", obs_dir, "--scalar-dir", scalar_dir,
+            "--out", out, "--markdown", md)
+    with open(out) as f:
+        report = json.load(f)
+    validate_record("obs_report", report)
+    return report, open(md).read()
+
+
+def test_obs_report_end_to_end_clean_run(tmp_path):
+    """ISSUE 1 acceptance: a short CPU-mesh fit() + obs_report.py produce
+    one summary holding step metrics, a histogram, a flight-recorder tail,
+    and an HLO comm-audit record with nonzero collective counts — and the
+    anomaly detectors stay silent on the clean run."""
+    obs_dir, scalar_dir, res = _run_obs_fit(tmp_path)
+    assert np.isfinite(res.final_loss)
+    report, md = _build_report_cli(tmp_path, obs_dir, scalar_dir)
+
+    # step metrics from BOTH scalar streams (trainer writer + obs registry)
+    assert report["scalars"]["loss"]["count"] >= 10
+    assert report["scalars"]["train/loss"]["last"] == pytest.approx(
+        res.final_loss)
+    # at least one histogram with every step observed
+    assert report["histograms"]["train/step_time_ms"]["count"] == 10
+    assert report["histograms"]["train/data_wait_ms"]["count"] == 10
+    # flight-recorder tail
+    assert report["flight"]["reason"] == "fit_end"
+    tail = report["flight"]["tail"]
+    assert tail and tail[-1]["step"] == 9
+    assert {"loss", "grad_norm", "step_time_s", "host_s", "device_s",
+            "data_wait_s"} <= set(tail[-1])
+    # HLO comm audit with nonzero collective counts (tp=2 train step)
+    audits = report["hlo_audits"]
+    assert audits and audits[0]["name"] == "train_step"
+    assert audits[0]["total_collective_count"] > 0
+    assert audits[0]["total_collective_bytes"] > 0
+    # detectors silent on the clean run
+    assert report["anomalies"] == []
+    assert report["health"]["anomaly_count"] == 0
+    # timeline merged (train_step spans from the Timeline file)
+    assert report["timeline"]["events"] >= 10
+    # markdown rendering covers the same sections
+    for heading in ("# Run report", "## Step metrics", "## Histograms",
+                    "## Flight recorder", "## HLO communication audits"):
+        assert heading in md, md[:2000]
+
+
+def test_obs_report_end_to_end_nan_run(tmp_path):
+    """Injected NaN loss: the nan_loss detector fires, the warnings land in
+    the flight record, and the report surfaces them."""
+    obs_dir, scalar_dir, _ = _run_obs_fit(tmp_path, nan_from_step=5)
+    report, md = _build_report_cli(tmp_path, obs_dir, scalar_dir)
+    assert report["health"]["anomaly_count"] >= 1
+    detectors = {w["detector"] for w in report["anomalies"]}
+    assert "nan_loss" in detectors
+    assert min(w["step"] for w in report["anomalies"]) == 5
+    assert "## Anomalies" in md
+    # the anomaly instants also reached the timeline
+    assert any(m["name"] == "anomaly/nan_loss"
+               for m in report["timeline"]["anomaly_markers"])
+
+
+_OBS_SIGNAL_WORKER = '''
+import os, sys
+sys.path.insert(0, sys.argv[2])
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \\
+    " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.trainer import fit, initialize_parallel_model, \\
+    initialize_parallel_optimizer, default_batch_spec
+from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear
+from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy
+from flax import linen as nn
+
+class M(nn.Module):
+    @nn.compact
+    def __call__(self, ids):
+        h = nn.Embed(64, 32, dtype=jnp.float32)(ids)
+        return ColumnParallelLinear(features=64, use_bias=False,
+                                    gather_output=False, dtype=jnp.float32)(h)
+
+def loss(module, params, batch, rng):
+    return jnp.mean(parallel_cross_entropy(
+        module.apply(params, batch["ids"]), batch["labels"]))
+
+nxd.initialize_model_parallel(tensor_parallel_size=2)
+config = nxd.training_config(tensor_parallel_size=2, learning_rate=1e-3,
+                             compute_dtype="float32")
+model = initialize_parallel_model(config, M, (jnp.zeros((1, 8), jnp.int32),))
+opt = initialize_parallel_optimizer(config, model)
+ids = jax.random.randint(jax.random.PRNGKey(0), (4, 8), 0, 64)
+data = lambda step: {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+spec = default_batch_spec()
+res = fit(config, model, opt, data, steps=100000, loss_fn=loss,
+          batch_spec={"ids": spec, "labels": spec},
+          ckpt_dir=sys.argv[1] + "/ck", log_every=1,
+          checkpoint_on_signal=True, obs=sys.argv[1] + "/obs")
+print(f"OBS-FIT-DONE steps_run={res.steps_run}", flush=True)
+'''
+
+
+def test_obs_flight_dump_on_sigterm(tmp_path):
+    """The flight recorder rides fit()'s existing signal path: SIGTERM mid-
+    run leaves flight_record.json behind with a signal reason and the last
+    steps' records (mirrors test_trainer.test_fit_checkpoint_on_sigterm)."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_OBS_SIGNAL_WORKER)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    out_path, err_path = tmp_path / "out.log", tmp_path / "err.log"
+    with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, str(worker), str(tmp_path), REPO],
+            stdout=out_f, stderr=err_f, text=True, env=env,
+        )
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if '"step"' in out_path.read_text():
+                break
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"worker exited rc={proc.returncode} before training:\n"
+                    f"{err_path.read_text()[-3000:]}")
+            time.sleep(0.2)
+        else:
+            proc.kill()
+            raise AssertionError("worker never reached a training step:\n"
+                                 f"{err_path.read_text()[-3000:]}")
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise AssertionError("worker did not stop after SIGTERM")
+    assert proc.returncode == 0, err_path.read_text()[-3000:]
+    assert "OBS-FIT-DONE" in out_path.read_text()
+    doc = read_flight(str(tmp_path / "obs" / "flight_record.json"))
+    assert doc["reason"].startswith("signal_")
+    assert doc["records"], "flight ring empty after a running fit"
+    assert math.isfinite(doc["records"][-1]["loss"])
+    # the audit record landed too (the obs dir is complete evidence)
+    assert os.path.exists(str(tmp_path / "obs" / "hlo_audit.jsonl"))

@@ -42,6 +42,8 @@ def bench_all_reduce(devices) -> list[dict]:
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from neuronx_distributed_tpu.utils.common import shard_map as _shard_map
+
     n = len(devices)
     mesh = Mesh(devices, ("x",))
     rows = []
@@ -55,7 +57,7 @@ def bench_all_reduce(devices) -> list[dict]:
 
         @jax.jit
         def allreduce(x):
-            return jax.shard_map(
+            return _shard_map(
                 lambda s: jax.lax.psum(s, "x"),
                 mesh=mesh, in_specs=P("x", None), out_specs=P("x", None),
             )(x)

@@ -1,0 +1,86 @@
+"""obs_report — merge a run's telemetry artifacts into one summary.
+
+Reads whatever exists of: an obs run dir (``scalars.jsonl`` registry dumps,
+``flight_record.json``, ``hlo_audit.jsonl``, timeline traces), extra scalar
+streams (e.g. the trainer's ``--scalar-dir``), and extra timeline files —
+and emits a single JSON summary (stdout or ``--out``) plus an optional
+markdown rendering.  The "why was step N slow / why did the run die / how
+many bytes did this program move" questions answered from artifacts alone.
+
+Usage:
+    python tools/obs_report.py --run-dir /runs/r1/obs
+    python tools/obs_report.py --run-dir obs/ --scalar-dir /tb/run1 \
+        --timeline trace.json --out report.json --markdown report.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable as `python tools/obs_report.py`
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--run-dir", default=None,
+                   help="obs output dir (scalars.jsonl / flight_record.json / "
+                        "hlo_audit.jsonl / *trace*.json inside it)")
+    p.add_argument("--scalar-dir", action="append", default=[],
+                   help="extra dir holding a scalars.jsonl (repeatable)")
+    p.add_argument("--scalars", action="append", default=[],
+                   help="extra scalars.jsonl file (repeatable)")
+    p.add_argument("--flight", default=None, help="flight_record.json path")
+    p.add_argument("--hlo-audit", default=None, help="hlo_audit.jsonl path")
+    p.add_argument("--timeline", action="append", default=[],
+                   help="Chrome-trace timeline file (repeatable)")
+    p.add_argument("--tail", type=int, default=10,
+                   help="flight-record tail length in the summary")
+    p.add_argument("--out", default=None, help="write JSON here (default stdout)")
+    p.add_argument("--markdown", default=None, help="also write a markdown rendering")
+    args = p.parse_args(argv)
+
+    if not (args.run_dir or args.scalar_dir or args.scalars or args.flight
+            or args.hlo_audit or args.timeline):
+        p.error("nothing to report on: pass --run-dir or explicit artifact paths")
+
+    from neuronx_distributed_tpu.obs.report import build_report, render_markdown
+    from neuronx_distributed_tpu.obs.schemas import validate_record
+
+    scalar_paths = list(args.scalars)
+    for d in args.scalar_dir:
+        q = os.path.join(d, "scalars.jsonl")
+        if os.path.exists(q):
+            scalar_paths.append(q)
+        else:
+            print(f"obs_report: no scalars.jsonl in {d}", file=sys.stderr)
+
+    report = build_report(
+        run_dir=args.run_dir,
+        scalar_paths=scalar_paths,
+        flight_path=args.flight,
+        hlo_audit_path=args.hlo_audit,
+        timeline_paths=args.timeline,
+        tail=args.tail,
+    )
+    validate_record("obs_report", report)  # the emitter honors its own schema
+
+    text = json.dumps(report, indent=2, sort_keys=False)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    if args.markdown:
+        with open(args.markdown, "w") as f:
+            f.write(render_markdown(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
