@@ -19,6 +19,10 @@ framework-native analogue of the reference's
   # cached decode vs teacher-forced full forward
   python examples/inference/runner.py check-accuracy --preset tiny --tp 2 \
       --batch-size 2 --context-len 32 --max-total-len 64 --virtual-devices 8
+
+  # continuous-batching serving demo (Poisson arrivals, streamed tokens)
+  python examples/inference/runner.py serve --preset tiny --batch-size 3 \
+      --context-len 16 --max-total-len 32 --num-requests 6 --rate 50
 """
 
 import argparse
@@ -157,6 +161,86 @@ def cmd_spec_decode(args):
     sys.exit(0 if identical else 1)
 
 
+def cmd_serve(args):
+    """Continuous-batching serving demo: drive ``ServingEngine`` from a JSONL
+    prompt file (``{"prompt_ids": [...], "max_new_tokens"?, "temperature"?}``
+    per line; random prompts when no file) with Poisson arrivals, streaming
+    each token as a JSONL event and ending with one stats line."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from neuronx_distributed_tpu.serving import (
+        Request, SamplingParams, ServingEngine, replay_trace)
+
+    cfg, _, _, model = build_model(args)
+    rs = np.random.RandomState(args.seed)
+    specs = []
+    if args.prompts:
+        with open(args.prompts) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    specs.append(json.loads(line))
+        # the whole file unless --num-requests explicitly caps it
+        if args.num_requests is not None:
+            specs = specs[: args.num_requests]
+    else:
+        n = args.num_requests if args.num_requests is not None else 8
+        specs = [
+            {"prompt_ids": rs.randint(
+                1, cfg.vocab_size,
+                size=rs.randint(2, args.context_len + 1)).tolist()}
+            for _ in range(n)
+        ]
+    if not specs:
+        raise SystemExit("serve: no prompts (empty --prompts file or "
+                         "--num-requests 0)")
+    gaps = rs.exponential(1.0 / args.rate, size=len(specs))
+    arrivals = np.cumsum(gaps) - gaps[0]
+
+    def stream(req, tok):
+        if not args.quiet:
+            print(json.dumps({"event": "token", "request_id": req.request_id,
+                              "token": int(tok)}), flush=True)
+
+    engine = ServingEngine(
+        model, rng=jax.random.PRNGKey(args.seed), stats_path=args.stats_out)
+    requests = [
+        Request(
+            request_id=i,
+            prompt_ids=s["prompt_ids"],
+            max_new_tokens=int(s.get("max_new_tokens", args.max_new_tokens)),
+            sampling=SamplingParams(
+                temperature=float(s.get("temperature", args.temperature))),
+            stream_cb=stream,
+        )
+        for i, s in enumerate(specs)
+    ]
+
+    def done(out):
+        print(json.dumps({"event": "done", "request_id": out.request_id,
+                          "state": out.state, "tokens": list(out.token_ids)}),
+              flush=True)
+
+    t0 = time.monotonic()
+    outputs = replay_trace(engine, arrivals, requests, on_output=done)
+    wall = time.monotonic() - t0
+    engine.close()
+    snap = engine.registry.snapshot()
+    ttfts = [o.ttft_ms for o in outputs.values() if o.ttft_ms is not None]
+    print(json.dumps({
+        "requests": len(outputs),
+        "finished": int(snap.get("serving/finished_total", 0)),
+        "tokens": int(snap.get("serving/tokens_total", 0)),
+        "ttft_p50_ms": float(np.percentile(ttfts, 50)) if ttfts else None,
+        "wall_s": round(wall, 4),
+        "tokens_per_s": (int(snap.get("serving/tokens_total", 0)) /
+                         max(wall, 1e-9)),
+    }))
+
+
 def cmd_benchmark(args):
     from neuronx_distributed_tpu.trace import parallel_model_load
 
@@ -224,6 +308,25 @@ def main():
     sp = sub.add_parser("benchmark", help="p50/p99 per-token latency")
     common(sp, traced=True)
     sp.set_defaults(fn=cmd_benchmark)
+
+    sp = sub.add_parser("serve", help="continuous-batching serving demo: "
+                                      "JSONL prompts, Poisson arrivals, "
+                                      "streamed tokens + stats line")
+    common(sp)
+    sp.add_argument("--prompts", default=None,
+                    help="JSONL prompt file ({'prompt_ids': [...]} per line; "
+                         "random prompts when omitted)")
+    sp.add_argument("--num-requests", type=int, default=None,
+                    help="request count (default: whole --prompts file, or "
+                         "8 random prompts)")
+    sp.add_argument("--rate", type=float, default=20.0,
+                    help="Poisson arrival rate, requests/s")
+    sp.add_argument("--temperature", type=float, default=0.0)
+    sp.add_argument("--stats-out", default=None,
+                    help="serving_stats.jsonl output path")
+    sp.add_argument("--quiet", action="store_true",
+                    help="suppress per-token stream events")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("spec-decode", help="speculative decoding: verify + time vs plain greedy")
     common(sp)

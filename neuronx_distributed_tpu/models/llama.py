@@ -356,7 +356,15 @@ class CoreAttention(nn.Module):
         scores = scores * scale
         if cfg.attn_softcap is not None:
             scores = cfg.attn_softcap * jnp.tanh(scores / cfg.attn_softcap)
-        mask = _causal_mask(S, T, q_offset, cfg.sliding_window)[None, None, None]
+        if jnp.ndim(q_offset) == 1:
+            # per-example query offsets [B] (continuous-batching decode: each
+            # slot is at its own cache position) — the ONE band-mask
+            # definition, vmapped per row: [B, 1, 1, S, T]
+            mask = jax.vmap(
+                lambda off: _causal_mask(S, T, off, cfg.sliding_window)
+            )(q_offset)[:, None, None]
+        else:
+            mask = _causal_mask(S, T, q_offset, cfg.sliding_window)[None, None, None]
         if kv_valid is not None:
             # per-example key validity [B, T] (left-padded serving batches,
             # the reference's padded HF batches, neuron_modeling_llama.py:437-465)
@@ -402,8 +410,23 @@ class LlamaAttention(nn.Module):
         if kv_cache is not None:
             # decode: write new k/v at cache_offset, attend over the cache
             ck, cv = kv_cache
-            ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_offset, axis=1)
-            cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_offset, axis=1)
+            if jnp.ndim(cache_offset) == 1:
+                # per-example write positions [B] (continuous batching: every
+                # slot decodes at its own offset).  Single-token steps only —
+                # a masked select over the time axis instead of a slice
+                # update; an out-of-range offset (>= T) writes nothing, which
+                # lets idle slots park harmlessly at T.
+                if k.shape[1] != 1:
+                    raise ValueError(
+                        "per-example cache offsets support single-token "
+                        f"decode only, got {k.shape[1]} new positions")
+                hot = (jnp.arange(ck.shape[1])[None, :]
+                       == cache_offset[:, None])[:, :, None, None]
+                ck = jnp.where(hot, k.astype(ck.dtype), ck)
+                cv = jnp.where(hot, v.astype(cv.dtype), cv)
+            else:
+                ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_offset, axis=1)
+                cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_offset, axis=1)
             new_cache = (ck, cv)
             k, v = ck, cv
 

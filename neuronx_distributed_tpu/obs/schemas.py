@@ -39,6 +39,15 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     },
     # one line of docs/tpu_watch_results.jsonl (tools/tpu_watch.py append)
     "tpu_watch": {"ts": str, "kind": str},
+    # one line of serving_stats.jsonl (serving.engine.ServingEngine) —
+    # one record per TERMINAL request; ttft_ms is null for requests that
+    # never produced a token (cancelled/timed out while queued)
+    "serving_stats": {
+        "schema": str, "time": _NUM, "request_id": int, "state": str,
+        "finish_reason": (str, type(None)), "prompt_len": int,
+        "new_tokens": int, "queue_ms": _NUM,
+        "ttft_ms": (int, float, type(None)), "total_ms": _NUM,
+    },
     # tools/obs_report.py output document
     "obs_report": {
         "schema": str, "generated_at": _NUM, "scalars": dict,

@@ -1,0 +1,42 @@
+"""Continuous-batching serving subsystem (ISSUE 2 tentpole).
+
+Iteration-level scheduling over the AOT decode executables: requests enter
+and leave the fixed-``B`` batch independently (per-slot KV offsets +
+slot-insert prefill), with per-request sampler params, rng streams, stop
+conditions, streaming callbacks, FCFS admission control, cancellation and
+deadlines — the serving layer the ROADMAP's "heavy traffic from millions of
+users" north star points at.
+
+- :mod:`.request` — Request/RequestOutput lifecycle (QUEUED → PREFILL →
+  DECODE → {FINISHED, CANCELLED, TIMED_OUT}) and SamplingParams;
+- :mod:`.scheduler` — the fixed-slot-table FCFS scheduler (pure host-side,
+  property-tested: no slot leak, FIFO preserved, capacity bound);
+- :mod:`.engine` — ``ServingEngine.step()``: sweep → admit/prefill →
+  batched per-slot decode → stop detection → slot free, exporting telemetry
+  through the PR-1 ``obs.MetricRegistry`` and ``serving_stats.jsonl``.
+"""
+
+from neuronx_distributed_tpu.serving.engine import (
+    SERVING_STATS_SCHEMA,
+    ServingEngine,
+    replay_trace,
+)
+from neuronx_distributed_tpu.serving.request import (
+    Request,
+    RequestOutput,
+    RequestState,
+    SamplingParams,
+)
+from neuronx_distributed_tpu.serving.scheduler import AdmissionError, SlotScheduler
+
+__all__ = [
+    "ServingEngine",
+    "SERVING_STATS_SCHEMA",
+    "Request",
+    "RequestOutput",
+    "RequestState",
+    "SamplingParams",
+    "AdmissionError",
+    "SlotScheduler",
+    "replay_trace",
+]

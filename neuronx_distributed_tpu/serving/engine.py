@@ -1,0 +1,386 @@
+"""Continuous-batching serving engine over the AOT decode executables.
+
+``ServingEngine.step()`` is the iteration-level scheduling loop (Orca,
+OSDI '22): sweep cancellations/deadlines, admit queued requests into free
+slots (single-request prefill + KV slot-insert into the live donated
+caches), run ONE batched decode step with per-slot cache offsets, sample
+each slot from its own request's rng stream and sampler params, stream the
+tokens, and free the slots of finished requests — so requests enter and
+leave the batch independently instead of in lockstep, closing the
+utilization gap of the static ``generate`` batch (slots no longer idle
+until the longest request finishes).
+
+The compiled-program contract: the engine owns the live batch state
+(``caches [B, T, ...]``, ``valid [B, T]``, per-slot offsets) and threads it
+through three phase executables on the serving wrapper —
+``prefill_one`` (the batched context fn at B=1, numerically identical to a
+solo prefill), ``insert_slot`` (donated batch-axis scatter), and
+``decode_slots`` (the per-slot-offset generalization of ``decode``).  Greedy
+outputs are token-identical to a solo ``generate`` of the same prompt: the
+per-row mask/position machinery reproduces the scalar-offset math row by
+row, and masked lanes contribute exactly zero probability.
+
+Telemetry goes through the PR-1 ``obs.MetricRegistry`` (queue-depth /
+slot-occupancy gauges, TTFT and inter-token histograms, admission /
+finish / cancel counters) and per-request ``serving_stats.jsonl`` records
+validated by ``obs.schemas``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.obs import MS_BUCKETS, MetricRegistry
+from neuronx_distributed_tpu.serving.request import (
+    Request,
+    RequestOutput,
+    RequestState,
+)
+from neuronx_distributed_tpu.serving.scheduler import SlotScheduler
+from neuronx_distributed_tpu.trace.engine import _sample_logits, request_rng
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+SERVING_STATS_SCHEMA = "serving_stats/1"
+
+
+@jax.jit
+def _sample_rows(logits, base_keys, tok_idx, temperature, top_k, top_p):
+    """Row-wise sampler: every slot draws token ``tok_idx[b]`` from its own
+    request stream (``fold_in(base_keys[b], tok_idx[b])`` — the
+    per-token fold_in happens INSIDE the jit, so the hot decode loop pays
+    zero per-slot host dispatches) with its own sampler params.  One
+    compiled program serves any mix of greedy/sampled slots — greedy rows
+    take the ``where(temperature > 0)`` argmax branch and ignore their key.
+    Module-level jit so every engine over the same shapes shares one
+    compile."""
+    def row(lg, key, idx, t, k, p):
+        return _sample_logits(lg, jax.random.fold_in(key, idx), t, k, p)
+
+    return jax.vmap(row)(logits, base_keys, tok_idx, temperature, top_k, top_p)
+
+
+def replay_trace(engine: "ServingEngine", arrivals, requests,
+                 on_output=None, clock=time.monotonic, sleep=time.sleep):
+    """Replay an arrival trace through a live engine: submit each request
+    when its arrival time (seconds from replay start) passes, stepping the
+    engine in between and sleeping only when idle ahead of the next
+    arrival.  The ONE drive loop shared by ``tools/serve_bench.py
+    --continuous`` and the runner's ``serve`` subcommand.  Returns
+    ``{request_id: RequestOutput}``; ``on_output`` additionally fires per
+    terminal request as it completes (streaming hooks ride on the requests
+    themselves via ``stream_cb``)."""
+    if len(arrivals) != len(requests):
+        raise ValueError(
+            f"arrivals ({len(arrivals)}) and requests ({len(requests)}) "
+            "must pair up")
+    outputs = {}
+    t0 = clock()
+    next_i = 0
+    while next_i < len(requests) or engine.has_work:
+        now = clock() - t0
+        while next_i < len(requests) and arrivals[next_i] <= now:
+            engine.submit(requests[next_i])
+            next_i += 1
+        if engine.has_work:
+            for out in engine.step():
+                outputs[out.request_id] = out
+                if on_output is not None:
+                    on_output(out)
+        elif next_i < len(requests):
+            sleep(min(arrivals[next_i] - now, 0.05))
+    return outputs
+
+
+class ServingEngine:
+    """Continuous-batching engine over a :class:`~..trace.ParallelInferenceModel`.
+
+    ``model`` must expose the per-slot serving surface (``prefill_one`` /
+    ``insert_slot`` / ``decode_slots``) — ``ParallelInferenceModel`` does;
+    exported ``LoadedInferenceModel`` artifacts carry only the scalar-offset
+    context/decode pair and are rejected up front.
+
+    ``rng`` seeds the per-request sampling streams
+    (``fold_in(fold_in(rng, request_id), token_index)`` — the same streams
+    ``generate(request_ids=...)`` draws from, so a sampled request's tokens
+    are independent of its co-batch).  Greedy requests need no rng.
+
+    ``stats_path`` appends one schema-checked ``serving_stats`` JSONL record
+    per terminal request.  ``registry`` (an ``obs.MetricRegistry``) receives
+    the serving gauges/histograms/counters; one is created when omitted so
+    metrics are always available via :attr:`registry`.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        *,
+        rng: Optional[jax.Array] = None,
+        registry: Optional[MetricRegistry] = None,
+        stats_path: Optional[str] = None,
+        eos_token_id: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        for attr in ("prefill_one", "insert_slot", "decode_slots"):
+            if not hasattr(model, attr):
+                raise TypeError(
+                    f"model {type(model).__name__} has no {attr!r}: the "
+                    "continuous-batching engine needs the per-slot serving "
+                    "surface of ParallelInferenceModel (exported artifacts "
+                    "carry only the scalar-offset context/decode pair)")
+        self.model = model
+        cfg = model.config
+        self.B = cfg.batch_size
+        self.C = cfg.context_len
+        self.T = cfg.max_total_len
+        self.scheduler = SlotScheduler(self.B, self.C, self.T)
+        self.registry = registry if registry is not None else MetricRegistry()
+        # compiled-cache evictions (trace._CompiledLRU) surface here too.
+        # The caches live on the MODEL, which may outlive this engine or be
+        # shared by several — attach only when nothing is attached yet, so
+        # an existing registry (another live engine's, or one the caller set
+        # explicitly) keeps receiving its counts.
+        if getattr(model, "metrics_registry", None) is None:
+            model.metrics_registry = self.registry
+        self.eos_token_id = eos_token_id
+        self._rng = rng
+        self._clock = clock
+        self._stats_path = stats_path
+        self._stats_f = None
+
+        # live device state: the batch as a resource pool
+        self.caches = model.empty_caches()
+        self.valid = jnp.zeros((self.B, self.T), jnp.int32)
+        self._offsets = np.full((self.B,), self.T, np.int32)  # T = parked
+        self._next_tok = np.zeros((self.B,), np.int32)
+        self._last_tok_time: List[Optional[float]] = [None] * self.B
+        # per-slot sampling state, written once at admission so the decode
+        # loop builds no per-slot keys host-side: base_keys[b] is the
+        # request-stream key fold_in(rng, request_id) (zeros = greedy)
+        self._base_keys = np.zeros((self.B, 2), np.uint32)
+        self._temps = np.zeros((self.B,), np.float32)
+        self._topks = np.zeros((self.B,), np.int32)
+        self._topps = np.ones((self.B,), np.float32)
+
+        # pre-declare so a zero-request engine still exports the full set
+        reg = self.registry
+        reg.gauge("serving/queue_depth")
+        reg.gauge("serving/slots_active")
+        reg.histogram("serving/ttft_ms", MS_BUCKETS)
+        reg.histogram("serving/intertoken_ms", MS_BUCKETS)
+        for c in ("admitted", "finished", "cancelled", "timed_out", "tokens"):
+            reg.counter(f"serving/{c}_total")
+
+    # -- request surface ---------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        """Queue a request (FCFS).  Raises ``AdmissionError`` when it can
+        never fit the compiled envelope, ``ValueError`` for a sampled
+        request on an rng-less engine."""
+        if request.sampling.temperature > 0.0 and self._rng is None:
+            raise ValueError(
+                f"request {request.request_id} samples (temperature "
+                f"{request.sampling.temperature}) but the engine has no rng")
+        self.scheduler.submit(request, now=self._clock())
+
+    def cancel(self, request_id: int) -> bool:
+        return self.scheduler.cancel(request_id)
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.queue_depth > 0 or self.scheduler.active_count > 0
+
+    # -- engine loop -------------------------------------------------------
+
+    def step(self) -> List[RequestOutput]:
+        """One engine iteration: sweep → admit/prefill → batched decode →
+        per-slot stop detection → slot free.  Returns the requests that
+        reached a terminal state during this step."""
+        outputs: List[RequestOutput] = []
+        now = self._clock()
+
+        # 1) cancellation / deadline sweep (frees slots before admission)
+        swept = self.scheduler.sweep(now)
+        if swept:
+            self._park_free_slots()
+            for req in swept:
+                self.registry.counter(
+                    "serving/cancelled_total"
+                    if req.state is RequestState.CANCELLED
+                    else "serving/timed_out_total").inc()
+                outputs.append(self._emit(req, now))
+
+        # 2) admission: slot-insert prefill per granted request
+        for slot, req in self.scheduler.admit(now):
+            self._prefill_into_slot(slot, req, outputs)
+
+        # 3) one batched decode step over every decoding slot
+        active = [(slot, req) for slot, req in self.scheduler.active()
+                  if req.state is RequestState.DECODE]
+        if active:
+            self._decode_step(active, outputs)
+
+        self.registry.gauge("serving/queue_depth").set(self.scheduler.queue_depth)
+        self.registry.gauge("serving/slots_active").set(self.scheduler.active_count)
+        return outputs
+
+    def run_until_complete(self, max_steps: Optional[int] = None) -> List[RequestOutput]:
+        """Drive ``step()`` until queue and slots drain; returns every
+        terminal output in completion order."""
+        outputs: List[RequestOutput] = []
+        steps = 0
+        while self.has_work:
+            outputs.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"serving engine did not drain in {max_steps} steps "
+                    f"(queue={self.scheduler.queue_depth}, "
+                    f"active={self.scheduler.active_count})")
+        return outputs
+
+    def close(self) -> None:
+        if self._stats_f is not None:
+            self._stats_f.close()
+            self._stats_f = None
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # -- internals ---------------------------------------------------------
+
+    def _prefill_into_slot(self, slot: int, req: Request, outputs: list) -> None:
+        """Single-request prefill, KV/validity slot-insert, first token."""
+        L = req.prompt_len
+        ids = np.zeros((1, self.C), np.int32)
+        ids[0, self.C - L:] = req.prompt_ids  # LEFT-padded to the traced width
+        valid_ctx = jnp.asarray(
+            (np.arange(self.C) >= self.C - L).astype(np.int32))[None, :]
+        logits, row_caches = self.model.prefill_one(jnp.asarray(ids), valid_ctx)
+        row_valid = jnp.concatenate(
+            [valid_ctx, jnp.zeros((1, self.T - self.C), jnp.int32)], axis=1)
+        self.caches, self.valid = self.model.insert_slot(
+            self.caches, row_caches, self.valid, row_valid, slot)
+
+        s = req.sampling
+        if s.temperature > 0.0 and self._rng is not None:
+            self._base_keys[slot] = np.asarray(
+                request_rng(self._rng, req.request_id))
+        else:
+            self._base_keys[slot] = 0  # greedy: the sampler ignores the key
+        self._temps[slot] = s.temperature
+        self._topks[slot] = s.top_k
+        self._topps[slot] = s.top_p
+        tok = int(_sample_rows(
+            logits, jnp.asarray(self._base_keys[slot])[None, :],
+            jnp.zeros((1,), jnp.int32),
+            jnp.full((1,), s.temperature, jnp.float32),
+            jnp.full((1,), s.top_k, jnp.int32),
+            jnp.full((1,), s.top_p, jnp.float32))[0])
+        now = self._clock()
+        req.transition(RequestState.DECODE)
+        req.first_token_time = now
+        if req.submit_time is not None:
+            self.registry.histogram("serving/ttft_ms", MS_BUCKETS).observe(
+                (now - req.submit_time) * 1e3)
+        self.registry.counter("serving/admitted_total").inc()
+        self._append_token(slot, req, tok, now)
+        if not req.done:
+            self._offsets[slot] = self.C
+            self._next_tok[slot] = tok
+        else:
+            outputs.append(self._emit(req, now))
+
+    def _decode_step(self, active: list, outputs: list) -> None:
+        """One per-slot-offset decode over the whole batch; inactive slots
+        are parked at offset ``T`` (write nothing, logits ignored).  The
+        per-token sampling keys are derived INSIDE the jitted sampler from
+        the admission-time per-slot base keys — no per-slot host work here."""
+        tok_idx = np.zeros((self.B,), np.int32)
+        for slot, req in active:
+            tok_idx[slot] = len(req.generated)
+
+        logits, self.caches, self.valid = self.model.decode_slots(
+            jnp.asarray(self._next_tok)[:, None], self._offsets,
+            self.caches, self.valid)
+        toks = np.asarray(_sample_rows(
+            logits, jnp.asarray(self._base_keys), jnp.asarray(tok_idx),
+            jnp.asarray(self._temps), jnp.asarray(self._topks),
+            jnp.asarray(self._topps)))
+        now = self._clock()
+        for slot, req in active:
+            self._offsets[slot] += 1  # the step wrote req's previous token
+            tok = int(toks[slot])
+            last = self._last_tok_time[slot]
+            if last is not None:
+                ms = (now - last) * 1e3
+                req.intertoken_ms.append(ms)
+                self.registry.histogram(
+                    "serving/intertoken_ms", MS_BUCKETS).observe(ms)
+            self._append_token(slot, req, tok, now)
+            if not req.done:
+                self._next_tok[slot] = tok
+            else:
+                outputs.append(self._emit(req, now))
+
+    def _append_token(self, slot: int, req: Request, tok: int, now: float) -> None:
+        """Record + stream one generated token; finish the request when it
+        hits a stop condition (slot freed immediately)."""
+        req.generated.append(tok)
+        self._last_tok_time[slot] = now
+        self.registry.counter("serving/tokens_total").inc()
+        if req.stream_cb is not None:
+            req.stream_cb(req, tok)
+        reason = req.check_stop(tok)
+        if (reason is None and self.eos_token_id is not None
+                and tok == self.eos_token_id):
+            reason = "stop_token"  # engine-level EOS (tokenizer-wide)
+        if reason is not None:
+            req.transition(RequestState.FINISHED)
+            req.finish_reason = reason
+            req.finish_time = now
+            self.scheduler.release(req)
+            self._offsets[slot] = self.T  # park
+            self._last_tok_time[slot] = None
+            self.registry.counter("serving/finished_total").inc()
+
+    def _park_free_slots(self) -> None:
+        """Reset the device-side state of every slot without a live occupant
+        (after a sweep freed cancelled/timed-out requests): offset ``T``
+        writes nothing, so a freed slot is inert until its next insert."""
+        live = {slot for slot, _ in self.scheduler.active()}
+        for slot in range(self.B):
+            if slot not in live:
+                self._offsets[slot] = self.T
+                self._last_tok_time[slot] = None
+
+    def _emit(self, req: Request, now: float) -> RequestOutput:
+        out = RequestOutput.from_request(req, now)
+        if self._stats_path is not None:
+            if self._stats_f is None:
+                self._stats_f = open(self._stats_path, "a")
+            rec = {
+                "schema": SERVING_STATS_SCHEMA,
+                "time": time.time(),
+                "request_id": out.request_id,
+                "state": out.state,
+                "finish_reason": out.finish_reason,
+                "prompt_len": out.prompt_len,
+                "new_tokens": len(out.token_ids),
+                "queue_ms": out.queue_ms,
+                "ttft_ms": out.ttft_ms,
+                "total_ms": out.total_ms,
+            }
+            self._stats_f.write(json.dumps(rec) + "\n")
+            self._stats_f.flush()
+        return out

@@ -1,0 +1,164 @@
+"""Request lifecycle for the continuous-batching serving engine.
+
+A :class:`Request` is the unit the engine schedules: it enters QUEUED,
+moves to PREFILL when a slot is granted, DECODE after its prompt's KV rows
+are slot-inserted, and terminates in exactly one of FINISHED (EOS / length),
+CANCELLED (caller), or TIMED_OUT (deadline sweep).  Transitions are
+validated — an illegal edge is an engine bug, not a recoverable condition.
+
+Per-request sampler settings (:class:`SamplingParams`) and stop conditions
+ride on the request, so one compiled decode program serves every
+temperature / top-k / top-p combination in the batch (the iteration-level
+scheduling model of Orca, OSDI '22; the slot-table analogue of vLLM's
+sequence groups, SOSP '23).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, List, Optional, Sequence, Tuple
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    TIMED_OUT = "timed_out"
+
+
+# legal lifecycle edges; terminal states have no successors
+_TRANSITIONS = {
+    RequestState.QUEUED: {RequestState.PREFILL, RequestState.CANCELLED,
+                          RequestState.TIMED_OUT},
+    RequestState.PREFILL: {RequestState.DECODE, RequestState.FINISHED,
+                           RequestState.CANCELLED, RequestState.TIMED_OUT},
+    RequestState.DECODE: {RequestState.FINISHED, RequestState.CANCELLED,
+                          RequestState.TIMED_OUT},
+    RequestState.FINISHED: set(),
+    RequestState.CANCELLED: set(),
+    RequestState.TIMED_OUT: set(),
+}
+
+TERMINAL_STATES = frozenset(
+    s for s, nxt in _TRANSITIONS.items() if not nxt
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampler knobs (the same three ``generate`` takes);
+    ``temperature == 0`` is exact greedy and needs no rng."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def __post_init__(self):
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request.
+
+    ``prompt_ids`` is the UNPADDED token list (the engine left-pads to the
+    compiled context length).  ``deadline_s`` is a relative budget from
+    submission; the scheduler's sweep times the request out wherever it is
+    (queued or decoding).  ``stream_cb(request, token_id)`` fires once per
+    generated token, before the request completes — the streaming hook."""
+
+    request_id: int
+    prompt_ids: Sequence[int]
+    max_new_tokens: int
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    stop_token_ids: Tuple[int, ...] = ()
+    deadline_s: Optional[float] = None
+    stream_cb: Optional[Callable[["Request", int], None]] = None
+
+    # lifecycle (engine-owned)
+    state: RequestState = RequestState.QUEUED
+    generated: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None
+    submit_time: Optional[float] = None
+    prefill_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    intertoken_ms: List[float] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        self.prompt_ids = [int(t) for t in self.prompt_ids]
+        if not self.prompt_ids:
+            raise ValueError(f"request {self.request_id}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.request_id}: max_new_tokens must be >= 1, "
+                f"got {self.max_new_tokens}")
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def done(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def transition(self, new_state: RequestState) -> None:
+        if new_state not in _TRANSITIONS[self.state]:
+            raise RuntimeError(
+                f"request {self.request_id}: illegal transition "
+                f"{self.state.value} -> {new_state.value}")
+        self.state = new_state
+
+    def check_stop(self, token: int) -> Optional[str]:
+        """Finish reason after appending ``token``, or None to keep going."""
+        if token in self.stop_token_ids:
+            return "stop_token"
+        if len(self.generated) >= self.max_new_tokens:
+            return "length"
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestOutput:
+    """Terminal snapshot handed to the caller (and the ``serving_stats``
+    record source): the generated tokens plus the latency decomposition —
+    queue wait, TTFT (submit → first token), end-to-end total."""
+
+    request_id: int
+    state: str
+    finish_reason: Optional[str]
+    prompt_len: int
+    token_ids: Tuple[int, ...]
+    queue_ms: float
+    ttft_ms: Optional[float]
+    total_ms: float
+    intertoken_ms: Tuple[float, ...] = ()
+
+    @staticmethod
+    def from_request(req: Request, now: float) -> "RequestOutput":
+        if not req.done:
+            raise ValueError(f"request {req.request_id} is not terminal "
+                             f"({req.state.value})")
+        submit = req.submit_time if req.submit_time is not None else now
+        queue_end = req.prefill_time if req.prefill_time is not None else now
+        return RequestOutput(
+            request_id=req.request_id,
+            state=req.state.value,
+            finish_reason=req.finish_reason,
+            prompt_len=req.prompt_len,
+            token_ids=tuple(req.generated),
+            queue_ms=max(queue_end - submit, 0.0) * 1e3,
+            ttft_ms=(
+                (req.first_token_time - submit) * 1e3
+                if req.first_token_time is not None else None),
+            total_ms=max(now - submit, 0.0) * 1e3,
+            intertoken_ms=tuple(req.intertoken_ms),
+        )

@@ -1,0 +1,203 @@
+"""Slot scheduler: FCFS admission over a fixed-size slot table.
+
+The compiled decode program has a fixed batch axis ``B``; this scheduler
+treats that axis as a RESOURCE POOL of ``B`` slots (iteration-level
+scheduling, Orca OSDI '22) rather than a tensor shape.  Requests queue FCFS;
+a request is admitted the moment a slot is free and its shape fits the
+compiled envelope; cancellation and deadline sweeps free slots immediately
+so the next queued request can enter on the same engine step.
+
+Pure host-side bookkeeping — no jax imports — so every policy property
+(no slot leak, FIFO order, capacity bound, cancellation frees the slot) is
+testable without compiling anything.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from neuronx_distributed_tpu.serving.request import Request, RequestState
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+class AdmissionError(ValueError):
+    """Request can never fit the compiled serving envelope."""
+
+
+class SlotScheduler:
+    """Fixed-``B`` slot table + FCFS queue.
+
+    Admission gates (checked at ``submit`` — a request that can NEVER fit
+    is rejected up front rather than parked forever):
+
+    - ``prompt_len <= context_len`` (the compiled prefill width);
+    - ``context_len + max_new_tokens <= max_total_len`` (decode slots start
+      at the prefill boundary, so this — not ``prompt_len +
+      max_new_tokens`` — is the binding cache-capacity bound).
+    """
+
+    def __init__(self, num_slots: int, context_len: int, max_total_len: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self.context_len = context_len
+        self.max_total_len = max_total_len
+        self._queue: deque = deque()
+        self._slots: List[Optional[Request]] = [None] * num_slots
+        self._slot_of: Dict[int, int] = {}
+        self._by_id: Dict[int, Request] = {}
+        self._cancel_requested: set = set()
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._slot_of)
+
+    @property
+    def free_count(self) -> int:
+        return self.num_slots - len(self._slot_of)
+
+    def active(self) -> List[Tuple[int, Request]]:
+        """``[(slot, request), ...]`` for every occupied slot."""
+        return sorted(
+            (slot, self._slots[slot]) for slot in self._slot_of.values()
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def submit(self, request: Request, now: Optional[float] = None) -> None:
+        """Queue a request FCFS; raises :class:`AdmissionError` when it can
+        never fit the compiled envelope."""
+        if request.request_id in self._by_id:
+            raise ValueError(f"duplicate request id {request.request_id}")
+        if request.prompt_len > self.context_len:
+            raise AdmissionError(
+                f"request {request.request_id}: prompt_len "
+                f"{request.prompt_len} > context_len {self.context_len}")
+        if self.context_len + request.max_new_tokens > self.max_total_len:
+            raise AdmissionError(
+                f"request {request.request_id}: context_len + max_new_tokens "
+                f"({self.context_len} + {request.max_new_tokens}) > "
+                f"max_total_len {self.max_total_len} (decode slots start at "
+                "the prefill boundary)")
+        request.submit_time = time.monotonic() if now is None else now
+        self._by_id[request.request_id] = request
+        self._queue.append(request)
+
+    def cancel(self, request_id: int) -> bool:
+        """Flag a request for cancellation (applied by the next ``sweep``);
+        returns False for unknown/already-terminal ids."""
+        req = self._by_id.get(request_id)
+        if req is None or req.done:
+            return False
+        self._cancel_requested.add(request_id)
+        return True
+
+    def sweep(self, now: Optional[float] = None) -> List[Request]:
+        """Apply cancellations and deadline expiries — queued requests are
+        dropped from the queue, running ones have their slot freed.  Returns
+        the newly-terminal requests (caller emits their outputs)."""
+        now = time.monotonic() if now is None else now
+        swept: List[Request] = []
+
+        def expired(req: Request) -> bool:
+            return (req.deadline_s is not None and req.submit_time is not None
+                    and now - req.submit_time > req.deadline_s)
+
+        for req in list(self._queue):
+            reason = None
+            if req.request_id in self._cancel_requested:
+                reason = RequestState.CANCELLED
+            elif expired(req):
+                reason = RequestState.TIMED_OUT
+            if reason is not None:
+                self._queue.remove(req)
+                self._by_id.pop(req.request_id, None)
+                req.transition(reason)
+                req.finish_reason = reason.value
+                req.finish_time = now
+                swept.append(req)
+        for slot, req in self.active():
+            reason = None
+            if req.request_id in self._cancel_requested:
+                reason = RequestState.CANCELLED
+            elif expired(req):
+                reason = RequestState.TIMED_OUT
+            if reason is not None:
+                req.transition(reason)
+                req.finish_reason = reason.value
+                req.finish_time = now
+                self.release(req)
+                swept.append(req)
+        self._cancel_requested.difference_update(r.request_id for r in swept)
+        return swept
+
+    def admit(self, now: Optional[float] = None) -> List[Tuple[int, Request]]:
+        """FCFS admission: grant free slots to queue heads (order
+        preserved — the head blocks nobody behind it only when a slot is
+        free for it too, which is always true under FCFS).  Transitions each
+        granted request to PREFILL; returns ``[(slot, request), ...]``."""
+        now = time.monotonic() if now is None else now
+        grants: List[Tuple[int, Request]] = []
+        while self._queue and self.free_count > 0:
+            req = self._queue.popleft()
+            slot = next(i for i, r in enumerate(self._slots) if r is None)
+            self._slots[slot] = req
+            self._slot_of[req.request_id] = slot
+            req.transition(RequestState.PREFILL)
+            req.prefill_time = now
+            grants.append((slot, req))
+        return grants
+
+    def release(self, request: Request) -> int:
+        """Free a terminal request's slot; returns the slot index.  The
+        scheduler drops every reference to the request (a long-lived server
+        must not accumulate one Request — with its token lists — per
+        request served), so its id becomes reusable."""
+        if not request.done:
+            raise ValueError(
+                f"request {request.request_id} is not terminal "
+                f"({request.state.value}); finish/cancel it first")
+        slot = self._slot_of.pop(request.request_id, None)
+        if slot is None:
+            raise ValueError(f"request {request.request_id} holds no slot")
+        self._slots[slot] = None
+        self._by_id.pop(request.request_id, None)
+        self._cancel_requested.discard(request.request_id)
+        return slot
+
+    # -- invariants --------------------------------------------------------
+
+    def assert_invariants(self) -> None:
+        """No slot leak, no double occupancy, capacity respected, queue
+        holds only QUEUED requests.  O(B + queue) — cheap enough to run
+        every engine step in tests."""
+        occupied = [i for i, r in enumerate(self._slots) if r is not None]
+        assert len(occupied) == len(self._slot_of), (
+            f"slot leak: {len(occupied)} occupied slots vs "
+            f"{len(self._slot_of)} tracked requests")
+        assert len(occupied) <= self.num_slots
+        for rid, slot in self._slot_of.items():
+            req = self._slots[slot]
+            assert req is not None and req.request_id == rid, (
+                f"slot {slot} does not hold request {rid}")
+            assert req.state in (RequestState.PREFILL, RequestState.DECODE), (
+                f"slot {slot} holds terminal/queued request {rid} "
+                f"({req.state.value})")
+        seen = set()
+        for req in self._queue:
+            assert req.state is RequestState.QUEUED, (
+                f"queued request {req.request_id} in state {req.state.value}")
+            assert req.request_id not in self._slot_of, (
+                f"request {req.request_id} both queued and slotted")
+            assert req.request_id not in seen
+            seen.add(req.request_id)

@@ -7,6 +7,7 @@ from neuronx_distributed_tpu.trace.engine import (
     ParallelInferenceModel,
     init_kv_caches,
     parallel_model_trace,
+    request_rng,
     speculative_generate,
 )
 from neuronx_distributed_tpu.trace.export import (
@@ -23,5 +24,6 @@ __all__ = [
     "parallel_model_trace",
     "parallel_model_save",
     "parallel_model_load",
+    "request_rng",
     "speculative_generate",
 ]

@@ -56,6 +56,60 @@ from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
+# default bound on each lazily-jitted per-shape executable cache (decode
+# loops per n, chunk scorers per length, serving phase fns) — a long-lived
+# serving process must not grow compile caches without limit
+COMPILED_CACHE_SIZE = 8
+
+
+class _CompiledLRU:
+    """Small LRU for lazily-jitted executables, keyed by shape-ish tuples.
+
+    ``owner`` is the serving wrapper; when it carries a ``metrics_registry``
+    (an ``obs.MetricRegistry``, set by the serving engine), evictions are
+    counted there as ``trace/compiled_cache_evictions_total`` so a long-lived
+    server's recompile churn is visible in the persisted telemetry."""
+
+    def __init__(self, name: str, capacity: int = COMPILED_CACHE_SIZE,
+                 owner: Any = None):
+        from collections import OrderedDict
+
+        self.name = name
+        self.capacity = max(1, int(capacity))
+        self.owner = owner
+        self._d: "OrderedDict" = OrderedDict()
+
+    def get(self, key):
+        fn = self._d.get(key)
+        if fn is not None:
+            self._d.move_to_end(key)
+        return fn
+
+    def put(self, key, fn) -> None:
+        self._d[key] = fn
+        self._d.move_to_end(key)
+        if len(self._d) > self.capacity:
+            old_key, _ = self._d.popitem(last=False)
+            logger.info(
+                "compiled-fn cache %r evicted key %r (capacity %d)",
+                self.name, old_key, self.capacity,
+            )
+            reg = getattr(self.owner, "metrics_registry", None)
+            if reg is not None:
+                reg.counter("trace/compiled_cache_evictions_total").inc()
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+def request_rng(rng: jax.Array, request_id: int) -> jax.Array:
+    """Per-request sampling stream: fold the request id into the batch-level
+    key, so a sampled request's output depends only on ``(rng, request_id,
+    token index)`` — never on which requests it happens to be co-batched
+    with.  Shared convention between ``generate(request_ids=...)`` and the
+    continuous-batching :class:`~..serving.ServingEngine`."""
+    return jax.random.fold_in(rng, jnp.uint32(request_id))
+
 
 def _filtered_logits(logits, temperature, top_k=0, top_p=1.0):
     """Temperature/top-k/nucleus-filtered fp32 logits — the distribution the
@@ -95,12 +149,21 @@ def _sample_logits(logits, rng, temperature, top_k=0, top_p=1.0):
     ``temperature == 0.0`` short-circuit kept so greedy callers need no rng.
     Serving parity with HF ``generate``'s standard sampler knobs (the
     reference drives its compiled pair through HF generate,
-    ``neuron_modeling_llama.py:437-465``)."""
+    ``neuron_modeling_llama.py:437-465``).
+
+    ``rng`` may also be a BATCH of keys ``[B, 2]`` (one per example — the
+    per-request streams of ``generate(request_ids=...)`` and the serving
+    engine): each row is then drawn with its own key."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if isinstance(temperature, (int, float)) and float(temperature) == 0.0:
         return greedy
     filtered = _filtered_logits(logits, temperature, top_k, top_p)
-    sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
+    if rng is not None and jnp.ndim(rng) == 2 and logits.ndim == 2:
+        sampled = jax.vmap(
+            lambda key, lg: jax.random.categorical(key, lg, axis=-1)
+        )(rng, filtered).astype(jnp.int32)
+    else:
+        sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
     return jnp.where(jnp.asarray(temperature, jnp.float32) > 0.0, sampled, greedy)
 
 
@@ -255,7 +318,7 @@ class _ServingBase:
         (temperature / top_k / top_p) are RUNTIME scalars, so one compiled
         loop per ``n`` serves every per-request sampler setting."""
         if not hasattr(self, "_loop_cache"):
-            self._loop_cache = {}
+            self._loop_cache = _CompiledLRU("decode_loop", owner=self)
         fn = self._loop_cache.get(n)
         if fn is not None:
             return fn
@@ -276,7 +339,7 @@ class _ServingBase:
             return toks.T  # [B, n]
 
         fn = jax.jit(loop, donate_argnums=(3,))
-        self._loop_cache[n] = fn
+        self._loop_cache.put(n, fn)
         return fn
 
     def generate(
@@ -289,6 +352,7 @@ class _ServingBase:
         fused: bool = True,
         top_k: int = 0,
         top_p: float = 1.0,
+        request_ids: Optional[Sequence[int]] = None,
     ) -> jax.Array:
         """Prefill + fixed-length decode; returns ``[B, C + max_new_tokens]``.
 
@@ -296,7 +360,14 @@ class _ServingBase:
         enables ragged batches.  ``fused`` (default) runs the whole decode as
         one jitted ``lax.scan`` — zero host round-trips; ``fused=False``
         steps the single-token executable (the reference's per-token
-        HF-generate driving, ``neuron_modeling_llama.py:437-465``)."""
+        HF-generate driving, ``neuron_modeling_llama.py:437-465``).
+
+        ``request_ids`` (one int per example, with ``rng``) switches sampling
+        to PER-REQUEST rng streams: row ``b`` draws token ``i`` with
+        ``fold_in(fold_in(rng, request_ids[b]), i)`` (:func:`request_rng`),
+        so a sampled request's output is reproducible regardless of which
+        requests it is co-batched with — the continuous-batching
+        :class:`~..serving.ServingEngine` samples from the same streams."""
         cfg = self.config
         B, C = prompt_ids.shape
         chunk = cfg.context_len
@@ -342,21 +413,37 @@ class _ServingBase:
                     self.params, ids[:, i * chunk:(i + 1) * chunk],
                     jnp.int32(i * chunk), caches, valid_full,
                 )
-        first_rng = jax.random.fold_in(rng, 0) if rng is not None else None
-        first = self._sample(logits, first_rng, temperature, top_k, top_p)[:, None]
+        row_keys = None
+        if request_ids is not None:
+            if rng is None:
+                raise ValueError("request_ids requires an rng key")
+            rids = jnp.asarray(request_ids, jnp.uint32)
+            if rids.shape != (B,):
+                raise ValueError(f"request_ids shape {rids.shape} != ({B},)")
+            row_keys = jax.vmap(lambda r: request_rng(rng, r))(rids)  # [B, 2]
+
+        def tok_rng(i):
+            """Key(s) for generated-token index ``i``: shared fold_in stream,
+            or per-request streams when ``request_ids`` is given."""
+            if rng is None:
+                return None
+            if row_keys is None:
+                return jax.random.fold_in(rng, i)
+            return jax.vmap(lambda k: jax.random.fold_in(k, i))(row_keys)
+
+        first = self._sample(logits, tok_rng(0), temperature, top_k, top_p)[:, None]
         if max_new_tokens == 1:
             return jnp.concatenate([prompt_ids, first], axis=1)
 
         n_more = max_new_tokens - 1
         if fused:
             # one vmapped fold_in (not n host dispatches); indices 1..n match
-            # the stepped path's per-step fold_in exactly (parity-tested)
-            rngs = (
-                jax.vmap(lambda i: jax.random.fold_in(rng, i))(
-                    jnp.arange(1, n_more + 1))
-                if rng is not None
-                else jnp.zeros((n_more, 2), jnp.uint32)
-            )
+            # the stepped path's per-step fold_in exactly (parity-tested).
+            # Per-request streams carry [n, B, 2] keys through the scan.
+            if rng is None:
+                rngs = jnp.zeros((n_more, 2), jnp.uint32)
+            else:
+                rngs = jax.vmap(tok_rng)(jnp.arange(1, n_more + 1))
             more = self._decode_loop(n_more)(
                 self.params, first, jnp.int32(C), caches, valid_full, rngs,
                 jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p),
@@ -366,7 +453,7 @@ class _ServingBase:
         toks = [prompt_ids, first]
         nxt = first
         for step in range(n_more):
-            step_rng = jax.random.fold_in(rng, 1 + step) if rng is not None else None
+            step_rng = tok_rng(1 + step)
             logits, caches, valid_full = self.decode(
                 self.params, nxt, jnp.int32(C + step), caches, valid_full
             )
@@ -545,14 +632,14 @@ class ParallelInferenceModel(_ServingBase):
         pinned to the same batch/cache shardings as the AOT executables so
         its caches/masks feed straight back into them."""
         if not hasattr(self, "_score_cache"):
-            self._score_cache = {}
+            self._score_cache = _CompiledLRU("score_chunk", owner=self)
         fn = self._score_cache.get(ids.shape[1])
         if fn is None:
             io = self._io_shardings  # set by _build; unpinned outputs would
             # silently reintroduce the dp>1 placement mismatch, so fail loudly
             fn = jax.jit(self._score_chunk_fn, donate_argnums=(3,),
                          out_shardings=(None, io["cache_out"], io["batch"](None)))
-            self._score_cache[ids.shape[1]] = fn
+            self._score_cache.put(ids.shape[1], fn)
         return fn(self.params, ids, jnp.int32(offset), caches, valid)
 
     def _decode_fn(self, params, tok, offset, caches, valid):
@@ -568,6 +655,80 @@ class ParallelInferenceModel(_ServingBase):
             params, tok, positions, caches, offset, kv_valid=valid
         )
         return logits[:, -1, :], caches, valid
+
+    # -- continuous-batching phase fns (serving/engine.ServingEngine) ------
+
+    def _decode_slots_fn(self, params, tok, offsets, caches, valid):
+        """One token step with PER-SLOT cache offsets ``[B]`` — the
+        continuous-batching generalization of :meth:`_decode_fn`: every slot
+        writes its new key at its own position and takes its RoPE phase from
+        its own validity prefix, so requests at different depths decode in
+        one batched step.  An offset of ``T`` parks an idle slot (writes
+        nothing).  Returns ``(logits [B, V], caches, valid)``."""
+        T = valid.shape[1]
+        hot = jnp.arange(T)[None, :] == offsets[:, None]  # [B, T]
+        valid = jnp.where(hot, 1, valid)  # the new token becomes a key
+        # per-example position: number of valid keys strictly before offset
+        before = jnp.where(jnp.arange(T)[None, :] < offsets[:, None], valid, 0)
+        positions = jnp.sum(before, axis=1, keepdims=True).astype(jnp.int32)
+        logits, caches = self.module.apply(
+            params, tok, positions, caches, offsets, kv_valid=valid
+        )
+        return logits[:, -1, :], caches, valid
+
+    def decode_slots(self, tok, offsets, caches, valid):
+        """Compiled per-slot decode step (lazily jitted, cache donated);
+        ``offsets`` is the per-slot next-write index ``[B]`` (``T`` = idle).
+        Outputs pinned to the AOT executables' shardings."""
+        if not hasattr(self, "_serving_cache"):
+            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        fn = self._serving_cache.get("decode_slots")
+        if fn is None:
+            io = self._io_shardings
+            fn = jax.jit(self._decode_slots_fn, donate_argnums=(3,),
+                         out_shardings=(None, io["cache_out"], io["batch"](None)))
+            self._serving_cache.put("decode_slots", fn)
+        return fn(self.params, tok, jnp.asarray(offsets, jnp.int32), caches, valid)
+
+    def prefill_one(self, ids, valid):
+        """Single-request prefill ``[1, C] -> (logits [1, V], caches B=1)``
+        — the same pure phase fn as the batched ``context`` executable, so a
+        slot-inserted request's prefill is numerically identical to a solo
+        ``generate``'s.  The returned one-row caches feed
+        :meth:`insert_slot`."""
+        if not hasattr(self, "_serving_cache"):
+            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        fn = self._serving_cache.get("prefill_one")
+        if fn is None:
+            fn = jax.jit(self._context_fn)
+            self._serving_cache.put("prefill_one", fn)
+        return fn(self.params, ids.astype(jnp.int32), valid)
+
+    def _insert_slot_fn(self, caches, row_caches, valid, row_valid, slot):
+        """Scatter a prefilled request into live batch state: write the
+        one-row KV caches and validity row at batch index ``slot`` (traced,
+        so one compiled program serves every slot)."""
+        caches = jax.tree.map(
+            lambda c, r: jax.lax.dynamic_update_slice_in_dim(
+                c, r.astype(c.dtype), slot, axis=0),
+            caches, row_caches,
+        )
+        valid = jax.lax.dynamic_update_slice_in_dim(valid, row_valid, slot, axis=0)
+        return caches, valid
+
+    def insert_slot(self, caches, row_caches, valid, row_valid, slot):
+        """Compiled slot insert (live caches + validity donated — requests
+        enter the batch without copying the other slots)."""
+        if not hasattr(self, "_serving_cache"):
+            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        fn = self._serving_cache.get("insert_slot")
+        if fn is None:
+            io = self._io_shardings
+            fn = jax.jit(self._insert_slot_fn, donate_argnums=(0, 2),
+                         out_shardings=(io["cache_out"], io["batch"](None)))
+            self._serving_cache.put("insert_slot", fn)
+        return fn(caches, row_caches, valid.astype(jnp.int32),
+                  jnp.asarray(row_valid, jnp.int32), jnp.int32(slot))
 
     def _build(self):
         from jax.sharding import NamedSharding
@@ -645,7 +806,8 @@ class ParallelInferenceModel(_ServingBase):
             self.prefill_chunk = self._prefill_chunk_jit.lower(
                 params_spec, ids_spec, off_spec, cache_spec, valid_spec
             ).compile()
-        self._loop_cache = {}
+        self._loop_cache = _CompiledLRU("decode_loop", owner=self)
+        self._serving_cache = _CompiledLRU("serving_phase", owner=self)
         self._arg_specs = (
             params_spec, ids_spec, vctx_spec, tok_spec, off_spec, cache_spec,
             valid_spec,

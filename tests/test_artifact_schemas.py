@@ -29,7 +29,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def test_schema_list_is_complete():
     """The artifact kinds the framework documents all have schemas."""
     assert {"scalars", "flight_record", "flight_step", "anomaly",
-            "hlo_audit", "tpu_watch", "obs_report"} <= set(SCHEMAS)
+            "hlo_audit", "tpu_watch", "obs_report",
+            "serving_stats"} <= set(SCHEMAS)
 
 
 def test_committed_tpu_watch_results_validate():
@@ -102,6 +103,33 @@ def test_flight_and_audit_and_report_validate(tmp_path):
     report = build_report(run_dir=obs.out_dir)
     validate_record("obs_report", report)
     assert report["health"]["anomaly_count"] == 1
+
+
+def test_serving_stats_schema(tmp_path):
+    """One serving_stats record per terminal request: the shape the serving
+    engine emits (the live-emitter path is validated end-to-end in
+    tests/test_serving.py) — including the null ttft_ms of a request that
+    never produced a token."""
+    from neuronx_distributed_tpu.serving.engine import SERVING_STATS_SCHEMA
+
+    recs = [
+        {"schema": SERVING_STATS_SCHEMA, "time": 1.0, "request_id": 0,
+         "state": "finished", "finish_reason": "length", "prompt_len": 5,
+         "new_tokens": 8, "queue_ms": 0.5, "ttft_ms": 12.0, "total_ms": 40.0},
+        {"schema": SERVING_STATS_SCHEMA, "time": 2.0, "request_id": 1,
+         "state": "timed_out", "finish_reason": "timed_out", "prompt_len": 3,
+         "new_tokens": 0, "queue_ms": 100.0, "ttft_ms": None, "total_ms": 100.0},
+    ]
+    path = tmp_path / "serving_stats.jsonl"
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    assert validate_jsonl("serving_stats", str(path)) == 2
+    with pytest.raises(ValueError, match="missing required field"):
+        validate_record("serving_stats", {"schema": SERVING_STATS_SCHEMA})
+    with pytest.raises(ValueError, match="expected"):
+        bad = dict(recs[0], new_tokens="8")
+        validate_record("serving_stats", bad)
 
 
 def test_validate_record_rejects_bad_records():

@@ -1,11 +1,17 @@
-"""Serving decode-latency benchmark on the local chip — one JSON line.
+"""Serving benchmark on the local chip — one JSON line.
 
-Measures the bench-scale (438M, the single-chip Llama-2-7B/TP8 slice) model
-through the serving engine's neuronperf-equivalent harness
-(`trace.engine.benchmark`: context-encode ms, per-token p50/p99 ms,
-tokens/s — reference `examples/inference/benchmark.py:53-77`).  Run by the
-TPU watcher in a healthy window (VERDICT r3 #6: record serving latency in
-the repo); `--tiny` smoke-tests the harness on CPU.
+Two modes:
+
+- default: static-batch decode latency through the serving engine's
+  neuronperf-equivalent harness (`trace.engine.benchmark`: context-encode
+  ms, per-token p50/p99 ms, tokens/s — reference
+  `examples/inference/benchmark.py:53-77`).  Run by the TPU watcher in a
+  healthy window (VERDICT r3 #6); `--tiny` smoke-tests the harness on CPU.
+- `--continuous`: replays a Poisson arrival trace through the
+  continuous-batching `serving.ServingEngine` and reports TTFT p50/p99,
+  inter-token p50/p99, and goodput against the static lockstep `generate`
+  baseline over the same prompts — the utilization gap iteration-level
+  scheduling closes.  Writes a schema-checked `serving_stats.jsonl`.
 """
 
 from __future__ import annotations
@@ -14,8 +20,109 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _percentiles(values, ps=(50, 99)):
+    import numpy as np
+
+    if not values:
+        return {f"p{p}": None for p in ps}
+    arr = np.asarray(values, dtype=float)
+    return {f"p{p}": float(np.percentile(arr, p)) for p in ps}
+
+
+def run_continuous(args, model, vocab_size: int) -> dict:
+    """Replay a Poisson arrival trace through ServingEngine; compare against
+    lockstep static batches of the same prompts."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+    from neuronx_distributed_tpu.serving import Request, ServingEngine, replay_trace
+
+    B, C = model.config.batch_size, model.config.context_len
+    rs = np.random.RandomState(args.seed)
+    n = args.num_requests
+    if n < 1:
+        raise SystemExit(f"--continuous needs --num-requests >= 1, got {n}")
+    prompts = [
+        rs.randint(1, vocab_size, size=rs.randint(max(2, C // 4), C + 1)).tolist()
+        for _ in range(n)
+    ]
+    # Poisson process: exponential inter-arrival gaps at --arrival-rate req/s
+    gaps = rs.exponential(1.0 / args.arrival_rate, size=n)
+    arrivals = np.cumsum(gaps) - gaps[0]  # first request arrives at t=0
+
+    # warm every compiled phase (prefill_one/insert_slot/decode_slots + the
+    # static baseline's fused loop) so compile time never pollutes TTFT;
+    # one registry across warm + measured engines so model-level compiled-
+    # cache metrics land in the snapshot we report
+    registry = MetricRegistry()
+    warm = ServingEngine(model, registry=registry, stats_path=None)
+    warm.submit(Request(request_id=-1, prompt_ids=prompts[0],
+                        max_new_tokens=min(2, args.max_new_tokens)))
+    warm.run_until_complete(max_steps=1000)
+    pad = np.zeros((B, C), np.int32)
+    jax.block_until_ready(model.generate(
+        jnp.asarray(pad), args.max_new_tokens,
+        prompt_lens=jnp.full((B,), C, jnp.int32)))
+
+    stats_path = args.stats_out or os.path.join(
+        tempfile.mkdtemp(prefix="serve_bench_"), "serving_stats.jsonl")
+    if os.path.exists(stats_path):
+        os.remove(stats_path)
+    engine = ServingEngine(model, registry=registry, stats_path=stats_path)
+    t0 = time.monotonic()
+    outputs = replay_trace(
+        engine, arrivals,
+        [Request(request_id=i, prompt_ids=prompts[i],
+                 max_new_tokens=args.max_new_tokens) for i in range(n)])
+    t_cont = time.monotonic() - t0
+    engine.close()
+
+    n_stats = validate_jsonl("serving_stats", stats_path)
+    assert n_stats == n, f"expected {n} serving_stats records, got {n_stats}"
+
+    total_tokens = sum(len(o.token_ids) for o in outputs.values())
+    ttfts = [o.ttft_ms for o in outputs.values() if o.ttft_ms is not None]
+    inter = [ms for o in outputs.values() for ms in o.intertoken_ms]
+
+    # static lockstep baseline: the same prompts in full batches of B; every
+    # batch decodes max_new_tokens in lockstep (what generate offers today)
+    t0 = time.monotonic()
+    static_tokens = 0
+    for i in range(0, n, B):
+        chunk = prompts[i:i + B]
+        ids = np.zeros((B, C), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for j, p in enumerate(chunk):
+            ids[j, C - len(p):] = p
+            lens[j] = len(p)
+        jax.block_until_ready(model.generate(
+            jnp.asarray(ids), args.max_new_tokens, prompt_lens=jnp.asarray(lens)))
+        static_tokens += len(chunk) * args.max_new_tokens
+    t_static = max(time.monotonic() - t0, 1e-9)
+
+    return {
+        "num_requests": n,
+        "arrival_rate_hz": args.arrival_rate,
+        "ttft_ms": _percentiles(ttfts),
+        "intertoken_ms": _percentiles(inter),
+        "goodput_tok_s": total_tokens / max(t_cont, 1e-9),
+        "static_tok_s": static_tokens / t_static,
+        "continuous_s": round(t_cont, 4),
+        "static_s": round(t_static, 4),
+        "finished": sum(1 for o in outputs.values() if o.state == "finished"),
+        "stats_records": n_stats,
+        "stats_path": os.path.abspath(stats_path),
+    }
 
 
 def main() -> int:
@@ -25,6 +132,15 @@ def main() -> int:
     p.add_argument("--context-len", type=int, default=128)
     p.add_argument("--max-total-len", type=int, default=256)
     p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--continuous", action="store_true",
+                   help="continuous-batching mode: Poisson arrivals through "
+                        "serving.ServingEngine vs the static generate baseline")
+    p.add_argument("--num-requests", type=int, default=16)
+    p.add_argument("--arrival-rate", type=float, default=20.0,
+                   help="Poisson arrival rate, requests/s")
+    p.add_argument("--stats-out", default=None,
+                   help="serving_stats.jsonl path (continuous mode)")
+    p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
     import jax
@@ -54,10 +170,18 @@ def main() -> int:
         return 1
     nxd.initialize_model_parallel(tensor_parallel_size=1, devices=devices[:1])
 
+    if args.continuous and args.batch_size == 1:
+        # a 1-slot pool degenerates to serial serving — not a continuous-
+        # batching measurement
+        args.batch_size = 3
+        print("serve_bench: --continuous with --batch-size 1 is a serial "
+              "run; using batch size 3", file=sys.stderr)
+
     if args.tiny:
         cfg = LlamaConfig.tiny(max_seq_len=args.max_total_len,
                                sequence_parallel=False, remat="none")
         args.max_new_tokens = min(args.max_new_tokens, 8)
+        args.num_requests = min(args.num_requests, 8)
     else:
         # the bench.py 438M model (7B hidden layout / 4)
         cfg = LlamaConfig(
@@ -86,16 +210,19 @@ def main() -> int:
         kv_cache_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
     )
     model = ParallelInferenceModel(module, params, icfg)
-    stats = model.benchmark(max_new_tokens=args.max_new_tokens)
     n_params = sum(int(x.size) for x in jax.tree.leaves(params))
-    print(json.dumps({
-        "metric": "serving_decode_latency",
+    base = {
         "device": getattr(devices[0], "device_kind", devices[0].platform),
         "model_params_m": round(n_params / 1e6),
         "config": {"batch": args.batch_size, "context": args.context_len,
                    "max_new": args.max_new_tokens},
-        **stats,
-    }))
+    }
+    if args.continuous:
+        stats = run_continuous(args, model, cfg.vocab_size)
+        print(json.dumps({"metric": "serving_continuous", **base, **stats}))
+    else:
+        stats = model.benchmark(max_new_tokens=args.max_new_tokens)
+        print(json.dumps({"metric": "serving_decode_latency", **base, **stats}))
     return 0
 
 
