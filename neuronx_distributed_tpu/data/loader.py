@@ -20,6 +20,7 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
+from neuronx_distributed_tpu.resilience.faults import fault_point
 from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -314,6 +315,7 @@ class TokenDataLoader:
         out = np.empty((self.batch_size, self.seq_len + 1), np.int32)
         ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         while True:
+            fault_point("data/next_batch", epoch=self.epoch, rank=self.dp_rank)
             got = lib.nxd_loader_next(self._loader, ptr)
             if got < 0:
                 return
@@ -328,6 +330,7 @@ class TokenDataLoader:
         toks = self.ds._np_tokens
         n = self.seq_len
         while self._cursor < self.num_batches:
+            fault_point("data/next_batch", epoch=self.epoch, rank=self.dp_rank)
             b = self._cursor
             self._cursor += 1
             chunk_ids = mine[b * self.batch_size:(b + 1) * self.batch_size]

@@ -27,6 +27,7 @@ from neuronx_distributed_tpu.obs.hlo_audit import read_audits
 from neuronx_distributed_tpu.obs.registry import read_histograms
 
 OBS_REPORT_SCHEMA = "obs_report_v1"
+SUPERVISOR_EVENTS_FILE = "supervisor_events.jsonl"
 
 
 def _read_scalar_file(path: str) -> List[dict]:
@@ -93,6 +94,45 @@ def _summarize_scalars(records: List[dict],
     return by_tag
 
 
+def _summarize_supervisor(path: str) -> dict:
+    """Summarize a ``supervisor_events.jsonl`` stream: restart count, crash
+    causes, time-to-recover (crash ``exit`` → next successful ``start``),
+    and the final outcome — the "how many times did this run die and how
+    fast did it come back" section of the run summary."""
+    events = _read_scalar_file(path)  # same JSONL shape, different kind
+    causes: List[str] = []
+    recover_s: List[float] = []
+    last_crash_time: Optional[float] = None
+    gave_up = succeeded = False
+    final_rc: Optional[int] = None
+    for e in events:
+        kind = e.get("event")
+        if kind == "exit":
+            final_rc = e.get("rc")
+            if e.get("rc") != 0:
+                causes.append(e.get("cause", "unknown"))
+                last_crash_time = e.get("time")
+        elif kind == "start" and last_crash_time is not None:
+            recover_s.append(max(0.0, e["time"] - last_crash_time))
+            last_crash_time = None
+        elif kind == "giveup":
+            gave_up = True
+        elif kind == "success":
+            succeeded = True
+    return {
+        "events": len(events),
+        "attempts": max((e.get("attempt", 0) for e in events), default=0),
+        "restarts": sum(1 for e in events if e.get("event") == "restart"),
+        "crash_causes": causes,
+        "recover_s": [round(s, 3) for s in recover_s],
+        "mean_recover_s": (round(sum(recover_s) / len(recover_s), 3)
+                           if recover_s else None),
+        "succeeded": succeeded,
+        "gave_up": gave_up,
+        "final_rc": final_rc,
+    }
+
+
 def _summarize_timeline(paths: Sequence[str]) -> dict:
     events = instants = 0
     dur_by_name: Dict[str, float] = {}
@@ -126,13 +166,15 @@ def build_report(
     flight_path: Optional[str] = None,
     hlo_audit_path: Optional[str] = None,
     timeline_paths: Sequence[str] = (),
+    supervisor_events_path: Optional[str] = None,
     tail: int = 10,
 ) -> dict:
     """Merge the artifacts into one summary document.
 
     ``run_dir`` seeds the default artifact locations (``scalars.jsonl``,
-    ``flight_record.json``, ``hlo_audit.jsonl`` and any ``*trace*.json``
-    inside it); the explicit path arguments add to / override them."""
+    ``flight_record.json``, ``hlo_audit.jsonl``, ``supervisor_events.jsonl``
+    and any ``*trace*.json`` inside it); the explicit path arguments add
+    to / override them."""
     scalar_paths = list(scalar_paths)
     timeline_paths = list(timeline_paths)
     if run_dir:
@@ -145,6 +187,9 @@ def build_report(
         if hlo_audit_path is None:
             q = os.path.join(run_dir, HLO_AUDIT_FILE)
             hlo_audit_path = q if os.path.exists(q) else None
+        if supervisor_events_path is None:
+            q = os.path.join(run_dir, SUPERVISOR_EVENTS_FILE)
+            supervisor_events_path = q if os.path.exists(q) else None
         for q in sorted(glob.glob(os.path.join(run_dir, "*trace*.json"))):
             if q not in timeline_paths:
                 timeline_paths.append(q)
@@ -168,6 +213,10 @@ def build_report(
     audits = read_audits(hlo_audit_path) if (
         hlo_audit_path and os.path.exists(hlo_audit_path)) else []
 
+    supervisor = None
+    if supervisor_events_path and os.path.exists(supervisor_events_path):
+        supervisor = _summarize_supervisor(supervisor_events_path)
+
     anomalies = list(flight["warnings"]) if flight else []
     histograms = read_histograms(scalar_records)
     report = {
@@ -179,6 +228,7 @@ def build_report(
             "flight": flight_path,
             "hlo_audit": hlo_audit_path,
             "timelines": timeline_paths,
+            "supervisor_events": supervisor_events_path,
         },
         "scalars": _summarize_scalars(scalar_records, frozenset(histograms)),
         "histograms": histograms,
@@ -186,12 +236,14 @@ def build_report(
         "anomalies": anomalies,
         "hlo_audits": audits,
         "timeline": _summarize_timeline(timeline_paths),
+        "supervisor": supervisor,
         "health": {
             "anomaly_count": len(anomalies),
             "total_collective_count": sum(
                 a.get("total_collective_count", 0) for a in audits),
             "total_collective_bytes": sum(
                 a.get("total_collective_bytes", 0) for a in audits),
+            "restarts": supervisor["restarts"] if supervisor else 0,
         },
     }
     return report
@@ -202,10 +254,26 @@ def render_markdown(report: dict) -> str:
     lines = ["# Run report", ""]
     h = report["health"]
     lines.append(f"- anomalies: **{h['anomaly_count']}**")
+    lines.append(f"- supervisor restarts: **{h.get('restarts', 0)}**")
     lines.append(f"- collectives across audited programs: "
                  f"{h['total_collective_count']} ops, "
                  f"{h['total_collective_bytes']:,} bytes")
     lines.append("")
+
+    sup = report.get("supervisor")
+    if sup:
+        lines += ["## Supervisor", "",
+                  f"{sup['attempts']} attempt(s), {sup['restarts']} "
+                  f"restart(s); "
+                  + ("succeeded" if sup["succeeded"] else
+                     ("gave up" if sup["gave_up"] else
+                      f"final rc {sup['final_rc']}"))]
+        if sup["crash_causes"]:
+            lines.append(f"- crash causes: {', '.join(sup['crash_causes'])}")
+        if sup["mean_recover_s"] is not None:
+            lines.append(f"- time to recover: mean {sup['mean_recover_s']}s "
+                         f"({sup['recover_s']})")
+        lines.append("")
 
     if report["scalars"]:
         lines += ["## Step metrics", "",

@@ -48,11 +48,18 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "new_tokens": int, "queue_ms": _NUM,
         "ttft_ms": (int, float, type(None)), "total_ms": _NUM,
     },
+    # one line of supervisor_events.jsonl (resilience.supervisor.Supervisor)
+    # — events: start / exit / restart / giveup / success; extra keys carry
+    # the event payload (pid, rc, cause, backoff_s, resume_tag, ...)
+    "supervisor_event": {
+        "schema": str, "time": _NUM, "event": str, "attempt": int,
+    },
     # tools/obs_report.py output document
     "obs_report": {
         "schema": str, "generated_at": _NUM, "scalars": dict,
         "histograms": dict, "flight": (dict, type(None)),
         "anomalies": list, "hlo_audits": list, "timeline": dict,
+        "supervisor": (dict, type(None)),
     },
 }
 

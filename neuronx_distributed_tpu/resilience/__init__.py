@@ -1,0 +1,79 @@
+"""Resilience subsystem (ISSUE 3 tentpole): make failure a tested, recoverable
+code path instead of a human's afternoon.
+
+The reference framework's whole checkpoint/rendezvous design (SURVEY §5.4)
+exists because multi-host runs *die* — preemptions, NaN blow-ups, stalled
+hosts.  PR 1 gave this repo detection (flight-recorder anomaly detectors);
+this package adds the three layers that *act*:
+
+- :mod:`.faults` — a deterministic, env/JSON-plan-driven fault-injection
+  plane.  Named fault points threaded through ``trainer/fit.py``,
+  ``trainer/checkpoint.py``, ``data/loader.py`` and ``serving/engine.py``
+  let subprocess tests kill/poison/stall unmodified production code at exact
+  places (``NXD_FAULT_PLAN``), which is what makes the crash-consistency
+  kill-point matrix and the supervisor restart loop testable at all.
+- :mod:`.policy` — anomaly *response* policies: NaN/loss-spike →
+  skip-update or rollback-to-newest-checkpoint (re-wound data position,
+  bounded retries), plus a step-latency watchdog.  Consumed by
+  ``fit(policy=...)``.
+- :mod:`.supervisor` — a process supervisor (library + CLI
+  ``tools/train_supervisor.py``): restart-on-crash with exponential backoff
+  and a crash budget, resume from the newest complete checkpoint tag,
+  schema-checked ``supervisor_events.jsonl`` merged into the obs run report.
+
+Serving-side hardening (non-finite-logit slot quarantine, bounded admission
+queue, engine step watchdog) lives in :mod:`..serving.engine` and draws its
+injected faults from :mod:`.faults`.
+"""
+
+from neuronx_distributed_tpu.resilience.faults import (
+    ENV_VAR,
+    KILL_EXIT_CODE,
+    FaultPlan,
+    InjectedFault,
+    active_plan,
+    clear_plan,
+    fault_point,
+    fired_events,
+    install_plan,
+    perturb,
+)
+from neuronx_distributed_tpu.resilience.policy import (
+    AnomalyPolicy,
+    PolicyDecision,
+    PolicyEngine,
+    PolicyHalt,
+    RetriesExhausted,
+    StepWatchdog,
+)
+from neuronx_distributed_tpu.resilience.supervisor import (
+    SUPERVISOR_EVENTS_SCHEMA,
+    Supervisor,
+    SupervisorResult,
+    classify_exit,
+    newest_complete_tag,
+)
+
+__all__ = [
+    "FaultPlan",
+    "InjectedFault",
+    "ENV_VAR",
+    "KILL_EXIT_CODE",
+    "install_plan",
+    "clear_plan",
+    "active_plan",
+    "fault_point",
+    "perturb",
+    "fired_events",
+    "AnomalyPolicy",
+    "PolicyDecision",
+    "PolicyEngine",
+    "PolicyHalt",
+    "RetriesExhausted",
+    "StepWatchdog",
+    "Supervisor",
+    "SupervisorResult",
+    "SUPERVISOR_EVENTS_SCHEMA",
+    "classify_exit",
+    "newest_complete_tag",
+]

@@ -1,0 +1,254 @@
+"""Deterministic fault-injection plane.
+
+Production code paths carry named *fault points* (``fault_point(name, **ctx)``
+for control-flow faults, ``perturb(name, value, **ctx)`` for value faults).
+With no plan installed both are a single ``is None`` check — the hooks cost
+nothing in real runs.  A plan is installed either programmatically
+(:func:`install_plan`) or through the ``NXD_FAULT_PLAN`` environment variable
+(a path to a JSON file, or inline JSON), which is how subprocess tests inject
+faults into **unmodified** production code: the child process reads the env on
+the first fault-point hit, no test shims in the import path.
+
+Plan format — ``{"faults": [spec, ...]}`` where each spec is::
+
+    {
+      "point":  "ckpt/pre_done",          # fault-point name (exact match)
+      "action": "kill",                   # see ACTIONS below
+      "match":  {"tag": "step_4"},        # optional: every key must equal the
+                                          #   call-site ctx value (specs with a
+                                          #   match key absent from ctx do not
+                                          #   fire — e.g. {"step": 3} never
+                                          #   matches a point without a step)
+      "count":  1,                        # max fires (default 1; 0 = unlimited)
+      "hit":    1,                        # fire starting at the Nth matching
+                                          #   hit of this spec (default 1)
+      # action-specific:
+      "exit_code": 43,                    # kill
+      "message": "...",                   # exception
+      "seconds": 2.0,                     # sleep
+      "slot": 1,                          # nan on an array: poison row [slot]
+    }
+
+ACTIONS:
+
+- ``kill``      — ``os._exit(exit_code)``: an instant hard death (no atexit,
+  no finally blocks), the honest simulation of a preemption / OOM-kill at
+  exactly this point.  Default exit code :data:`KILL_EXIT_CODE`.
+- ``exception`` — raise :class:`InjectedFault` (a host-side crash the
+  supervisor must classify and restart from).
+- ``sigterm``   — ``os.kill(os.getpid(), SIGTERM)``: a synthetic preemption
+  notice, exercising the ``checkpoint_on_signal`` path.
+- ``sleep``     — ``time.sleep(seconds)``: a data-loader stall / slow step /
+  stuck host, exercising throughput detectors and watchdogs.
+- ``nan``       — (perturb points only) replace the value with NaN: a float
+  becomes ``float("nan")``; an array is poisoned whole, or only row
+  ``spec["slot"]`` when given.  The injected-numerical-blow-up fault.
+
+Every fired fault logs ``faults: fired <point> action=<action>`` and appends
+to :func:`fired_events` so tests (and post-mortems) can confirm the injection
+actually happened.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import signal
+import time
+from typing import Any, Dict, List, Optional
+
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+ENV_VAR = "NXD_FAULT_PLAN"
+KILL_EXIT_CODE = 43  # distinctive: tests assert the kill (not a real crash)
+
+_ACTIONS = ("kill", "exception", "sigterm", "sleep", "nan")
+_RESERVED = {"point", "action", "match", "count", "hit", "exit_code",
+             "message", "seconds", "slot"}
+
+
+class InjectedFault(RuntimeError):
+    """The exception raised by an ``action: exception`` fault spec."""
+
+
+class FaultPlan:
+    """A parsed, stateful fault plan: per-spec hit/fire counters decide which
+    call-site invocation actually fires."""
+
+    def __init__(self, specs: List[dict]):
+        self.specs = []
+        for i, spec in enumerate(specs):
+            if "point" not in spec:
+                raise ValueError(f"fault spec {i} has no 'point': {spec}")
+            action = spec.get("action")
+            if action not in _ACTIONS:
+                raise ValueError(
+                    f"fault spec {i} ({spec.get('point')}): unknown action "
+                    f"{action!r} (known: {_ACTIONS})")
+            unknown = set(spec) - _RESERVED
+            if unknown:
+                raise ValueError(
+                    f"fault spec {i} ({spec['point']}): unknown keys "
+                    f"{sorted(unknown)} — conditions go under 'match'")
+            self.specs.append({
+                **spec,
+                "_hits": 0,    # matching invocations seen
+                "_fires": 0,   # times actually fired
+            })
+
+    @staticmethod
+    def from_json(obj: "str | dict") -> "FaultPlan":
+        if isinstance(obj, str):
+            obj = json.loads(obj)
+        if isinstance(obj, list):
+            obj = {"faults": obj}
+        return FaultPlan(list(obj.get("faults", [])))
+
+    @staticmethod
+    def from_env() -> Optional["FaultPlan"]:
+        raw = os.environ.get(ENV_VAR)
+        if not raw:
+            return None
+        if raw.lstrip().startswith(("{", "[")):
+            return FaultPlan.from_json(raw)
+        with open(raw) as f:
+            return FaultPlan.from_json(f.read())
+
+    # -- matching ---------------------------------------------------------
+
+    def _matches(self, spec: dict, point: str, ctx: Dict[str, Any]) -> bool:
+        if spec["point"] != point:
+            return False
+        for key, want in spec.get("match", {}).items():
+            if key not in ctx or ctx[key] != want:
+                return False
+        return True
+
+    def fire(self, point: str, value: Any, ctx: Dict[str, Any]) -> Any:
+        """Run every matching spec's action; returns the (possibly perturbed)
+        value.  Called by :func:`fault_point` / :func:`perturb` only."""
+        for spec in self.specs:
+            if not self._matches(spec, point, ctx):
+                continue
+            spec["_hits"] += 1
+            if spec["_hits"] < int(spec.get("hit", 1)):
+                continue
+            count = int(spec.get("count", 1))
+            if count and spec["_fires"] >= count:
+                continue
+            spec["_fires"] += 1
+            value = _execute(spec, point, value, ctx)
+        return value
+
+
+def _execute(spec: dict, point: str, value: Any, ctx: Dict[str, Any]) -> Any:
+    action = spec["action"]
+    record = {"point": point, "action": action, "time": time.time(),
+              "ctx": {k: v for k, v in ctx.items()
+                      if isinstance(v, (int, float, str, bool))}}
+    _FIRED.append(record)
+    # stderr + flush BEFORE acting: a kill must still leave the evidence
+    logger.warning("faults: fired %s action=%s ctx=%s", point, action,
+                   record["ctx"])
+    if action == "kill":
+        os._exit(int(spec.get("exit_code", KILL_EXIT_CODE)))
+    if action == "exception":
+        raise InjectedFault(
+            spec.get("message", f"injected fault at {point}"))
+    if action == "sigterm":
+        os.kill(os.getpid(), signal.SIGTERM)
+        return value
+    if action == "sleep":
+        time.sleep(float(spec.get("seconds", 1.0)))
+        return value
+    if action == "nan":
+        return _poison(value, spec)
+    raise AssertionError(f"unreachable action {action}")  # pragma: no cover
+
+
+def _poison(value: Any, spec: dict) -> Any:
+    """NaN-replace a perturb value: scalars whole, arrays whole or one row."""
+    if value is None:
+        return value
+    if isinstance(value, (int, float)):
+        return float("nan")
+    if hasattr(value, "at") and hasattr(value, "shape"):  # jax array
+        if "slot" in spec and value.ndim >= 1:
+            return value.at[int(spec["slot"])].set(math.nan)
+        return value.at[...].set(math.nan)
+    if hasattr(value, "shape"):  # numpy
+        import numpy as np
+
+        out = np.array(value, copy=True, dtype=np.result_type(value, np.float32))
+        if "slot" in spec and out.ndim >= 1:
+            out[int(spec["slot"])] = np.nan
+        else:
+            out[...] = np.nan
+        return out
+    return float("nan")
+
+
+# -- module state -----------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+_ENV_CHECKED = False
+_FIRED: List[dict] = []
+
+
+def install_plan(plan: "FaultPlan | dict | str | None") -> Optional[FaultPlan]:
+    """Install (or with ``None`` clear) the process-wide fault plan."""
+    global _PLAN, _ENV_CHECKED
+    _ENV_CHECKED = True  # an explicit install overrides the env
+    _PLAN = None if plan is None else (
+        plan if isinstance(plan, FaultPlan) else FaultPlan.from_json(plan))
+    return _PLAN
+
+
+def clear_plan() -> None:
+    """Remove any installed plan and re-arm the env check (tests)."""
+    global _PLAN, _ENV_CHECKED
+    _PLAN = None
+    _ENV_CHECKED = False
+    _FIRED.clear()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan, lazily loading ``NXD_FAULT_PLAN`` on first use."""
+    global _PLAN, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        try:
+            _PLAN = FaultPlan.from_env()
+        except Exception as e:  # a broken plan must be loud, not fatal-silent
+            logger.error("faults: failed to load %s: %s", ENV_VAR, e)
+            raise
+        if _PLAN is not None:
+            logger.warning("faults: plan loaded from %s (%d specs)",
+                           ENV_VAR, len(_PLAN.specs))
+    return _PLAN
+
+
+def fired_events() -> List[dict]:
+    """Every fault fired in this process (oldest first)."""
+    return list(_FIRED)
+
+
+def fault_point(point: str, **ctx) -> None:
+    """Control-flow fault hook: no-op without a plan; may kill the process,
+    raise :class:`InjectedFault`, send SIGTERM, or sleep."""
+    plan = active_plan()
+    if plan is not None:
+        plan.fire(point, None, ctx)
+
+
+def perturb(point: str, value: Any, **ctx) -> Any:
+    """Value fault hook: returns ``value`` untouched without a plan; a
+    matching ``nan`` spec returns a poisoned copy (other actions behave as in
+    :func:`fault_point` and return the original value)."""
+    plan = active_plan()
+    if plan is None:
+        return value
+    return plan.fire(point, value, ctx)

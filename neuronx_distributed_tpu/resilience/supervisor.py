@@ -1,0 +1,316 @@
+"""Process supervisor: run a training entry in a subprocess, restart it on
+crashes with exponential backoff and a crash budget, resume from the newest
+checkpoint.
+
+The reference framework leans on an external orchestrator (SLURM requeue /
+k8s restartPolicy) to revive dead trainers; this supervisor is the in-repo
+equivalent with *training-aware* accounting: every attempt records the
+checkpoint tag it resumed from, every exit records a classified crash cause
+(clean / signal / injected fault / NaN / traceback / timeout), and the whole
+history lands in a schema-checked ``supervisor_events.jsonl`` that
+``tools/obs_report.py`` merges into the run summary (restart count, causes,
+time-to-recover).
+
+Design constraints:
+
+- the child is *unmodified* production code — resume works because the entry
+  itself passes ``resume=True`` to ``fit()`` and the newest complete
+  checkpoint tag is the contract between attempts;
+- a clean exit (rc 0) ends supervision; any other exit consumes one unit of
+  the crash budget (``max_restarts``) and backs off exponentially
+  (``backoff_base_s * 2^(attempt-1)``, capped at ``backoff_max_s``);
+- an optional per-attempt ``timeout_s`` kills a wedged child (stalled host /
+  deadlocked loader) and counts it as a crash with cause ``timeout``;
+- no ``jax`` at module scope beyond the package import: the supervisor is a
+  babysitter, not a training process — ``newest`` resolution re-reads the
+  checkpoint directory's marker files directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import IO, List, Optional, Sequence
+
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+SUPERVISOR_EVENTS_SCHEMA = "supervisor_events/1"
+
+# crash-cause signatures scanned from the child log tail, most specific
+# first — the first match wins
+_CAUSE_SIGNATURES = (
+    ("InjectedFault", "injected_fault"),
+    ("RetriesExhausted", "policy_retries_exhausted"),
+    ("PolicyHalt", "policy_halt"),
+    ("non-finite", "non_finite"),
+    ("NaN", "nan"),
+    ("Traceback (most recent call last)", "exception"),
+)
+
+
+def newest_complete_tag(ckpt_dir: str) -> Optional[str]:
+    """Filesystem-only twin of ``trainer.checkpoint.newest_tag`` (the
+    supervisor must not pay a jax/orbax import to read two marker files):
+    the ``newest`` pointer when its target has a ``.done`` marker, else the
+    most recently completed tag, else None."""
+    if not ckpt_dir or not os.path.isdir(ckpt_dir):
+        return None
+    p = os.path.join(ckpt_dir, "newest")
+    if os.path.exists(p):
+        with open(p) as f:
+            tag = f.read().strip()
+        if tag and os.path.exists(os.path.join(ckpt_dir, tag, ".done")):
+            return tag
+    done = [(os.path.getmtime(os.path.join(ckpt_dir, d, ".done")), d)
+            for d in os.listdir(ckpt_dir)
+            if os.path.exists(os.path.join(ckpt_dir, d, ".done"))]
+    return max(done)[1] if done else None
+
+
+def classify_exit(rc: int, log_tail: str) -> str:
+    """Map an exit code + child-log tail to a crash-cause label."""
+    if rc == 0:
+        return "clean"
+    if rc < 0:
+        try:
+            return f"signal_{signal.Signals(-rc).name}"
+        except ValueError:
+            return f"signal_{-rc}"
+    for needle, label in _CAUSE_SIGNATURES:
+        if needle in log_tail:
+            return label
+    return f"exit_{rc}"
+
+
+@dataclasses.dataclass
+class SupervisorResult:
+    """Outcome of :meth:`Supervisor.run`."""
+
+    ok: bool
+    attempts: int
+    restarts: int
+    final_rc: int
+    total_runtime_s: float
+    causes: List[str]
+    events_path: Optional[str]
+
+
+class Supervisor:
+    """Run ``argv`` under supervision (see module docstring).
+
+    ``events_path`` appends one schema-checked JSONL record per lifecycle
+    event: ``start`` (attempt, pid, resume_tag), ``exit`` (rc, cause,
+    runtime_s), ``restart`` (backoff_s), ``giveup``, ``success``.
+    ``log_path`` receives the child's merged stdout/stderr (append mode —
+    one log across attempts, with attempt banners); default inherits the
+    supervisor's own streams (no cause classification possible then).
+    ``clock``/``sleep`` are injectable for tests."""
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        *,
+        max_restarts: int = 3,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+        timeout_s: Optional[float] = None,
+        ckpt_dir: Optional[str] = None,
+        events_path: Optional[str] = None,
+        log_path: Optional[str] = None,
+        env: Optional[dict] = None,
+        cwd: Optional[str] = None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        if not argv:
+            raise ValueError("supervisor needs a command to run")
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        self.argv = list(argv)
+        self.max_restarts = max_restarts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.timeout_s = timeout_s
+        self.ckpt_dir = ckpt_dir
+        self.events_path = events_path
+        self.log_path = log_path
+        self.env = env
+        self.cwd = cwd
+        self._clock = clock
+        self._sleep = sleep
+        self._events_f: Optional[IO] = None
+        self._log_start = 0  # child-log size at current attempt's start
+        self.events: List[dict] = []
+
+    # -- events ------------------------------------------------------------
+
+    def _emit(self, event: str, attempt: int, **fields) -> dict:
+        rec = {"schema": SUPERVISOR_EVENTS_SCHEMA, "time": time.time(),
+               "event": event, "attempt": attempt, **fields}
+        from neuronx_distributed_tpu.obs.schemas import validate_record
+
+        validate_record("supervisor_event", rec)  # the emitter honors its schema
+        self.events.append(rec)
+        if self.events_path:
+            if self._events_f is None:
+                parent = os.path.dirname(self.events_path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                self._events_f = open(self.events_path, "a")
+            self._events_f.write(json.dumps(rec) + "\n")
+            self._events_f.flush()
+        logger.info("supervisor: %s attempt=%d %s", event, attempt, fields)
+        return rec
+
+    def _log_tail(self, nbytes: int = 8192) -> str:
+        """The last ``nbytes`` of the child log written by the CURRENT
+        attempt only (``_log_start`` marks the file size at attempt start) —
+        a previous attempt's crash text must never classify this one."""
+        if not self.log_path or not os.path.exists(self.log_path):
+            return ""
+        with open(self.log_path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(self._log_start, f.tell() - nbytes))
+            return f.read().decode(errors="replace")
+
+    # -- one attempt -------------------------------------------------------
+
+    def _run_once(self, attempt: int) -> int:
+        log_f = None
+        if self.log_path:
+            parent = os.path.dirname(self.log_path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            log_f = open(self.log_path, "a")
+            log_f.write(f"\n=== supervisor attempt {attempt} "
+                        f"({time.strftime('%Y-%m-%dT%H:%M:%S')}) ===\n")
+            log_f.flush()
+            self._log_start = log_f.tell()
+        try:
+            proc = subprocess.Popen(
+                self.argv, stdout=log_f, stderr=subprocess.STDOUT if log_f
+                else None, env=self.env, cwd=self.cwd)
+            self._emit("start", attempt, pid=proc.pid,
+                       resume_tag=newest_complete_tag(self.ckpt_dir))
+            try:
+                return proc.wait(timeout=self.timeout_s)
+            except subprocess.TimeoutExpired:
+                logger.warning("supervisor: attempt %d exceeded %.1fs — "
+                               "killing", attempt, self.timeout_s)
+                proc.kill()
+                proc.wait()
+                return -signal.SIGKILL  # classified as timeout below
+        finally:
+            if log_f is not None:
+                log_f.close()
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self) -> SupervisorResult:
+        t_start = self._clock()
+        attempt = 1
+        restarts = 0
+        causes: List[str] = []
+        try:
+            while True:
+                t0 = self._clock()
+                timed_out = False
+                try:
+                    rc = self._run_once(attempt)
+                except (OSError, subprocess.SubprocessError) as e:
+                    # spawn failure is a crash too (bad argv surfaces fast);
+                    # Popen raises OSError subclasses (FileNotFoundError,
+                    # PermissionError), not SubprocessError
+                    logger.error("supervisor: spawn failed: %s", e)
+                    rc = 127
+                runtime_s = self._clock() - t0
+                if rc == -signal.SIGKILL and self.timeout_s \
+                        and runtime_s >= self.timeout_s:
+                    timed_out = True
+                cause = "timeout" if timed_out else classify_exit(
+                    rc, self._log_tail())
+                self._emit("exit", attempt, rc=rc, cause=cause,
+                           runtime_s=round(runtime_s, 3),
+                           resume_tag=newest_complete_tag(self.ckpt_dir))
+                if rc == 0:
+                    self._emit("success", attempt, restarts=restarts)
+                    return SupervisorResult(
+                        ok=True, attempts=attempt, restarts=restarts,
+                        final_rc=0, total_runtime_s=self._clock() - t_start,
+                        causes=causes, events_path=self.events_path)
+                causes.append(cause)
+                if restarts >= self.max_restarts:
+                    self._emit("giveup", attempt, rc=rc,
+                               restarts=restarts, cause=cause)
+                    return SupervisorResult(
+                        ok=False, attempts=attempt, restarts=restarts,
+                        final_rc=rc, total_runtime_s=self._clock() - t_start,
+                        causes=causes, events_path=self.events_path)
+                restarts += 1
+                backoff = min(self.backoff_base_s * (2 ** (restarts - 1)),
+                              self.backoff_max_s)
+                attempt += 1
+                self._emit("restart", attempt, backoff_s=round(backoff, 3),
+                           cause=cause)
+                self._sleep(backoff)
+        finally:
+            if self._events_f is not None:
+                self._events_f.close()
+                self._events_f = None
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI body shared with ``tools/train_supervisor.py``."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="train_supervisor",
+        description="Supervised auto-resume: run a training command, restart "
+                    "on crashes with exponential backoff and a crash budget.")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--backoff-base", type=float, default=0.5,
+                   help="first backoff in seconds (doubles per restart)")
+    p.add_argument("--backoff-max", type=float, default=30.0)
+    p.add_argument("--timeout", type=float, default=None,
+                   help="per-attempt wall-clock limit; exceeding it kills the "
+                        "attempt (cause=timeout) and consumes crash budget")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint dir to record resume tags from")
+    p.add_argument("--events", default=None,
+                   help="supervisor_events.jsonl path (append)")
+    p.add_argument("--log", default=None,
+                   help="child stdout/stderr log (append; enables crash-cause "
+                        "classification)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the training command (prefix with --)")
+    args = p.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        p.error("no command given (pass it after --)")
+
+    sup = Supervisor(
+        command, max_restarts=args.max_restarts,
+        backoff_base_s=args.backoff_base, backoff_max_s=args.backoff_max,
+        timeout_s=args.timeout, ckpt_dir=args.ckpt_dir,
+        events_path=args.events, log_path=args.log)
+    res = sup.run()
+    print(json.dumps({
+        "supervisor": "done", "ok": res.ok, "attempts": res.attempts,
+        "restarts": res.restarts, "final_rc": res.final_rc,
+        "causes": res.causes,
+        "total_runtime_s": round(res.total_runtime_s, 3),
+    }), flush=True)
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

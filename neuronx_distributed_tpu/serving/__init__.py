@@ -14,9 +14,16 @@ users" north star points at.
 - :mod:`.engine` — ``ServingEngine.step()``: sweep → admit/prefill →
   batched per-slot decode → stop detection → slot free, exporting telemetry
   through the PR-1 ``obs.MetricRegistry`` and ``serving_stats.jsonl``.
+
+Hardened (resilience PR) against poisoned traffic and overload: non-finite
+logits quarantine the one affected request (terminal ``FAILED`` state, slot
+freed, co-batch untouched), ``max_queue`` bounds the admission backlog
+(``BackpressureError``), ``step_timeout_s`` arms a step watchdog, and an
+attached ``obs`` hub gives ``replay_trace`` a crash flight dump.
 """
 
 from neuronx_distributed_tpu.serving.engine import (
+    FAIL_NON_FINITE,
     SERVING_STATS_SCHEMA,
     ServingEngine,
     replay_trace,
@@ -27,16 +34,22 @@ from neuronx_distributed_tpu.serving.request import (
     RequestState,
     SamplingParams,
 )
-from neuronx_distributed_tpu.serving.scheduler import AdmissionError, SlotScheduler
+from neuronx_distributed_tpu.serving.scheduler import (
+    AdmissionError,
+    BackpressureError,
+    SlotScheduler,
+)
 
 __all__ = [
     "ServingEngine",
     "SERVING_STATS_SCHEMA",
+    "FAIL_NON_FINITE",
     "Request",
     "RequestOutput",
     "RequestState",
     "SamplingParams",
     "AdmissionError",
+    "BackpressureError",
     "SlotScheduler",
     "replay_trace",
 ]

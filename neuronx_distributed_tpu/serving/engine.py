@@ -37,18 +37,24 @@ import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_tpu.obs import MS_BUCKETS, MetricRegistry
+from neuronx_distributed_tpu.resilience.faults import perturb
 from neuronx_distributed_tpu.serving.request import (
     Request,
     RequestOutput,
     RequestState,
 )
-from neuronx_distributed_tpu.serving.scheduler import SlotScheduler
+from neuronx_distributed_tpu.serving.scheduler import (
+    BackpressureError,
+    SlotScheduler,
+)
 from neuronx_distributed_tpu.trace.engine import _sample_logits, request_rng
 from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
 SERVING_STATS_SCHEMA = "serving_stats/1"
+
+FAIL_NON_FINITE = "non_finite_logits"
 
 
 @jax.jit
@@ -60,9 +66,16 @@ def _sample_rows(logits, base_keys, tok_idx, temperature, top_k, top_p):
     compiled program serves any mix of greedy/sampled slots — greedy rows
     take the ``where(temperature > 0)`` argmax branch and ignore their key.
     Module-level jit so every engine over the same shapes shares one
-    compile."""
+    compile.
+
+    Returns ``(tokens [B], finite [B])``: ``finite[b]`` is False when row
+    ``b``'s logits contain NaN/Inf — computed inside the jit (a cheap
+    reduction riding the same dispatch; the full ``[B, V]`` logits never
+    cross to the host) so the engine can quarantine a numerically blown-up
+    slot without poisoning its co-batch."""
     def row(lg, key, idx, t, k, p):
-        return _sample_logits(lg, jax.random.fold_in(key, idx), t, k, p)
+        tok = _sample_logits(lg, jax.random.fold_in(key, idx), t, k, p)
+        return tok, jnp.all(jnp.isfinite(lg.astype(jnp.float32)))
 
     return jax.vmap(row)(logits, base_keys, tok_idx, temperature, top_k, top_p)
 
@@ -76,7 +89,12 @@ def replay_trace(engine: "ServingEngine", arrivals, requests,
     --continuous`` and the runner's ``serve`` subcommand.  Returns
     ``{request_id: RequestOutput}``; ``on_output`` additionally fires per
     terminal request as it completes (streaming hooks ride on the requests
-    themselves via ``stream_cb``)."""
+    themselves via ``stream_cb``).
+
+    An unhandled exception out of the drive loop dumps the engine's obs
+    flight record first (when the engine carries an ``obs=`` hub) — the
+    serving twin of ``fit()``'s crash path: the last K engine steps become a
+    persisted artifact instead of lost scrollback."""
     if len(arrivals) != len(requests):
         raise ValueError(
             f"arrivals ({len(arrivals)}) and requests ({len(requests)}) "
@@ -84,18 +102,26 @@ def replay_trace(engine: "ServingEngine", arrivals, requests,
     outputs = {}
     t0 = clock()
     next_i = 0
-    while next_i < len(requests) or engine.has_work:
-        now = clock() - t0
-        while next_i < len(requests) and arrivals[next_i] <= now:
-            engine.submit(requests[next_i])
-            next_i += 1
-        if engine.has_work:
-            for out in engine.step():
-                outputs[out.request_id] = out
-                if on_output is not None:
-                    on_output(out)
-        elif next_i < len(requests):
-            sleep(min(arrivals[next_i] - now, 0.05))
+    try:
+        while next_i < len(requests) or engine.has_work:
+            now = clock() - t0
+            while next_i < len(requests) and arrivals[next_i] <= now:
+                engine.submit(requests[next_i])
+                next_i += 1
+            if engine.has_work:
+                for out in engine.step():
+                    outputs[out.request_id] = out
+                    if on_output is not None:
+                        on_output(out)
+            elif next_i < len(requests):
+                sleep(min(arrivals[next_i] - now, 0.05))
+    except BaseException as e:
+        # telemetry IO must never mask the real crash
+        try:
+            engine.dump_flight(f"crash:{type(e).__name__}")
+        except Exception as dump_err:
+            logger.warning("serving: crash flight dump failed: %s", dump_err)
+        raise
     return outputs
 
 
@@ -116,6 +142,24 @@ class ServingEngine:
     per terminal request.  ``registry`` (an ``obs.MetricRegistry``) receives
     the serving gauges/histograms/counters; one is created when omitted so
     metrics are always available via :attr:`registry`.
+
+    Hardening knobs (resilience PR):
+
+    - ``max_queue`` bounds the admission queue — a full queue makes
+      ``submit`` raise ``BackpressureError`` (transient, retryable; counted
+      in ``serving/rejected_total``) so overload is rejected at the edge;
+    - non-finite logits in a slot fail THAT request only (terminal state
+      ``failed``, finish reason ``non_finite_logits``; the slot is freed and
+      reusable, co-batched requests never see the poison) — counted in
+      ``serving/failed_total``;
+    - ``step_timeout_s`` arms the engine step watchdog: a ``step()`` call
+      slower than the threshold logs a warning and counts into
+      ``serving/slow_steps_total`` (every step's duration exports as the
+      ``serving/step_ms`` histogram and ``serving/last_step_ms`` gauge);
+    - ``obs`` (an ``obs.Observability`` hub) records one flight-recorder
+      entry per engine step (queue depth, active slots, tokens, step time);
+      ``replay_trace`` dumps it on an unhandled exception, and the engine's
+      metrics then ride the hub's registry unless one was passed explicitly.
     """
 
     def __init__(
@@ -127,6 +171,9 @@ class ServingEngine:
         stats_path: Optional[str] = None,
         eos_token_id: Optional[int] = None,
         clock: Callable[[], float] = time.monotonic,
+        max_queue: Optional[int] = None,
+        step_timeout_s: Optional[float] = None,
+        obs: Any = None,
     ):
         for attr in ("prefill_one", "insert_slot", "decode_slots"):
             if not hasattr(model, attr):
@@ -140,8 +187,14 @@ class ServingEngine:
         self.B = cfg.batch_size
         self.C = cfg.context_len
         self.T = cfg.max_total_len
-        self.scheduler = SlotScheduler(self.B, self.C, self.T)
+        self.scheduler = SlotScheduler(self.B, self.C, self.T,
+                                       max_queue=max_queue)
+        self.obs = obs
+        if registry is None and obs is not None:
+            registry = obs.registry
         self.registry = registry if registry is not None else MetricRegistry()
+        self.step_timeout_s = step_timeout_s
+        self._steps = 0
         # compiled-cache evictions (trace._CompiledLRU) surface here too.
         # The caches live on the MODEL, which may outlive this engine or be
         # shared by several — attach only when nothing is attached yet, so
@@ -175,20 +228,29 @@ class ServingEngine:
         reg.gauge("serving/slots_active")
         reg.histogram("serving/ttft_ms", MS_BUCKETS)
         reg.histogram("serving/intertoken_ms", MS_BUCKETS)
-        for c in ("admitted", "finished", "cancelled", "timed_out", "tokens"):
+        reg.histogram("serving/step_ms", MS_BUCKETS)
+        reg.gauge("serving/last_step_ms")
+        for c in ("admitted", "finished", "cancelled", "timed_out", "tokens",
+                  "rejected", "failed", "slow_steps"):
             reg.counter(f"serving/{c}_total")
 
     # -- request surface ---------------------------------------------------
 
     def submit(self, request: Request) -> None:
         """Queue a request (FCFS).  Raises ``AdmissionError`` when it can
-        never fit the compiled envelope, ``ValueError`` for a sampled
-        request on an rng-less engine."""
+        never fit the compiled envelope, ``BackpressureError`` when the
+        bounded admission queue is full (transient — retry after the backlog
+        drains), ``ValueError`` for a sampled request on an rng-less
+        engine."""
         if request.sampling.temperature > 0.0 and self._rng is None:
             raise ValueError(
                 f"request {request.request_id} samples (temperature "
                 f"{request.sampling.temperature}) but the engine has no rng")
-        self.scheduler.submit(request, now=self._clock())
+        try:
+            self.scheduler.submit(request, now=self._clock())
+        except BackpressureError:
+            self.registry.counter("serving/rejected_total").inc()
+            raise
 
     def cancel(self, request_id: int) -> bool:
         return self.scheduler.cancel(request_id)
@@ -205,6 +267,8 @@ class ServingEngine:
         reached a terminal state during this step."""
         outputs: List[RequestOutput] = []
         now = self._clock()
+        t_step0 = now
+        self._steps += 1
 
         # 1) cancellation / deadline sweep (frees slots before admission)
         swept = self.scheduler.sweep(now)
@@ -229,7 +293,35 @@ class ServingEngine:
 
         self.registry.gauge("serving/queue_depth").set(self.scheduler.queue_depth)
         self.registry.gauge("serving/slots_active").set(self.scheduler.active_count)
+
+        # step watchdog: a slow engine step is the host-side signature of a
+        # recompile, a device stall, or a wedged model call — the gauge/
+        # histogram make it graphable, the counter makes it alertable
+        step_s = self._clock() - t_step0
+        self.registry.gauge("serving/last_step_ms").set(step_s * 1e3)
+        self.registry.histogram("serving/step_ms", MS_BUCKETS).observe(
+            step_s * 1e3)
+        if self.step_timeout_s is not None and step_s > self.step_timeout_s:
+            self.registry.counter("serving/slow_steps_total").inc()
+            logger.warning(
+                "serving: engine step %d took %.3fs (> watchdog %.3fs; "
+                "active=%d queued=%d)", self._steps, step_s,
+                self.step_timeout_s, self.scheduler.active_count,
+                self.scheduler.queue_depth)
+        if self.obs is not None:
+            self.obs.flight.record(
+                self._steps, step_time_s=step_s,
+                queue_depth=self.scheduler.queue_depth,
+                slots_active=self.scheduler.active_count,
+                terminal=len(outputs))
         return outputs
+
+    def dump_flight(self, reason: str) -> Optional[str]:
+        """Persist the per-engine-step flight ring (when an ``obs`` hub is
+        attached); the serving crash-evidence path used by ``replay_trace``."""
+        if self.obs is not None:
+            return self.obs.dump_flight(reason)
+        return None
 
     def run_until_complete(self, max_steps: Optional[int] = None) -> List[RequestOutput]:
         """Drive ``step()`` until queue and slots drain; returns every
@@ -267,6 +359,8 @@ class ServingEngine:
         valid_ctx = jnp.asarray(
             (np.arange(self.C) >= self.C - L).astype(np.int32))[None, :]
         logits, row_caches = self.model.prefill_one(jnp.asarray(ids), valid_ctx)
+        logits = perturb("serving/prefill_logits", logits,
+                         request_id=req.request_id, engine_step=self._steps)
         row_valid = jnp.concatenate(
             [valid_ctx, jnp.zeros((1, self.T - self.C), jnp.int32)], axis=1)
         self.caches, self.valid = self.model.insert_slot(
@@ -281,19 +375,23 @@ class ServingEngine:
         self._temps[slot] = s.temperature
         self._topks[slot] = s.top_k
         self._topps[slot] = s.top_p
-        tok = int(_sample_rows(
+        toks, finite = _sample_rows(
             logits, jnp.asarray(self._base_keys[slot])[None, :],
             jnp.zeros((1,), jnp.int32),
             jnp.full((1,), s.temperature, jnp.float32),
             jnp.full((1,), s.top_k, jnp.int32),
-            jnp.full((1,), s.top_p, jnp.float32))[0])
+            jnp.full((1,), s.top_p, jnp.float32))
         now = self._clock()
+        self.registry.counter("serving/admitted_total").inc()
+        if not bool(finite[0]):
+            self._fail_slot(slot, req, outputs, now)
+            return
+        tok = int(toks[0])
         req.transition(RequestState.DECODE)
         req.first_token_time = now
         if req.submit_time is not None:
             self.registry.histogram("serving/ttft_ms", MS_BUCKETS).observe(
                 (now - req.submit_time) * 1e3)
-        self.registry.counter("serving/admitted_total").inc()
         self._append_token(slot, req, tok, now)
         if not req.done:
             self._offsets[slot] = self.C
@@ -313,13 +411,22 @@ class ServingEngine:
         logits, self.caches, self.valid = self.model.decode_slots(
             jnp.asarray(self._next_tok)[:, None], self._offsets,
             self.caches, self.valid)
-        toks = np.asarray(_sample_rows(
+        logits = perturb("serving/decode_logits", logits,
+                         engine_step=self._steps)
+        toks_f = _sample_rows(
             logits, jnp.asarray(self._base_keys), jnp.asarray(tok_idx),
             jnp.asarray(self._temps), jnp.asarray(self._topks),
-            jnp.asarray(self._topps)))
+            jnp.asarray(self._topps))
+        toks, finite = np.asarray(toks_f[0]), np.asarray(toks_f[1])
         now = self._clock()
         for slot, req in active:
             self._offsets[slot] += 1  # the step wrote req's previous token
+            if not bool(finite[slot]):
+                # quarantine: fail THIS request only — its logits blew up;
+                # co-batched rows never mixed with them (attention is
+                # per-row) and keep decoding untouched
+                self._fail_slot(slot, req, outputs, now)
+                continue
             tok = int(toks[slot])
             last = self._last_tok_time[slot]
             if last is not None:
@@ -332,6 +439,25 @@ class ServingEngine:
                 self._next_tok[slot] = tok
             else:
                 outputs.append(self._emit(req, now))
+
+    def _fail_slot(self, slot: int, req: Request, outputs: list,
+                   now: float) -> None:
+        """Quarantine one numerically poisoned request: terminal ``FAILED``
+        state, slot freed and parked (the next ``insert_slot`` overwrites the
+        poisoned KV rows; a parked row's logits are ignored meanwhile), the
+        rest of the batch untouched."""
+        req.transition(RequestState.FAILED)
+        req.finish_reason = FAIL_NON_FINITE
+        req.finish_time = now
+        self.scheduler.release(req)
+        self._offsets[slot] = self.T  # park
+        self._last_tok_time[slot] = None
+        self.registry.counter("serving/failed_total").inc()
+        logger.warning(
+            "serving: request %d failed (%s) after %d tokens — slot %d "
+            "quarantined and freed", req.request_id, FAIL_NON_FINITE,
+            len(req.generated), slot)
+        outputs.append(self._emit(req, now))
 
     def _append_token(self, slot: int, req: Request, tok: int, now: float) -> None:
         """Record + stream one generated token; finish the request when it

@@ -3,8 +3,11 @@
 A :class:`Request` is the unit the engine schedules: it enters QUEUED,
 moves to PREFILL when a slot is granted, DECODE after its prompt's KV rows
 are slot-inserted, and terminates in exactly one of FINISHED (EOS / length),
-CANCELLED (caller), or TIMED_OUT (deadline sweep).  Transitions are
-validated — an illegal edge is an engine bug, not a recoverable condition.
+CANCELLED (caller), TIMED_OUT (deadline sweep), or FAILED (the engine
+quarantined the request — e.g. its logits went non-finite; the *one*
+request fails, its slot is freed, co-batched requests are untouched).
+Transitions are validated — an illegal edge is an engine bug, not a
+recoverable condition.
 
 Per-request sampler settings (:class:`SamplingParams`) and stop conditions
 ride on the request, so one compiled decode program serves every
@@ -27,19 +30,24 @@ class RequestState(enum.Enum):
     FINISHED = "finished"
     CANCELLED = "cancelled"
     TIMED_OUT = "timed_out"
+    FAILED = "failed"
 
 
-# legal lifecycle edges; terminal states have no successors
+# legal lifecycle edges; terminal states have no successors.  FAILED is
+# reachable only from the compute states (PREFILL/DECODE): a queued request
+# has run nothing that could fail.
 _TRANSITIONS = {
     RequestState.QUEUED: {RequestState.PREFILL, RequestState.CANCELLED,
                           RequestState.TIMED_OUT},
     RequestState.PREFILL: {RequestState.DECODE, RequestState.FINISHED,
-                           RequestState.CANCELLED, RequestState.TIMED_OUT},
+                           RequestState.CANCELLED, RequestState.TIMED_OUT,
+                           RequestState.FAILED},
     RequestState.DECODE: {RequestState.FINISHED, RequestState.CANCELLED,
-                          RequestState.TIMED_OUT},
+                          RequestState.TIMED_OUT, RequestState.FAILED},
     RequestState.FINISHED: set(),
     RequestState.CANCELLED: set(),
     RequestState.TIMED_OUT: set(),
+    RequestState.FAILED: set(),
 }
 
 TERMINAL_STATES = frozenset(

@@ -28,6 +28,14 @@ class AdmissionError(ValueError):
     """Request can never fit the compiled serving envelope."""
 
 
+class BackpressureError(RuntimeError):
+    """The admission queue is full — a *transient* rejection (unlike
+    :class:`AdmissionError`): the same request can be retried once load
+    drains.  A bounded queue is what keeps an overloaded engine's latency
+    bounded instead of letting the backlog (and every deadline in it) grow
+    without limit."""
+
+
 class SlotScheduler:
     """Fixed-``B`` slot table + FCFS queue.
 
@@ -37,15 +45,25 @@ class SlotScheduler:
     - ``prompt_len <= context_len`` (the compiled prefill width);
     - ``context_len + max_new_tokens <= max_total_len`` (decode slots start
       at the prefill boundary, so this — not ``prompt_len +
-      max_new_tokens`` — is the binding cache-capacity bound).
+      max_new_tokens`` — is the binding cache-capacity bound);
+    - when ``max_queue`` is set, the *excess* backlog (queued requests
+      beyond the free slots the next ``admit`` can immediately grant) is
+      bounded: exceeding it raises :class:`BackpressureError` (transient;
+      retryable) so overload is rejected at the edge instead of
+      accumulating unbounded backlog.  A burst of ``free_count +
+      max_queue`` submissions always fits.
     """
 
-    def __init__(self, num_slots: int, context_len: int, max_total_len: int):
+    def __init__(self, num_slots: int, context_len: int, max_total_len: int,
+                 max_queue: Optional[int] = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.num_slots = num_slots
         self.context_len = context_len
         self.max_total_len = max_total_len
+        self.max_queue = max_queue
         self._queue: deque = deque()
         self._slots: List[Optional[Request]] = [None] * num_slots
         self._slot_of: Dict[int, int] = {}
@@ -76,9 +94,13 @@ class SlotScheduler:
 
     def submit(self, request: Request, now: Optional[float] = None) -> None:
         """Queue a request FCFS; raises :class:`AdmissionError` when it can
-        never fit the compiled envelope."""
+        never fit the compiled envelope, :class:`BackpressureError` when the
+        bounded queue is full (retryable)."""
         if request.request_id in self._by_id:
             raise ValueError(f"duplicate request id {request.request_id}")
+        # envelope checks BEFORE the backlog check: a never-fits request must
+        # get the permanent AdmissionError even under load, not a retryable
+        # BackpressureError a well-behaved client would loop on forever
         if request.prompt_len > self.context_len:
             raise AdmissionError(
                 f"request {request.request_id}: prompt_len "
@@ -89,6 +111,13 @@ class SlotScheduler:
                 f"({self.context_len} + {request.max_new_tokens}) > "
                 f"max_total_len {self.max_total_len} (decode slots start at "
                 "the prefill boundary)")
+        if self.max_queue is not None \
+                and len(self._queue) - self.free_count >= self.max_queue:
+            raise BackpressureError(
+                f"request {request.request_id}: admission backlog full "
+                f"({len(self._queue)} queued, {self.free_count} free slots, "
+                f"max_queue {self.max_queue}); retry after the backlog "
+                "drains")
         request.submit_time = time.monotonic() if now is None else now
         self._by_id[request.request_id] = request
         self._queue.append(request)

@@ -33,10 +33,21 @@ wait-before-next-save contract.
 Kept reference semantics: tagged checkpoint directories, a ``newest`` pointer
 file, ``num_kept_ckpts`` rotation, and separate model / optimizer /
 scheduler / user_content payloads (``:175-199``).
+
+Crash consistency (resilience PR): the visibility markers — ``meta.json``,
+``.done``, ``newest``, written in that order after the shard payloads are
+durable — go through :func:`_atomic_write` (tmp + ``fsync`` +
+``os.replace``), so a hard kill at ANY point mid-save leaves
+:func:`newest_tag` resolving to a complete checkpoint (the in-flight tag
+never becomes visible; the next save of the same tag clears the debris).
+The ``ckpt/*`` fault points interleaved below let subprocess tests kill the
+process at each such point and prove it
+(``tests/test_resilience.py::test_checkpoint_kill_point_matrix``).
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import shutil
@@ -47,6 +58,7 @@ import orbax.checkpoint as ocp
 from jax.experimental import multihost_utils
 from jax.sharding import NamedSharding
 
+from neuronx_distributed_tpu.resilience.faults import fault_point
 from neuronx_distributed_tpu.utils.distributed import is_primary as _is_primary
 from neuronx_distributed_tpu.utils.logger import get_logger
 
@@ -59,6 +71,27 @@ _DONE = ".done"
 def _barrier(name: str) -> None:
     if jax.process_count() > 1:
         multihost_utils.sync_global_devices(name)
+
+
+def _atomic_write(path: str, text: str) -> None:
+    """Crash-consistent marker write: tmp file + ``fsync`` + ``os.replace``.
+    The visibility markers (``meta.json``, ``.done``, ``newest``) are what
+    :func:`newest_tag`/:func:`load_checkpoint` trust — a kill mid-``write``
+    must leave either the old content or the new, never a truncated file.
+    Stale tmps from previous killed saves (dead PIDs — only process 0 writes
+    markers) are reaped here so crash-restart cycles can't accumulate
+    orphans."""
+    for stale in glob.glob(f"{path}.tmp.*"):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 class _PendingSave:
@@ -148,6 +181,7 @@ def save_checkpoint(
             shutil.rmtree(path)
         os.makedirs(path, exist_ok=True)
     _barrier(f"ckpt_prep:{tag}")
+    fault_point("ckpt/pre_shard_write", tag=tag)
 
     checkpointers: List[ocp.AsyncCheckpointer] = []
     payloads = [("model", model_state)]
@@ -158,6 +192,7 @@ def save_checkpoint(
             c = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
             checkpointers.append(c)
             c.save(os.path.join(path, name), args=ocp.args.StandardSave(state))
+            fault_point("ckpt/mid_shard_write", tag=tag, payload=name)
     except Exception:
         # never orphan an in-flight background write: a later save of the
         # same tag would rmtree the directory under its TensorStore streams
@@ -178,16 +213,17 @@ def save_checkpoint(
                 meta["scheduler"] = scheduler_state
             if user_content is not None:
                 meta["user_content"] = user_content
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump(meta, f)
-            with open(os.path.join(path, _DONE), "w") as f:
-                f.write("ok")
-            with open(os.path.join(ckpt_dir, _NEWEST), "w") as f:
-                f.write(tag)
+            fault_point("ckpt/pre_meta", tag=tag)
+            _atomic_write(os.path.join(path, "meta.json"), json.dumps(meta))
+            fault_point("ckpt/pre_done", tag=tag)
+            _atomic_write(os.path.join(path, _DONE), "ok")
+            fault_point("ckpt/pre_newest", tag=tag)
+            _atomic_write(os.path.join(ckpt_dir, _NEWEST), tag)
             if num_kept_ckpts is not None and num_kept_ckpts > 0:
                 for old in _list_tags(ckpt_dir)[:-num_kept_ckpts]:
                     logger.info("rotating out checkpoint %s", old)
                     shutil.rmtree(_tag_dir(ckpt_dir, old), ignore_errors=True)
+                    fault_point("ckpt/mid_rotation", tag=tag, rotated=old)
         _barrier(f"ckpt_done:{tag}")
         logger.info("saved checkpoint %s", path)
 

@@ -35,8 +35,10 @@ import time
 from typing import Any, Callable, Iterable, Optional
 
 import jax
+import jax.numpy as jnp
 
 from neuronx_distributed_tpu.config import TrainingConfig
+from neuronx_distributed_tpu.resilience.faults import fault_point, perturb
 from neuronx_distributed_tpu.trainer.checkpoint import (
     load_checkpoint,
     newest_tag,
@@ -64,6 +66,9 @@ class FitResult:
     start_step: int
     peak_seq_per_sec: float
     eval_history: list  # [(step, eval_loss)]
+    policy_events: list = dataclasses.field(default_factory=list)
+    # [{"action", "reason", "step", "message"}] — skips/rollbacks/watchdog
+    # warnings taken by the AnomalyPolicy (empty without policy=)
 
 
 class Callback:
@@ -130,6 +135,7 @@ def fit(
     on_step: Optional[Callable[[int, dict], None]] = None,
     callbacks: "tuple[Callback, ...] | list" = (),
     checkpoint_on_signal: bool = False,
+    policy: "Any | None" = None,
 ) -> FitResult:
     """Run the training loop: steps, eval cadence, checkpoint cadence with
     resume, scalar/throughput logging.
@@ -173,6 +179,20 @@ def fit(
         maintenance events and preemptions send SIGTERM, so this turns a
         preemption into a clean ``resume=True`` restart instead of losing
         the work since the last cadence save.  Requires ``ckpt_dir``.
+      policy: a :class:`~..resilience.AnomalyPolicy` — turns detections into
+        actions instead of warnings.  NaN / loss-spike steps can be
+        *skipped* (pre-step params and optimizer state restored — costs one
+        device-side copy of both per step while armed — the batch counts as
+        consumed, no eval/checkpoint/callbacks fire for the discarded step)
+        or *rolled back* (reload the newest checkpoint, rewind the step
+        counter and with it the step-indexed data position; requires
+        ``ckpt_dir`` and callable ``data`` — an iterator cannot rewind; an
+        initial checkpoint is written when none exists so a rollback target
+        is always available).  Budgets (``max_skips`` / ``max_rollbacks``)
+        raise ``RetriesExhausted`` when exhausted; the optional step-latency
+        watchdog warns or halts on stalled steps.  Actions taken are
+        returned in ``FitResult.policy_events`` and counted in the obs
+        registry (``resilience/*_total``).
     """
     if checkpoint_on_signal:
         if not ckpt_dir:
@@ -192,22 +212,14 @@ def fit(
 
     params, opt_state = model.params, optimizer.state
     start_step = 0
+    resumed_user: dict = {}
     if resume and ckpt_dir and newest_tag(ckpt_dir):
         params, opt_state, _, user = load_checkpoint(
             ckpt_dir, model_template=params, optimizer_template=opt_state
         )
-        start_step = int((user or {}).get("step", 0))
+        resumed_user = dict(user or {})
+        start_step = int(resumed_user.get("step", 0))
         logger.info("resumed from step %d (%s)", start_step, newest_tag(ckpt_dir))
-
-    if callable(data):
-        next_batch = data
-    else:
-        it = iter(data)
-        for _ in range(start_step):  # iterator resume: consume skipped steps
-            next(it)
-
-        def next_batch(step):
-            return next(it)
 
     from neuronx_distributed_tpu.trainer.scalar_log import ScalarWriter
 
@@ -220,6 +232,50 @@ def fit(
         obs_rt = obs if isinstance(obs, Observability) else Observability(
             str(obs), timeline=timeline)
     obs_audited = False
+
+    policy_rt = None
+    if policy is not None:
+        from neuronx_distributed_tpu.resilience.policy import PolicyEngine
+
+        if policy.wants_rollback:
+            if not ckpt_dir:
+                raise ValueError("policy rollback requires ckpt_dir (the "
+                                 "newest checkpoint is the rollback target)")
+            if not callable(data):
+                raise ValueError(
+                    "policy rollback requires step-indexed data(step): an "
+                    "iterator's consumed batches cannot be re-wound")
+        policy_rt = PolicyEngine(
+            policy, registry=obs_rt.registry if obs_rt is not None else None)
+        if policy.wants_rollback and newest_tag(ckpt_dir) is None:
+            # guarantee a rollback target before the first cadence save: an
+            # anomaly at step 0..ckpt_every would otherwise have nothing to
+            # roll back to
+            save_checkpoint(ckpt_dir, f"step_{start_step}", params, opt_state,
+                            user_content={"step": start_step,
+                                          "batches_consumed": start_step},
+                            num_kept_ckpts=keep_ckpts,
+                            save_dtype=ckpt_save_dtype)
+
+    if callable(data):
+        next_batch = data
+    else:
+        it = iter(data)
+        for consumed in range(start_step):  # iterator resume: skip consumed
+            try:
+                next(it)
+            except StopIteration:
+                raise ValueError(
+                    f"resume fast-forward: the data iterator was exhausted "
+                    f"after {consumed} batches while seeking start step "
+                    f"{start_step}; the checkpoint records batches_consumed="
+                    f"{resumed_user.get('batches_consumed', 'unrecorded')} — "
+                    "the resumed data source is shorter than the one the "
+                    "checkpointed run consumed (wrong data file, un-reset "
+                    "epoch, or a differently-seeded shuffle)") from None
+
+        def next_batch(step):
+            return next(it)
 
     thr: Optional[Throughput] = None
     tokens_per_batch = None
@@ -259,10 +315,31 @@ def fit(
     final_step = steps
     last_saved_step = -1
     try:
-        for step in range(start_step, steps):
+        step = start_step
+        while step < steps:
+            if signal_seen:
+                # checked at the TOP of the loop so no path can outrun a
+                # pending preemption notice — the policy skip/rollback
+                # `continue`s land here instead of running another step
+                final_step = step
+                logger.info("stopping on signal %s after step %d (checkpoint "
+                            "follows)", signal_seen[0], final_step)
+                if obs_rt is not None:
+                    # flight evidence lands BEFORE the final checkpoint
+                    # drains — a second (fatal) signal still leaves the
+                    # dump behind
+                    obs_rt.dump_flight(f"signal_{signal_seen[0]}")
+                break
+            fault_point("fit/step_start", step=step, start_step=start_step)
             t_data = time.perf_counter()
             batch = next_batch(step)
             data_wait_s = time.perf_counter() - t_data
+            snap = None
+            if policy_rt is not None and policy.wants_snapshot:
+                # the jitted step donates params/opt buffers; a skip-update
+                # decision needs the pre-step state back, so keep a copy
+                snap = (jax.tree.map(jnp.copy, params),
+                        jax.tree.map(jnp.copy, opt_state))
             if thr is None:
                 leaves = jax.tree.leaves(batch)
                 bsz = leaves[0].shape[0]
@@ -296,6 +373,7 @@ def fit(
                 t_dispatch = time.perf_counter()
                 loss = float(m["loss"])
                 t_done = time.perf_counter()
+            loss = perturb("fit/loss", loss, step=step)
             seqs = thr.step()
             grad_norm = float(m["grad_norm"])
             if obs_rt is not None:
@@ -303,6 +381,38 @@ def fit(
                     step, loss=loss, grad_norm=grad_norm, seq_per_sec=seqs,
                     step_time_s=t_done - t0, host_s=t_dispatch - t0,
                     device_s=t_done - t_dispatch, data_wait_s=data_wait_s)
+            if policy_rt is not None:
+                decision = policy_rt.decide(step, loss=loss,
+                                            grad_norm=grad_norm,
+                                            step_time_s=t_done - t0)
+                if decision is not None and decision.action == "skip":
+                    # discard the update: pre-step params/opt restored, the
+                    # batch counts as consumed (scalars/eval/checkpoint/
+                    # callbacks do not fire for the discarded step)
+                    params, opt_state = snap
+                    step += 1
+                    continue
+                if decision is not None and decision.action == "rollback":
+                    wait_for_checkpoint()
+                    params, opt_state, _, user = load_checkpoint(
+                        ckpt_dir, model_template=params,
+                        optimizer_template=opt_state)
+                    rb_step = int((user or {}).get("step", 0))
+                    if rb_step > step:
+                        # the newest tag is AHEAD of this run: ckpt_dir holds
+                        # another run's checkpoints (resume=False into a used
+                        # dir) — "rolling back" onto them would teleport the
+                        # run forward onto foreign params and mark the result
+                        # complete
+                        raise RuntimeError(
+                            f"policy rollback loaded step {rb_step} > current "
+                            f"step {step} from {newest_tag(ckpt_dir)}: "
+                            f"{ckpt_dir} holds checkpoints this run did not "
+                            "write (stale dir? missing resume=True?)")
+                    step = rb_step
+                    logger.warning("policy: rolled back to step %d (%s)",
+                                   step, newest_tag(ckpt_dir))
+                    continue
             if scalars:
                 scalars.scalars(step, loss=loss, grad_norm=grad_norm,
                                 seq_per_sec=seqs)
@@ -331,25 +441,18 @@ def fit(
             if ckpt_dir and ckpt_every and (step + 1) % ckpt_every == 0 \
                     and step + 1 < steps:
                 path = save_checkpoint(ckpt_dir, f"step_{step + 1}", params, opt_state,
-                                       user_content={"step": step + 1},
+                                       user_content={"step": step + 1,
+                                                     "batches_consumed": step + 1},
                                        num_kept_ckpts=keep_ckpts, async_save=async_save,
                                        save_dtype=ckpt_save_dtype)
                 last_saved_step = step + 1
                 for cb in cbs:
                     cb.on_checkpoint(step + 1, path)
-            if signal_seen:
-                final_step = step + 1
-                logger.info("stopping on signal %s after step %d (checkpoint "
-                            "follows)", signal_seen[0], final_step)
-                if obs_rt is not None:
-                    # flight evidence lands BEFORE the final checkpoint drains
-                    # — a second (fatal) signal still leaves the dump behind
-                    obs_rt.dump_flight(f"signal_{signal_seen[0]}")
-                break
             if any(cb.should_stop for cb in cbs):
                 final_step = step + 1
                 logger.info("callback requested stop after step %d", final_step)
                 break
+            step += 1
 
         ran_any = start_step < steps
         if not ran_any:
@@ -361,7 +464,8 @@ def fit(
                 # skip when an early stop landed exactly on a cadence save — a
                 # rewrite would rmtree the just-written tag and double-notify
                 path = save_checkpoint(ckpt_dir, f"step_{final_step}", params, opt_state,
-                                       user_content={"step": final_step},
+                                       user_content={"step": final_step,
+                                                     "batches_consumed": final_step},
                                        num_kept_ckpts=keep_ckpts,
                                        save_dtype=ckpt_save_dtype)
                 wait_for_checkpoint()
@@ -398,6 +502,9 @@ def fit(
             "resumed_from_step": start_step,
             "peak_seq_per_sec": thr.peak if thr else 0.0,
         }
+        if policy_rt is not None:
+            summary["policy_skipped_updates"] = policy_rt.skips
+            summary["policy_rollbacks"] = policy_rt.rollbacks
         if flops_per_token and peak_flops and thr and thr.window \
                 and tokens_per_batch:
             toks_per_sec = thr.batch_size * len(thr.window) / max(
@@ -414,6 +521,7 @@ def fit(
         start_step=start_step,
         peak_seq_per_sec=thr.peak if thr else 0.0,
         eval_history=eval_history,
+        policy_events=list(policy_rt.events) if policy_rt is not None else [],
     )
     for cb in cbs:
         cb.on_fit_end(result)

@@ -30,7 +30,7 @@ def test_schema_list_is_complete():
     """The artifact kinds the framework documents all have schemas."""
     assert {"scalars", "flight_record", "flight_step", "anomaly",
             "hlo_audit", "tpu_watch", "obs_report",
-            "serving_stats"} <= set(SCHEMAS)
+            "serving_stats", "supervisor_event"} <= set(SCHEMAS)
 
 
 def test_committed_tpu_watch_results_validate():
@@ -130,6 +130,32 @@ def test_serving_stats_schema(tmp_path):
     with pytest.raises(ValueError, match="expected"):
         bad = dict(recs[0], new_tokens="8")
         validate_record("serving_stats", bad)
+
+
+def test_supervisor_events_validate_and_merge_into_report(tmp_path):
+    """The live supervisor emitter's events validate against the schema, and
+    the obs report merges them (restarts / causes / final outcome)."""
+    import sys
+
+    from neuronx_distributed_tpu.resilience.supervisor import Supervisor
+
+    events = str(tmp_path / "supervisor_events.jsonl")
+    sup = Supervisor([sys.executable, "-c", "print('ok')"],
+                     events_path=events, max_restarts=0)
+    res = sup.run()
+    assert res.ok
+    assert validate_jsonl("supervisor_event", events) == 3  # start/exit/success
+    with pytest.raises(ValueError, match="missing required field"):
+        validate_record("supervisor_event", {"schema": "supervisor_events/1",
+                                             "time": 1.0, "event": "start"})
+
+    from neuronx_distributed_tpu.obs.report import build_report
+
+    report = build_report(run_dir=str(tmp_path))
+    validate_record("obs_report", report)
+    assert report["supervisor"]["succeeded"] is True
+    assert report["supervisor"]["restarts"] == 0
+    assert report["health"]["restarts"] == 0
 
 
 def test_validate_record_rejects_bad_records():

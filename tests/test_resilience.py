@@ -1,0 +1,582 @@
+"""Resilience subsystem tests (fast tier; `chaos`-marked members spawn
+subprocesses that get killed / crashed / restarted on purpose).
+
+Three layers of assurance, mirroring the subsystem's split:
+
+- **fault plane + policy properties** — pure host-side: plan parsing and
+  matching semantics, skip/rollback/halt decisions and budgets, the
+  step-latency watchdog, supervisor restart/backoff/giveup/timeout logic
+  (children are trivial non-jax scripts, so these stay fast);
+- **fit() integration** — in-process: injected NaN loss → skip-update keeps
+  training with the update discarded; → rollback re-winds to the newest
+  checkpoint and the step-indexed data position; iterator resume that
+  cannot fast-forward fails with a diagnosable error;
+- **crash consistency (the acceptance matrix, `chaos`)** — a subprocess is
+  hard-killed (`os._exit`) at EVERY checkpoint kill point
+  (pre-shard-write, mid-shard-write, pre-`.done`, pre-`newest`,
+  mid-rotation); a fresh process must find ``newest_tag`` resolving to a
+  complete checkpoint, and the resumed run's per-step losses must be
+  token-identical to an uninterrupted run.  The supervisor demo survives
+  one injected hard exception (process restart + resume) and one injected
+  NaN (in-process policy rollback) with no manual intervention, visible in
+  ``supervisor_events.jsonl`` and the obs report.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import pytest
+
+from neuronx_distributed_tpu.resilience import (
+    AnomalyPolicy,
+    FaultPlan,
+    InjectedFault,
+    KILL_EXIT_CODE,
+    PolicyEngine,
+    PolicyHalt,
+    RetriesExhausted,
+    StepWatchdog,
+    Supervisor,
+    classify_exit,
+    clear_plan,
+    fired_events,
+    install_plan,
+    newest_complete_tag,
+    perturb,
+)
+from neuronx_distributed_tpu.resilience import faults as faults_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    clear_plan()
+    yield
+    clear_plan()
+
+
+# -- fault plane ------------------------------------------------------------
+
+def test_fault_plan_matching_counts_and_actions():
+    install_plan({"faults": [
+        {"point": "a/b", "action": "nan", "match": {"step": 3}},
+        {"point": "a/c", "action": "exception", "message": "boom",
+         "count": 2},
+        {"point": "a/d", "action": "nan", "hit": 2, "count": 0},
+    ]})
+    # match filter: only step 3 fires, and only count=1 times
+    assert perturb("a/b", 1.0, step=2) == 1.0
+    assert math.isnan(perturb("a/b", 1.0, step=3))
+    assert perturb("a/b", 1.0, step=3) == 1.0  # count exhausted
+    # a spec whose match key is absent from ctx never fires
+    assert perturb("a/b", 1.0) == 1.0
+    # count=2 exceptions, then inert
+    for _ in range(2):
+        with pytest.raises(InjectedFault, match="boom"):
+            perturb("a/c", None)
+    assert perturb("a/c", 5.0) == 5.0
+    # hit=2 skips the first matching invocation; count=0 is unlimited
+    assert perturb("a/d", 1.0) == 1.0
+    assert math.isnan(perturb("a/d", 1.0))
+    assert math.isnan(perturb("a/d", 1.0))
+    fired = fired_events()
+    assert [f["point"] for f in fired] == ["a/b", "a/c", "a/c", "a/d", "a/d"]
+
+
+def test_fault_plan_validation():
+    with pytest.raises(ValueError, match="unknown action"):
+        FaultPlan([{"point": "x", "action": "explode"}])
+    with pytest.raises(ValueError, match="no 'point'"):
+        FaultPlan([{"action": "nan"}])
+    with pytest.raises(ValueError, match="unknown keys"):
+        # conditions must go under "match", not sit at top level
+        FaultPlan([{"point": "x", "action": "nan", "step": 3}])
+
+
+def test_fault_plan_nan_poisons_array_row():
+    import numpy as np
+
+    install_plan({"faults": [
+        {"point": "p", "action": "nan", "slot": 1},
+        {"point": "q", "action": "nan"},
+    ]})
+    out = perturb("p", np.ones((3, 4), np.float32))
+    assert np.isnan(out[1]).all() and np.isfinite(out[[0, 2]]).all()
+    assert np.isnan(perturb("q", np.ones((2,), np.float32))).all()
+
+
+def test_fault_plan_from_env_inline_and_file(tmp_path, monkeypatch):
+    clear_plan()
+    monkeypatch.setenv(faults_mod.ENV_VAR,
+                       '{"faults": [{"point": "e", "action": "nan"}]}')
+    assert math.isnan(perturb("e", 1.0))
+    clear_plan()
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps(
+        {"faults": [{"point": "f", "action": "nan"}]}))
+    monkeypatch.setenv(faults_mod.ENV_VAR, str(plan_file))
+    assert math.isnan(perturb("f", 2.0))
+    clear_plan()
+    monkeypatch.delenv(faults_mod.ENV_VAR)
+    assert perturb("e", 1.0) == 1.0  # no plan, no perturbation
+
+
+# -- policy engine ----------------------------------------------------------
+
+def test_policy_skip_budget_then_exhausted():
+    pe = PolicyEngine(AnomalyPolicy(on_nan="skip", max_skips=2))
+    d = pe.decide(0, loss=float("nan"))
+    assert d.action == "skip" and d.reason == "nan_loss"
+    assert pe.decide(1, loss=1.0) is None
+    assert pe.decide(2, loss=float("inf")).action == "skip"
+    with pytest.raises(RetriesExhausted, match="skip budget"):
+        pe.decide(3, loss=float("nan"))
+    assert pe.skips == 2
+    assert [e["action"] for e in pe.events] == ["skip", "skip"]
+
+
+def test_policy_spike_maps_to_rollback_and_halt():
+    pol = AnomalyPolicy(on_nan="halt", on_spike="rollback",
+                        spike_min_history=4, spike_z=4.0, max_rollbacks=1)
+    pe = PolicyEngine(pol)
+    for i in range(6):
+        assert pe.decide(i, loss=1.0 + 1e-4 * i) is None
+    d = pe.decide(6, loss=100.0)
+    assert d is not None and d.action == "rollback" and d.reason == "loss_spike"
+    with pytest.raises(RetriesExhausted, match="rollback budget"):
+        pe.decide(7, loss=100.0)
+    pe2 = PolicyEngine(pol)
+    with pytest.raises(PolicyHalt, match="nan_loss"):
+        pe2.decide(0, loss=float("nan"))
+
+
+def test_policy_watchdog_warns_and_halts():
+    wd = StepWatchdog(factor=3.0, min_excess_s=0.5, min_history=4)
+    for i in range(6):
+        assert wd.check(i, 0.1) is None
+    assert wd.check(6, 5.0) is not None and wd.strikes == 1
+
+    pol = AnomalyPolicy(watchdog_factor=3.0, watchdog_min_excess_s=0.5,
+                        watchdog_min_history=4, on_watchdog="warn")
+    pe = PolicyEngine(pol)
+    for i in range(6):
+        assert pe.decide(i, loss=1.0, step_time_s=0.1) is None
+    d = pe.decide(6, loss=1.0, step_time_s=5.0)
+    assert d is not None and d.action == "warn" and d.reason == "watchdog"
+
+    pe2 = PolicyEngine(AnomalyPolicy(
+        watchdog_factor=3.0, watchdog_min_excess_s=0.5,
+        watchdog_min_history=4, on_watchdog="halt"))
+    for i in range(6):
+        pe2.decide(i, loss=1.0, step_time_s=0.1)
+    with pytest.raises(PolicyHalt, match="watchdog"):
+        pe2.decide(6, loss=1.0, step_time_s=5.0)
+
+
+def test_anomaly_policy_validates_actions():
+    with pytest.raises(ValueError, match="on_nan"):
+        AnomalyPolicy(on_nan="explode")
+    with pytest.raises(ValueError, match="on_watchdog"):
+        AnomalyPolicy(on_watchdog="rollback")
+    assert AnomalyPolicy(on_nan="skip").wants_snapshot
+    assert not AnomalyPolicy(on_nan="rollback").wants_snapshot
+    assert AnomalyPolicy(on_nan="rollback").wants_rollback
+
+
+# -- supervisor (trivial non-jax children: fast) ----------------------------
+
+def _crashy_script(tmp_path, crashes: int) -> str:
+    """A child that crashes `crashes` times (tracked in a state file), then
+    exits clean."""
+    state = tmp_path / "state"
+    script = tmp_path / "child.py"
+    script.write_text(
+        f"import os, sys\n"
+        f"p = {str(state)!r}\n"
+        f"n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        f"open(p, 'w').write(str(n + 1))\n"
+        f"if n < {crashes}:\n"
+        f"    raise RuntimeError('boom %d' % n)\n"
+        f"print('clean exit')\n")
+    return str(script)
+
+
+def test_supervisor_restarts_until_clean(tmp_path):
+    events_path = str(tmp_path / "supervisor_events.jsonl")
+    sup = Supervisor(
+        [sys.executable, _crashy_script(tmp_path, crashes=2)],
+        max_restarts=3, backoff_base_s=0.01, events_path=events_path,
+        log_path=str(tmp_path / "child.log"))
+    res = sup.run()
+    assert res.ok and res.attempts == 3 and res.restarts == 2
+    assert res.causes == ["exception", "exception"]
+    kinds = [e["event"] for e in sup.events]
+    assert kinds == ["start", "exit", "restart", "start", "exit", "restart",
+                     "start", "exit", "success"]
+    # exponential backoff recorded
+    backoffs = [e["backoff_s"] for e in sup.events if e["event"] == "restart"]
+    assert backoffs == [0.01, 0.02]
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    assert validate_jsonl("supervisor_event", events_path) == 9
+
+
+def test_supervisor_gives_up_when_budget_spent(tmp_path):
+    sup = Supervisor(
+        [sys.executable, _crashy_script(tmp_path, crashes=99)],
+        max_restarts=1, backoff_base_s=0.01,
+        events_path=str(tmp_path / "ev.jsonl"),
+        log_path=str(tmp_path / "child.log"))
+    res = sup.run()
+    assert not res.ok and res.restarts == 1 and res.final_rc != 0
+    assert sup.events[-1]["event"] == "giveup"
+
+
+def test_supervisor_kills_wedged_child_on_timeout(tmp_path):
+    script = tmp_path / "wedged.py"
+    script.write_text("import time\ntime.sleep(600)\n")
+    sup = Supervisor([sys.executable, str(script)], max_restarts=0,
+                     timeout_s=1.0, events_path=str(tmp_path / "ev.jsonl"))
+    res = sup.run()
+    assert not res.ok and res.causes == ["timeout"]
+
+
+def test_newest_complete_tag_marker_semantics(tmp_path):
+    d = str(tmp_path / "ck")
+    assert newest_complete_tag(d) is None
+    os.makedirs(os.path.join(d, "step_2"))
+    open(os.path.join(d, "step_2", ".done"), "w").write("ok")
+    open(os.path.join(d, "newest"), "w").write("step_2")
+    assert newest_complete_tag(d) == "step_2"
+    # stale pointer (tag without .done) falls back to newest completed tag
+    os.makedirs(os.path.join(d, "step_4"))
+    open(os.path.join(d, "newest"), "w").write("step_4")
+    assert newest_complete_tag(d) == "step_2"
+
+
+def test_classify_exit_signatures():
+    assert classify_exit(0, "") == "clean"
+    assert classify_exit(-15, "") == "signal_SIGTERM"
+    assert classify_exit(1, "...\nInjectedFault: at fit/step_start") \
+        == "injected_fault"
+    assert classify_exit(1, "Traceback (most recent call last)\nValueError") \
+        == "exception"
+    assert classify_exit(7, "") == "exit_7"
+
+
+# -- fit() integration (in-process, CPU mesh) -------------------------------
+
+@pytest.fixture
+def config(devices8):
+    import neuronx_distributed_tpu as nxd
+
+    return nxd.training_config(tensor_parallel_size=2, learning_rate=5e-3)
+
+
+def _build(config):
+    import jax.numpy as jnp
+    from test_trainer import TinyLM
+    from neuronx_distributed_tpu.trainer import (
+        initialize_parallel_model,
+        initialize_parallel_optimizer,
+    )
+
+    m = initialize_parallel_model(config, TinyLM, (jnp.zeros((1, 8), jnp.int32),))
+    o = initialize_parallel_optimizer(config, m)
+    return m, o
+
+
+def _fit_kwargs():
+    from test_trainer import lm_loss
+    from neuronx_distributed_tpu.trainer import default_batch_spec
+
+    return dict(loss_fn=lm_loss, log_every=0,
+                batch_spec={"ids": default_batch_spec(),
+                            "labels": default_batch_spec()})
+
+
+def _step_data():
+    import jax
+    from test_trainer import _data
+
+    return lambda step: _data(jax.random.PRNGKey(100 + step))
+
+
+def test_fit_policy_skip_discards_update(config):
+    """An injected NaN at step 3 is skipped: the run completes, exactly one
+    skip event is recorded, and the params actually moved on from the
+    pre-anomaly state (training continued)."""
+    from neuronx_distributed_tpu.trainer import fit
+
+    m, o = _build(config)
+    install_plan({"faults": [
+        {"point": "fit/loss", "action": "nan", "match": {"step": 3}}]})
+    losses = []
+    res = fit(config, m, o, _step_data(), steps=6, **_fit_kwargs(),
+              policy=AnomalyPolicy(on_nan="skip"),
+              on_step=lambda s, mm: losses.append(s))
+    assert res.steps_run == 6
+    assert [e["action"] for e in res.policy_events] == ["skip"]
+    assert res.policy_events[0]["step"] == 3
+    # the skipped step fires no on_step callback; every other step does
+    assert losses == [0, 1, 2, 4, 5]
+    import numpy as np
+
+    assert np.isfinite(res.final_loss)
+
+
+def test_fit_policy_rollback_rewinds_and_completes(config, tmp_path):
+    """An injected NaN at step 4 rolls back to the newest checkpoint
+    (step_4, saved just before) and re-runs; the run completes with one
+    rollback event and the re-run steps recorded once each."""
+    from neuronx_distributed_tpu.trainer import fit
+
+    m, o = _build(config)
+    install_plan({"faults": [
+        {"point": "fit/loss", "action": "nan", "match": {"step": 4}}]})
+    seen = []
+    res = fit(config, m, o, _step_data(), steps=6, **_fit_kwargs(),
+              ckpt_dir=str(tmp_path / "ck"), ckpt_every=2, async_save=False,
+              policy=AnomalyPolicy(on_nan="rollback"),
+              on_step=lambda s, mm: seen.append(s))
+    assert [e["action"] for e in res.policy_events] == ["rollback"]
+    assert seen == [0, 1, 2, 3, 4, 5]  # step 4 re-ran clean after rollback
+    assert res.steps_run == 6
+
+
+def test_fit_policy_rollback_requires_rewindable_data(config, tmp_path):
+    from neuronx_distributed_tpu.trainer import fit
+
+    m, o = _build(config)
+    batches = [_step_data()(i) for i in range(4)]
+    with pytest.raises(ValueError, match="cannot be re-wound"):
+        fit(config, m, o, iter(batches), steps=4, **_fit_kwargs(),
+            ckpt_dir=str(tmp_path / "ck"),
+            policy=AnomalyPolicy(on_nan="rollback"))
+    with pytest.raises(ValueError, match="requires ckpt_dir"):
+        fit(config, m, o, _step_data(), steps=4, **_fit_kwargs(),
+            policy=AnomalyPolicy(on_nan="rollback"))
+
+
+def test_fit_iterator_resume_too_short_is_diagnosable(config, tmp_path):
+    """Resuming with an iterable shorter than start_step must raise a clear
+    error naming the recorded batches_consumed, not a bare StopIteration."""
+    from neuronx_distributed_tpu.trainer import fit
+
+    data = _step_data()
+    m, o = _build(config)
+    fit(config, m, o, data, steps=4, **_fit_kwargs(),
+        ckpt_dir=str(tmp_path / "ck"), async_save=False)
+    # final checkpoint records step=4 AND batches_consumed=4
+    meta = json.load(open(tmp_path / "ck" / "step_4" / "meta.json"))
+    assert meta["user_content"] == {"step": 4, "batches_consumed": 4}
+
+    m2, o2 = _build(config)
+    short = [data(i) for i in range(2)]  # 2 < start_step 4
+    with pytest.raises(ValueError, match="batches_consumed=4"):
+        fit(config, m2, o2, iter(short), steps=8, **_fit_kwargs(),
+            ckpt_dir=str(tmp_path / "ck"), resume=True)
+
+
+# -- crash consistency: the checkpoint kill-point matrix (chaos) ------------
+
+_MATRIX_WORKER = '''
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+sys.path.insert(0, sys.argv[2])
+from flax import linen as nn
+import neuronx_distributed_tpu as nxd
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear, ParallelEmbedding, RowParallelLinear)
+from neuronx_distributed_tpu.parallel.loss import parallel_cross_entropy
+from neuronx_distributed_tpu.trainer import (
+    default_batch_spec, fit, initialize_parallel_model,
+    initialize_parallel_optimizer)
+
+class TinyLM(nn.Module):
+    @nn.compact
+    def __call__(self, ids):
+        h = ParallelEmbedding(num_embeddings=64, features=32,
+                              dtype=jnp.float32)(ids)
+        h = ColumnParallelLinear(features=64, use_bias=False,
+                                 dtype=jnp.float32)(h)
+        h = nn.gelu(h)
+        h = RowParallelLinear(features=32, use_bias=False,
+                              dtype=jnp.float32)(h)
+        return ColumnParallelLinear(features=64, use_bias=False,
+                                    gather_output=False, dtype=jnp.float32)(h)
+
+def lm_loss(module, params, batch, rng):
+    logits = module.apply(params, batch["ids"])
+    return jnp.mean(parallel_cross_entropy(logits, batch["labels"]))
+
+def data(step):
+    ids = jax.random.randint(jax.random.PRNGKey(100 + step), (4, 8), 0, 64)
+    return {"ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+
+ckpt_dir, mode = sys.argv[1], sys.argv[3]
+nxd.initialize_model_parallel(tensor_parallel_size=1)
+config = nxd.training_config(tensor_parallel_size=1, learning_rate=5e-3)
+m = initialize_parallel_model(config, TinyLM, (jnp.zeros((1, 8), jnp.int32),))
+o = initialize_parallel_optimizer(config, m)
+kw = {}
+if mode == "policy":
+    from neuronx_distributed_tpu.resilience import AnomalyPolicy
+    kw["policy"] = AnomalyPolicy(on_nan="rollback", max_rollbacks=2)
+    kw["obs"] = os.path.join(os.path.dirname(ckpt_dir), "obs")
+res = fit(config, m, o, data, steps=8, loss_fn=lm_loss,
+          batch_spec={"ids": default_batch_spec(),
+                      "labels": default_batch_spec()},
+          ckpt_dir=ckpt_dir, ckpt_every=2, keep_ckpts=2, resume=True,
+          async_save=False, log_every=1, **kw)
+print("WORKER-DONE steps_run=%d start=%d" % (res.steps_run, res.start_step),
+      flush=True)
+'''
+
+# the five kill points of the acceptance matrix; mid_rotation fires on the
+# first rotation (saving step_6 rotates step_2 out under keep_ckpts=2)
+KILL_POINTS = [
+    ("ckpt/pre_shard_write", "step_4"),
+    ("ckpt/mid_shard_write", "step_4"),
+    ("ckpt/pre_done", "step_4"),
+    ("ckpt/pre_newest", "step_4"),
+    ("ckpt/mid_rotation", "step_6"),
+]
+
+
+def _run_worker(worker, ckpt_dir, tmp_path, label, env_extra=None,
+                mode="plain", timeout=420):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop(faults_mod.ENV_VAR, None)
+    env.update(env_extra or {})
+    out = tmp_path / f"{label}.log"
+    with open(out, "w") as f:
+        proc = subprocess.run(
+            [sys.executable, str(worker), str(ckpt_dir), REPO, mode],
+            stdout=f, stderr=subprocess.STDOUT, env=env, timeout=timeout)
+    return proc.returncode, out.read_text()
+
+
+def _step_losses(log_text):
+    """{step: printed loss} from the worker's log_every=1 JSON lines."""
+    out = {}
+    for line in log_text.splitlines():
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "step" in rec and "loss" in rec:
+                out[rec["step"]] = rec["loss"]
+    return out
+
+
+@pytest.mark.chaos
+def test_checkpoint_kill_point_matrix(tmp_path):
+    """Acceptance bar: for every kill point inside ``save_checkpoint``, a
+    hard ``os._exit`` mid-save leaves ``newest_tag`` resolving to a COMPLETE
+    checkpoint, and the resumed run's per-step losses are token-identical to
+    an uninterrupted run."""
+    from neuronx_distributed_tpu.trainer.checkpoint import newest_tag
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_MATRIX_WORKER)
+
+    rc, base_log = _run_worker(worker, tmp_path / "base_ck", tmp_path, "base")
+    assert rc == 0, base_log[-3000:]
+    baseline = _step_losses(base_log)
+    assert sorted(baseline) == list(range(8)), baseline
+
+    for point, tag in KILL_POINTS:
+        ckpt_dir = tmp_path / f"ck_{point.replace('/', '_')}"
+        plan = json.dumps({"faults": [
+            {"point": point, "action": "kill", "match": {"tag": tag}}]})
+        rc, log_a = _run_worker(
+            worker, ckpt_dir, tmp_path, f"kill_{point.replace('/', '_')}",
+            env_extra={faults_mod.ENV_VAR: plan})
+        assert rc == KILL_EXIT_CODE, (point, rc, log_a[-3000:])
+
+        # a fresh process must resolve newest to a COMPLETE checkpoint
+        found = newest_tag(str(ckpt_dir))
+        assert found is not None, (point, os.listdir(ckpt_dir))
+        tag_dir = ckpt_dir / found
+        assert (tag_dir / ".done").exists(), point
+        meta = json.loads((tag_dir / "meta.json").read_text())  # parses whole
+        assert meta["user_content"]["step"] == int(found.split("_")[1])
+
+        # ... and the resumed run is token-identical to the uninterrupted one
+        rc, log_b = _run_worker(
+            worker, ckpt_dir, tmp_path, f"resume_{point.replace('/', '_')}")
+        assert rc == 0, (point, log_b[-3000:])
+        assert "WORKER-DONE" in log_b
+        covered = _step_losses(log_a)
+        covered.update(_step_losses(log_b))
+        assert sorted(covered) == list(range(8)), (point, sorted(covered))
+        for step, loss in covered.items():
+            assert loss == baseline[step], (
+                f"{point}: step {step} loss {loss} != baseline "
+                f"{baseline[step]}")
+
+
+@pytest.mark.chaos
+def test_supervisor_demo_survives_injected_crashes(tmp_path):
+    """Acceptance bar: the supervised run survives one injected hard
+    exception (process death → supervisor restart → resume from the newest
+    tag) and one injected NaN (in-process policy rollback) with no manual
+    intervention — all visible in supervisor_events.jsonl and the obs
+    report."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_MATRIX_WORKER)
+    ckpt_dir = tmp_path / "ck"
+    obs_dir = tmp_path / "obs"
+    events_path = str(obs_dir / "supervisor_events.jsonl")
+    os.makedirs(obs_dir, exist_ok=True)
+
+    plan = json.dumps({"faults": [
+        # fresh process only (start_step 0): dies hard at step 3, after the
+        # step_2 cadence save — the supervisor must restart and resume
+        {"point": "fit/step_start", "action": "exception",
+         "match": {"step": 3, "start_step": 0}},
+        # the restarted process hits a NaN at step 5 — the policy must roll
+        # back to step_4 and retrain through it, no process death
+        {"point": "fit/loss", "action": "nan", "match": {"step": 5}},
+    ]})
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env[faults_mod.ENV_VAR] = plan
+
+    sup = Supervisor(
+        [sys.executable, str(worker), str(ckpt_dir), REPO, "policy"],
+        max_restarts=2, backoff_base_s=0.1, ckpt_dir=str(ckpt_dir),
+        events_path=events_path, log_path=str(tmp_path / "child.log"),
+        env=env)
+    res = sup.run()
+    log = (tmp_path / "child.log").read_text()
+    assert res.ok, log[-4000:]
+    assert res.restarts == 1 and res.causes == ["injected_fault"]
+    # the restarted attempt resumed from the pre-crash cadence checkpoint
+    starts = [e for e in sup.events if e["event"] == "start"]
+    assert starts[1]["resume_tag"] == "step_2"
+    assert "WORKER-DONE steps_run=6 start=2" in log
+
+    # obs report: restart + rollback both visible from artifacts alone
+    from neuronx_distributed_tpu.obs.report import build_report
+    from neuronx_distributed_tpu.obs.schemas import validate_record
+
+    report = build_report(run_dir=str(obs_dir))
+    validate_record("obs_report", report)
+    assert report["supervisor"]["restarts"] == 1
+    assert report["supervisor"]["crash_causes"] == ["injected_fault"]
+    assert report["supervisor"]["succeeded"]
+    assert report["health"]["restarts"] == 1
+    assert report["scalars"]["resilience/rollbacks_total"]["last"] == 1.0
+    # the NaN anomaly itself is in the flight warnings
+    assert any(w["detector"] == "nan_loss" for w in report["anomalies"])

@@ -10,7 +10,11 @@ Two layers of assurance, mirroring the subsystem's split:
   to a solo ``ParallelInferenceModel.generate`` of each prompt (per-slot
   offsets and slot-insert prefill introduce zero numerical drift), plus
   per-request rng-stream reproducibility, serving_stats schema validation,
-  and the bounded compiled-fn caches.
+  and the bounded compiled-fn caches;
+- hardening (resilience PR): non-finite-logit slot quarantine (the one
+  poisoned request FAILs, its co-batch stays token-identical to solo
+  generate, the slot is reusable), bounded-admission backpressure, the
+  engine step watchdog, and the crash flight dump of ``replay_trace``.
 """
 
 import json
@@ -23,13 +27,16 @@ import pytest
 from conftest import sharded_params
 from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from neuronx_distributed_tpu.parallel.mesh import initialize_model_parallel
+from neuronx_distributed_tpu.resilience import clear_plan, install_plan
 from neuronx_distributed_tpu.serving import (
     AdmissionError,
+    BackpressureError,
     Request,
     RequestState,
     SamplingParams,
     ServingEngine,
     SlotScheduler,
+    replay_trace,
 )
 from neuronx_distributed_tpu.trace import InferenceConfig, ParallelInferenceModel
 from neuronx_distributed_tpu.trace.engine import _CompiledLRU
@@ -361,6 +368,164 @@ def test_serve_bench_continuous_tiny_cli(tmp_path):
     assert rec["goodput_tok_s"] > 0 and rec["static_tok_s"] > 0
     assert rec["ttft_ms"]["p50"] is not None
     assert validate_jsonl("serving_stats", stats) == 4
+
+
+# -- hardening (resilience PR) ----------------------------------------------
+
+def test_failed_state_lifecycle():
+    """FAILED is terminal and reachable only from the compute states."""
+    req = _req(0)
+    with pytest.raises(RuntimeError, match="illegal transition"):
+        req.transition(RequestState.FAILED)  # QUEUED ran nothing to fail
+    req.transition(RequestState.PREFILL)
+    req.transition(RequestState.FAILED)
+    assert req.done
+    with pytest.raises(RuntimeError, match="illegal transition"):
+        req.transition(RequestState.DECODE)
+
+
+def test_scheduler_backpressure_bounds_excess_backlog():
+    """max_queue bounds the backlog BEYOND free slots: a burst of
+    free_count + max_queue always fits, one more is rejected (transient),
+    and draining re-opens admission."""
+    sched = SlotScheduler(num_slots=2, context_len=8, max_total_len=16,
+                          max_queue=1)
+    for i in range(3):  # 2 free slots + 1 excess
+        sched.submit(_req(i), now=0.0)
+    with pytest.raises(BackpressureError, match="backlog full"):
+        sched.submit(_req(3), now=0.0)
+    # a never-fits request stays a PERMANENT AdmissionError even under load
+    with pytest.raises(AdmissionError, match="prompt_len"):
+        sched.submit(_req(99, plen=9), now=0.0)
+    grants = sched.admit(now=0.0)  # 2 admitted, queue drops to 1 == max
+    with pytest.raises(BackpressureError):
+        sched.submit(_req(3), now=0.0)
+    _finish(sched, grants[0][1])  # a freed slot re-opens admission
+    sched.submit(_req(3), now=1.0)
+    sched.assert_invariants()
+
+
+def test_engine_backpressure_counts_rejections(served_pool):
+    cfg, pool, _ = served_pool
+    engine = ServingEngine(pool, max_queue=1)
+    for rid in range(4):  # B=3 slots + 1 backlog
+        engine.submit(Request(request_id=rid, prompt_ids=[1, 2],
+                              max_new_tokens=2))
+    with pytest.raises(BackpressureError):
+        engine.submit(Request(request_id=9, prompt_ids=[1], max_new_tokens=2))
+    assert engine.registry.snapshot()["serving/rejected_total"] == 1.0
+    outs = engine.run_until_complete(max_steps=200)
+    assert len(outs) == 4  # the admitted ones all finish
+
+
+def test_non_finite_logit_quarantine_decode(served_pool):
+    """A slot whose decode logits go non-finite fails THAT request alone:
+    terminal state ``failed``, co-batched requests token-identical to their
+    solo generates, slot freed and reusable."""
+    cfg, pool, solo = served_pool
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(1, cfg.vocab_size, size=5).tolist()
+               for _ in range(3)]
+    engine = ServingEngine(pool)
+    for rid in range(3):
+        engine.submit(Request(request_id=rid, prompt_ids=prompts[rid],
+                              max_new_tokens=6))
+    engine.step()  # prefill all three; find request 1's slot
+    slot_of = {req.request_id: slot for slot, req in engine.scheduler.active()}
+    install_plan({"faults": [{"point": "serving/decode_logits",
+                              "action": "nan", "slot": slot_of[1]}]})
+    try:
+        outs = {o.request_id: o
+                for o in engine.run_until_complete(max_steps=200)}
+    finally:
+        clear_plan()
+    assert outs[1].state == "failed"
+    assert outs[1].finish_reason == "non_finite_logits"
+    for rid in (0, 2):  # co-batch never saw the poison
+        assert outs[rid].state == "finished"
+        assert list(outs[rid].token_ids) == _solo_generate(
+            solo, prompts[rid], 6)
+    assert engine.registry.snapshot()["serving/failed_total"] == 1.0
+    # the quarantined slot is reusable
+    engine.submit(Request(request_id=7, prompt_ids=prompts[0],
+                          max_new_tokens=3))
+    [out7] = engine.run_until_complete(max_steps=100)
+    assert out7.state == "finished"
+    assert list(out7.token_ids) == _solo_generate(solo, prompts[0], 3)
+    engine.scheduler.assert_invariants()
+
+
+def test_non_finite_logit_quarantine_prefill(served_pool, tmp_path):
+    """Non-finite PREFILL logits fail the request before it ever decodes
+    (no tokens, null ttft) — and the stats record passes the schema."""
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    cfg, pool, _ = served_pool
+    stats = str(tmp_path / "serving_stats.jsonl")
+    engine = ServingEngine(pool, stats_path=stats)
+    install_plan({"faults": [{"point": "serving/prefill_logits",
+                              "action": "nan", "match": {"request_id": 0}}]})
+    try:
+        engine.submit(Request(request_id=0, prompt_ids=[1, 2, 3],
+                              max_new_tokens=4))
+        engine.submit(Request(request_id=1, prompt_ids=[1, 2, 3],
+                              max_new_tokens=2))
+        outs = {o.request_id: o
+                for o in engine.run_until_complete(max_steps=100)}
+    finally:
+        clear_plan()
+    engine.close()
+    assert outs[0].state == "failed" and outs[0].token_ids == ()
+    assert outs[0].ttft_ms is None
+    assert outs[1].state == "finished"
+    assert validate_jsonl("serving_stats", stats) == 2
+
+
+def test_engine_step_watchdog_counts_slow_steps(served_pool):
+    """A step slower than step_timeout_s increments the slow-step counter
+    (fake clock: each clock() call advances well past the threshold)."""
+    cfg, pool, _ = served_pool
+    t = [0.0]
+
+    def clock():
+        t[0] += 10.0
+        return t[0]
+
+    engine = ServingEngine(pool, clock=clock, step_timeout_s=1.0)
+    engine.submit(Request(request_id=0, prompt_ids=[1, 2], max_new_tokens=2))
+    engine.run_until_complete(max_steps=50)
+    snap = engine.registry.snapshot()
+    assert snap["serving/slow_steps_total"] >= 1.0
+    assert snap["serving/last_step_ms"] > 0.0
+    assert snap["serving/step_ms"]["count"] >= 1
+
+
+def test_replay_trace_dumps_flight_on_crash(served_pool, tmp_path):
+    """An unhandled exception out of the drive loop persists the engine
+    flight record (the serving twin of fit()'s crash path) and re-raises."""
+    from neuronx_distributed_tpu.obs import Observability
+    from neuronx_distributed_tpu.obs.schemas import validate_flight_document
+
+    cfg, pool, _ = served_pool
+    obs = Observability(str(tmp_path / "obs"))
+    engine = ServingEngine(pool, obs=obs)
+
+    reqs = [
+        Request(request_id=0, prompt_ids=[1, 2], max_new_tokens=3),
+        Request(request_id=1, prompt_ids=[1, 2], max_new_tokens=3,
+                stream_cb=lambda r, t: (_ for _ in ()).throw(
+                    RuntimeError("poisoned stream_cb"))),
+    ]
+    with pytest.raises(RuntimeError, match="poisoned stream_cb"):
+        replay_trace(engine, [0.0, 0.0], reqs)
+    doc = json.load(open(obs.flight_path))
+    validate_flight_document(doc)
+    assert doc["reason"] == "crash:RuntimeError"
+    # engine steps record into the flight ring (queue/slots/step time)
+    engine2 = ServingEngine(pool, obs=obs)
+    engine2.submit(Request(request_id=5, prompt_ids=[1], max_new_tokens=2))
+    engine2.run_until_complete(max_steps=50)
+    assert any("queue_depth" in r for r in obs.flight.records)
 
 
 def test_loop_caches_are_bounded(served_pool):

@@ -39,6 +39,10 @@ def main(argv=None) -> int:
     p.add_argument("--hlo-audit", default=None, help="hlo_audit.jsonl path")
     p.add_argument("--timeline", action="append", default=[],
                    help="Chrome-trace timeline file (repeatable)")
+    p.add_argument("--supervisor-events", default=None,
+                   help="supervisor_events.jsonl path (restarts / crash "
+                        "causes / time-to-recover; auto-detected in "
+                        "--run-dir)")
     p.add_argument("--tail", type=int, default=10,
                    help="flight-record tail length in the summary")
     p.add_argument("--out", default=None, help="write JSON here (default stdout)")
@@ -46,7 +50,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if not (args.run_dir or args.scalar_dir or args.scalars or args.flight
-            or args.hlo_audit or args.timeline):
+            or args.hlo_audit or args.timeline or args.supervisor_events):
         p.error("nothing to report on: pass --run-dir or explicit artifact paths")
 
     from neuronx_distributed_tpu.obs.report import build_report, render_markdown
@@ -66,6 +70,7 @@ def main(argv=None) -> int:
         flight_path=args.flight,
         hlo_audit_path=args.hlo_audit,
         timeline_paths=args.timeline,
+        supervisor_events_path=args.supervisor_events,
         tail=args.tail,
     )
     validate_record("obs_report", report)  # the emitter honors its own schema

@@ -1,0 +1,34 @@
+"""train_supervisor — supervised auto-resume for a training command.
+
+Runs the command after ``--`` in a subprocess; on a nonzero exit the child is
+restarted with exponential backoff until the crash budget (``--max-restarts``)
+is spent.  Each attempt records the checkpoint tag it resumed from (the entry
+itself must pass ``--resume`` / ``resume=True`` to ``fit()``), every lifecycle
+event lands in a schema-checked ``supervisor_events.jsonl``, and crash causes
+are classified from the child log tail.  ``tools/obs_report.py`` merges the
+events into the run summary (restarts, causes, time-to-recover).
+
+Usage:
+    python tools/train_supervisor.py \\
+        --ckpt-dir /ckpts/run1 --events /runs/r1/obs/supervisor_events.jsonl \\
+        --log /runs/r1/child.log --max-restarts 3 --backoff-base 1.0 \\
+        -- python examples/training/llama_pretrain.py --preset llama2_7b \\
+           --ckpt-dir /ckpts/run1 --ckpt-every 500 --resume
+
+Exit status: 0 when the child eventually exits clean, 1 when the crash
+budget is exhausted (the final JSON line has the full accounting).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable as `python tools/train_supervisor.py`
+    sys.path.insert(0, REPO)
+
+from neuronx_distributed_tpu.resilience.supervisor import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
