@@ -1,6 +1,8 @@
 """Data pipeline: native (C++) memory-mapped token-dataset loader with
 deterministic DP sharding and background prefetch; numpy fallback with
-identical semantics."""
+identical semantics.  :class:`DevicePrefetcher` extends the overlap onto the
+accelerator: batches are ``device_put`` against the step's shardings ahead
+of the step that consumes them (``fit(prefetch=N)``)."""
 
 from neuronx_distributed_tpu.data.loader import (
     TokenDataLoader,
@@ -8,8 +10,10 @@ from neuronx_distributed_tpu.data.loader import (
     read_token_file,
     write_token_file,
 )
+from neuronx_distributed_tpu.data.prefetch import DevicePrefetcher
 
 __all__ = [
+    "DevicePrefetcher",
     "TokenDataLoader",
     "TokenDataset",
     "read_token_file",

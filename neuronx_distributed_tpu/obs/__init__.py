@@ -55,7 +55,14 @@ from neuronx_distributed_tpu.obs.registry import (
     Histogram,
     MetricRegistry,
 )
-from neuronx_distributed_tpu.obs.schemas import SCHEMAS, validate_jsonl, validate_record
+from neuronx_distributed_tpu.obs.schemas import (
+    REGISTRY_METRICS,
+    SCHEMAS,
+    validate_jsonl,
+    validate_record,
+    validate_registry_metrics,
+)
+from neuronx_distributed_tpu.obs.transfer_audit import TransferAudit
 from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -197,8 +204,11 @@ __all__ = [
     "append_audit",
     "read_audits",
     "SCHEMAS",
+    "REGISTRY_METRICS",
     "validate_record",
     "validate_jsonl",
+    "validate_registry_metrics",
+    "TransferAudit",
     "SCALARS_FILE",
     "FLIGHT_FILE",
     "HLO_AUDIT_FILE",

@@ -133,6 +133,31 @@ def _summarize_supervisor(path: str) -> dict:
     }
 
 
+def _summarize_host_blocked(histograms: Dict[str, dict]) -> Dict[str, dict]:
+    """The async-hot-path overlap story, per subsystem: how much wall time
+    the host spent blocked on explicit device fetches
+    (``<sys>/host_blocked_ms``, written by the transfer audit) against the
+    subsystem's step time — ``frac`` near 0 means the deferred/pipelined
+    path is overlapping as designed, near 1 means every step drains the
+    device."""
+    out: Dict[str, dict] = {}
+    for sys_name, step_hist in (("train", "train/step_time_ms"),
+                                ("serving", "serving/step_ms")):
+        hb = histograms.get(f"{sys_name}/host_blocked_ms")
+        if not hb or not hb.get("count"):
+            continue
+        entry = {
+            "blocked_ms_total": round(hb["sum"], 3),
+            "blocked_ms_mean": round(hb["mean"], 3),
+            "fetches": hb["count"],
+        }
+        steps = histograms.get(step_hist)
+        if steps and steps.get("sum"):
+            entry["frac"] = round(min(hb["sum"] / steps["sum"], 1.0), 4)
+        out[sys_name] = entry
+    return out
+
+
 def _summarize_timeline(paths: Sequence[str]) -> dict:
     events = instants = 0
     dur_by_name: Dict[str, float] = {}
@@ -219,6 +244,7 @@ def build_report(
 
     anomalies = list(flight["warnings"]) if flight else []
     histograms = read_histograms(scalar_records)
+    host_blocked = _summarize_host_blocked(histograms)
     report = {
         "schema": OBS_REPORT_SCHEMA,
         "generated_at": time.time(),
@@ -239,6 +265,7 @@ def build_report(
         "supervisor": supervisor,
         "health": {
             "anomaly_count": len(anomalies),
+            "host_blocked": host_blocked,
             "total_collective_count": sum(
                 a.get("total_collective_count", 0) for a in audits),
             "total_collective_bytes": sum(
@@ -258,6 +285,11 @@ def render_markdown(report: dict) -> str:
     lines.append(f"- collectives across audited programs: "
                  f"{h['total_collective_count']} ops, "
                  f"{h['total_collective_bytes']:,} bytes")
+    for sys_name, hb in sorted(h.get("host_blocked", {}).items()):
+        frac = f", {hb['frac']:.1%} of step time" if "frac" in hb else ""
+        lines.append(
+            f"- {sys_name} host-blocked: {hb['blocked_ms_total']:.1f} ms "
+            f"across {hb['fetches']:.0f} fetches{frac}")
     lines.append("")
 
     sup = report.get("supervisor")

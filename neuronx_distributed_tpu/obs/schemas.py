@@ -64,6 +64,49 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
 }
 
 
+# Registry-metric contract: the async-hot-path metrics that flow into
+# scalars.jsonl through MetricRegistry.to_scalar_records (histograms
+# flatten to `name/count`, `name/sum` and cumulative `name/le_*` tags — all
+# validating as `scalars` records).  Name -> kind; a registered metric of
+# the wrong kind is an emitter bug (it would misfile the flattened tags),
+# which validate_registry_metrics catches.  Extra, undeclared metrics are
+# always allowed — this is a floor, like the record schemas above.
+REGISTRY_METRICS: Dict[str, str] = {
+    # data/prefetch.DevicePrefetcher — the staged input pipeline
+    "data/prefetch_queue_depth": "gauge",
+    "data/prefetch_staged_ahead": "gauge",
+    "data/prefetch_rewinds_total": "counter",
+    "data/prefetch_batches_staged_total": "counter",
+    "data/prefetch_wait_ms": "histogram",
+    # obs/transfer_audit.TransferAudit — explicit-crossing accounting
+    "transfer/explicit_fetches_total": "counter",
+    "transfer/explicit_puts_total": "counter",
+    "transfer/fetch_wait_ms": "histogram",
+    "transfer/guarded_sections_total": "counter",
+    # host-blocked wall time per subsystem (fit deferred fetch / serving
+    # packed decode fetch)
+    "train/host_blocked_ms": "histogram",
+    "serving/host_blocked_ms": "histogram",
+}
+
+
+def validate_registry_metrics(registry: Any) -> None:
+    """Check every :data:`REGISTRY_METRICS` name that IS registered in
+    ``registry`` against its declared kind (names may be absent — a run
+    without serving has no serving metrics).  Raises ``ValueError`` on a
+    kind mismatch."""
+    metrics = {m.name: m for m in registry.metrics()}
+    for name, kind in REGISTRY_METRICS.items():
+        m = metrics.get(name)
+        if m is None:
+            continue
+        have = type(m).__name__.lower()
+        if have != kind:
+            raise ValueError(
+                f"registry metric {name!r} is a {have}, schema declares "
+                f"{kind!r} — its scalars.jsonl tags would misfile")
+
+
 def validate_record(kind: str, record: dict, where: str = "") -> None:
     """Raise ValueError when ``record`` violates the ``kind`` schema."""
     schema = SCHEMAS.get(kind)

@@ -24,6 +24,25 @@ Telemetry goes through the PR-1 ``obs.MetricRegistry`` (queue-depth /
 slot-occupancy gauges, TTFT and inter-token histograms, admission /
 finish / cancel counters) and per-request ``serving_stats.jsonl`` records
 validated by ``obs.schemas``.
+
+**The decode hot path is asynchronous** (``async_decode=True``, the
+default): ``step()`` dispatches decode step N+1 *before* running step N's
+deferred host work (stream callbacks, inter-token telemetry, stats
+serialization), and the whole per-step device→host traffic — sampled
+tokens and per-slot finite flags — is packed into ONE ``[2, B]`` array
+fetched with a single explicit ``device_get`` per step (counted by the
+:class:`~..obs.transfer_audit.TransferAudit`; host wait exported as
+``serving/host_blocked_ms``).  The host→device direction is symmetric: the
+next-token feed, per-slot write offsets and token indices stage as one
+packed explicit ``device_put``, and the per-slot sampling state (keys /
+temperature / top-k / top-p) lives in device mirrors refreshed only when
+admission changes them.  Stop *detection* stays pre-dispatch — it is a few
+integer compares and the next step's active set depends on it — so the
+pipeline never decodes speculatively for a finished slot and async outputs
+remain token-identical to the synchronous engine (parity-tested).  The one
+observable shift: a token's stream callback fires after the next step's
+dispatch, and the final token's callback sees its request already in a
+terminal state.
 """
 
 from __future__ import annotations
@@ -37,6 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_tpu.obs import MS_BUCKETS, MetricRegistry
+from neuronx_distributed_tpu.obs.transfer_audit import TransferAudit
 from neuronx_distributed_tpu.resilience.faults import perturb
 from neuronx_distributed_tpu.serving.request import (
     Request,
@@ -78,6 +98,16 @@ def _sample_rows(logits, base_keys, tok_idx, temperature, top_k, top_p):
         return tok, jnp.all(jnp.isfinite(lg.astype(jnp.float32)))
 
     return jax.vmap(row)(logits, base_keys, tok_idx, temperature, top_k, top_p)
+
+
+@jax.jit
+def _pack_tokens(toks, finite):
+    """Pack the decode step's whole device→host payload into one ``[2, B]``
+    int32 array so the engine pays exactly ONE host fetch per step.  A
+    separate tiny jit (not fused into :func:`_sample_rows`) so the sampler
+    program stays bit-identical to the synchronous engine's — parity by
+    construction, not by hoping XLA fuses the same way."""
+    return jnp.stack([toks.astype(jnp.int32), finite.astype(jnp.int32)])
 
 
 def replay_trace(engine: "ServingEngine", arrivals, requests,
@@ -160,6 +190,18 @@ class ServingEngine:
       entry per engine step (queue depth, active slots, tokens, step time);
       ``replay_trace`` dumps it on an unhandled exception, and the engine's
       metrics then ride the hub's registry unless one was passed explicitly.
+
+    Async hot path (perf PR):
+
+    - ``async_decode`` (default True) pipelines the decode loop: step N+1
+      is dispatched before step N's stream callbacks / stats run, and all
+      per-step host↔device traffic packs into one explicit fetch + one
+      explicit put (see the module docstring).  ``False`` restores the
+      fully synchronous per-step engine (the parity reference);
+    - ``transfer_guard="forbid"`` wraps the steady decode section in
+      ``jax.transfer_guard("disallow")``: an implicit transfer in the hot
+      path raises instead of silently draining the device.  Fetch/put
+      counts and ``serving/host_blocked_ms`` export in every mode.
     """
 
     def __init__(
@@ -174,6 +216,8 @@ class ServingEngine:
         max_queue: Optional[int] = None,
         step_timeout_s: Optional[float] = None,
         obs: Any = None,
+        async_decode: bool = True,
+        transfer_guard: str = "off",
     ):
         for attr in ("prefill_one", "insert_slot", "decode_slots"):
             if not hasattr(model, attr):
@@ -195,6 +239,23 @@ class ServingEngine:
         self.registry = registry if registry is not None else MetricRegistry()
         self.step_timeout_s = step_timeout_s
         self._steps = 0
+        if transfer_guard not in ("off", "forbid"):
+            raise ValueError(
+                f"transfer_guard must be 'off' or 'forbid', "
+                f"got {transfer_guard!r}")
+        self.async_decode = async_decode
+        self._audit = TransferAudit(
+            self.registry,
+            mode="forbid" if transfer_guard == "forbid" else "observe")
+        # in-flight decode: (packed [2,B] device array, active snapshot)
+        self._pending: "Optional[tuple]" = None
+        # device mirrors of the per-slot sampling state, refreshed (one
+        # explicit put each) only when admission changes the host copies
+        self._sampling_dirty = True
+        self._keys_dev = None
+        self._temps_dev = None
+        self._topks_dev = None
+        self._topps_dev = None
         # compiled-cache evictions (trace._CompiledLRU) surface here too.
         # The caches live on the MODEL, which may outlive this engine or be
         # shared by several — attach only when nothing is attached yet, so
@@ -229,6 +290,7 @@ class ServingEngine:
         reg.histogram("serving/ttft_ms", MS_BUCKETS)
         reg.histogram("serving/intertoken_ms", MS_BUCKETS)
         reg.histogram("serving/step_ms", MS_BUCKETS)
+        reg.histogram("serving/host_blocked_ms", MS_BUCKETS)
         reg.gauge("serving/last_step_ms")
         for c in ("admitted", "finished", "cancelled", "timed_out", "tokens",
                   "rejected", "failed", "slow_steps"):
@@ -257,7 +319,11 @@ class ServingEngine:
 
     @property
     def has_work(self) -> bool:
-        return self.scheduler.queue_depth > 0 or self.scheduler.active_count > 0
+        # an in-flight async decode is work: its results still need one
+        # more step() to be collected and emitted
+        return (self.scheduler.queue_depth > 0
+                or self.scheduler.active_count > 0
+                or self._pending is not None)
 
     # -- engine loop -------------------------------------------------------
 
@@ -281,15 +347,32 @@ class ServingEngine:
                     else "serving/timed_out_total").inc()
                 outputs.append(self._emit(req, now))
 
-        # 2) admission: slot-insert prefill per granted request
+        # 2) admission: slot-insert prefill per granted request (its device
+        # work queues behind the in-flight decode, keeping the device busy
+        # while the host prepares the batch)
         for slot, req in self.scheduler.admit(now):
             self._prefill_into_slot(slot, req, outputs)
 
-        # 3) one batched decode step over every decoding slot
-        active = [(slot, req) for slot, req in self.scheduler.active()
-                  if req.state is RequestState.DECODE]
-        if active:
-            self._decode_step(active, outputs)
+        # 3) decode
+        if self.async_decode:
+            # pipelined: collect the in-flight step's packed results (one
+            # explicit fetch + cheap stop detection), dispatch the next
+            # decode, THEN run the collected step's host-side work (stream
+            # callbacks, telemetry, stats) while the device computes
+            with self._audit.section("serving/decode"):
+                post = self._collect_decode()
+                active = [(slot, req) for slot, req in self.scheduler.active()
+                          if req.state is RequestState.DECODE]
+                if active:
+                    self._dispatch_decode(active)
+            self._finish_decode(post, outputs)
+        else:
+            # synchronous reference engine: one fully-processed decode per
+            # step (the async path is parity-tested against this)
+            active = [(slot, req) for slot, req in self.scheduler.active()
+                      if req.state is RequestState.DECODE]
+            if active:
+                self._decode_step(active, outputs)
 
         self.registry.gauge("serving/queue_depth").set(self.scheduler.queue_depth)
         self.registry.gauge("serving/slots_active").set(self.scheduler.active_count)
@@ -375,18 +458,23 @@ class ServingEngine:
         self._temps[slot] = s.temperature
         self._topks[slot] = s.top_k
         self._topps[slot] = s.top_p
+        self._sampling_dirty = True  # device mirrors refresh at next dispatch
         toks, finite = _sample_rows(
             logits, jnp.asarray(self._base_keys[slot])[None, :],
             jnp.zeros((1,), jnp.int32),
             jnp.full((1,), s.temperature, jnp.float32),
             jnp.full((1,), s.top_k, jnp.int32),
             jnp.full((1,), s.top_p, jnp.float32))
+        # admission is off the steady path, but its fetch is still ONE
+        # explicit packed read (first token + finite flag together)
+        first = self._audit.fetch(_pack_tokens(toks, finite),
+                                  label="serving")
         now = self._clock()
         self.registry.counter("serving/admitted_total").inc()
-        if not bool(finite[0]):
+        if not bool(first[1][0]):
             self._fail_slot(slot, req, outputs, now)
             return
-        tok = int(toks[0])
+        tok = int(first[0][0])
         req.transition(RequestState.DECODE)
         req.first_token_time = now
         if req.submit_time is not None:
@@ -440,12 +528,126 @@ class ServingEngine:
             else:
                 outputs.append(self._emit(req, now))
 
-    def _fail_slot(self, slot: int, req: Request, outputs: list,
-                   now: float) -> None:
-        """Quarantine one numerically poisoned request: terminal ``FAILED``
-        state, slot freed and parked (the next ``insert_slot`` overwrites the
-        poisoned KV rows; a parked row's logits are ignored meanwhile), the
-        rest of the batch untouched."""
+    def _collect_decode(self) -> list:
+        """Collect the in-flight decode step: ONE explicit packed fetch
+        (tokens + finite flags), then the *cheap* pre-dispatch bookkeeping —
+        offset advance, non-finite quarantine, stop detection, slot release
+        — so the next dispatch sees the true active set and never decodes
+        speculatively for a finished slot.  Returns the deferred host work
+        as ``(kind, slot, req, tok, intertoken_ms, now)`` records for
+        :meth:`_finish_decode` to run AFTER the next dispatch."""
+        if self._pending is None:
+            return []
+        packed_dev, active = self._pending
+        self._pending = None
+        packed = self._audit.fetch(packed_dev, label="serving")  # [2, B]
+        toks, finite = packed[0], packed[1]
+        now = self._clock()
+        post: list = []
+        for slot, req in active:
+            if req.state is not RequestState.DECODE:
+                # swept (cancelled / timed out) while the step was in
+                # flight: the sweep already released and parked the slot —
+                # the speculative token is discarded, never streamed
+                continue
+            self._offsets[slot] += 1  # the step wrote req's previous token
+            if not finite[slot]:
+                self._fail_slot_state(slot, req, now)
+                post.append(("fail", slot, req, 0, None, now))
+                continue
+            tok = int(toks[slot])
+            last = self._last_tok_time[slot]
+            ms = (now - last) * 1e3 if last is not None else None
+            req.generated.append(tok)
+            self._last_tok_time[slot] = now
+            self.registry.counter("serving/tokens_total").inc()
+            reason = self._stop_reason(req, tok)
+            if reason is not None:
+                self._finish_request(slot, req, reason, now)
+            else:
+                self._next_tok[slot] = tok
+            post.append(("token", slot, req, tok, ms, now))
+        return post
+
+    def _dispatch_decode(self, active: list) -> None:
+        """Dispatch one per-slot-offset decode + row-wise sampling for the
+        current active set and leave the packed result in flight.  All
+        host→device traffic is explicit: the per-step-varying inputs
+        (next-token feed, write offsets, token indices) stage as ONE
+        explicit pytree put; the admission-time sampling state rides device
+        mirrors refreshed only when dirty.  Host arrays are copied before
+        staging — on backends where ``device_put`` aliases host memory, the
+        engine's in-place mutation of ``_next_tok``/``_offsets`` must never
+        reach into an in-flight computation."""
+        tok_idx = np.zeros((self.B,), np.int32)
+        for slot, req in active:
+            tok_idx[slot] = len(req.generated)
+        # eager slicing of a stacked [3, B] array would bind scalar start
+        # indices host-side (an implicit transfer the guard rejects), so the
+        # per-step inputs stage as one explicit pytree put instead
+        tok, offs, tidx = self._audit.put((
+            self._next_tok[:, None].copy(), self._offsets.copy(), tok_idx))
+        logits, self.caches, self.valid = self.model.decode_slots(
+            tok, offs, self.caches, self.valid)
+        logits = perturb("serving/decode_logits", logits,
+                         engine_step=self._steps)
+        if self._sampling_dirty:
+            self._keys_dev, self._temps_dev, self._topks_dev, \
+                self._topps_dev = self._audit.put(
+                    (self._base_keys.copy(), self._temps.copy(),
+                     self._topks.copy(), self._topps.copy()))
+            self._sampling_dirty = False
+        toks, finite = _sample_rows(
+            logits, self._keys_dev, tidx,
+            self._temps_dev, self._topks_dev, self._topps_dev)
+        self._pending = (_pack_tokens(toks, finite), list(active))
+
+    def _finish_decode(self, post: list, outputs: list) -> None:
+        """The collected step's deferred host work — stream callbacks,
+        inter-token telemetry, terminal emission (stats serialization) —
+        run while the next decode executes on the device."""
+        for kind, slot, req, tok, ms, now in post:
+            if kind == "fail":
+                logger.warning(
+                    "serving: request %d failed (%s) after %d tokens — "
+                    "slot %d quarantined and freed", req.request_id,
+                    FAIL_NON_FINITE, len(req.generated), slot)
+                outputs.append(self._emit(req, now))
+                continue
+            if ms is not None:
+                req.intertoken_ms.append(ms)
+                self.registry.histogram(
+                    "serving/intertoken_ms", MS_BUCKETS).observe(ms)
+            if req.stream_cb is not None:
+                req.stream_cb(req, tok)
+            if req.done:
+                outputs.append(self._emit(req, now))
+
+    def _stop_reason(self, req: Request, tok: int) -> Optional[str]:
+        """Finish reason for ``tok`` (already appended), engine-level EOS
+        included — the ONE stop predicate both engines share."""
+        reason = req.check_stop(tok)
+        if (reason is None and self.eos_token_id is not None
+                and tok == self.eos_token_id):
+            reason = "stop_token"  # engine-level EOS (tokenizer-wide)
+        return reason
+
+    def _finish_request(self, slot: int, req: Request, reason: str,
+                        now: float) -> None:
+        """Terminal FINISHED bookkeeping: state, slot release, park."""
+        req.transition(RequestState.FINISHED)
+        req.finish_reason = reason
+        req.finish_time = now
+        self.scheduler.release(req)
+        self._offsets[slot] = self.T  # park
+        self._last_tok_time[slot] = None
+        self.registry.counter("serving/finished_total").inc()
+
+    def _fail_slot_state(self, slot: int, req: Request, now: float) -> None:
+        """Quarantine bookkeeping for one numerically poisoned request:
+        terminal ``FAILED`` state, slot freed and parked (the next
+        ``insert_slot`` overwrites the poisoned KV rows; a parked row's
+        logits are ignored meanwhile), the rest of the batch untouched."""
         req.transition(RequestState.FAILED)
         req.finish_reason = FAIL_NON_FINITE
         req.finish_time = now
@@ -453,6 +655,12 @@ class ServingEngine:
         self._offsets[slot] = self.T  # park
         self._last_tok_time[slot] = None
         self.registry.counter("serving/failed_total").inc()
+
+    def _fail_slot(self, slot: int, req: Request, outputs: list,
+                   now: float) -> None:
+        """Synchronous quarantine: bookkeeping + log + emit in one go (the
+        prefill path and the synchronous engine)."""
+        self._fail_slot_state(slot, req, now)
         logger.warning(
             "serving: request %d failed (%s) after %d tokens — slot %d "
             "quarantined and freed", req.request_id, FAIL_NON_FINITE,
@@ -467,18 +675,9 @@ class ServingEngine:
         self.registry.counter("serving/tokens_total").inc()
         if req.stream_cb is not None:
             req.stream_cb(req, tok)
-        reason = req.check_stop(tok)
-        if (reason is None and self.eos_token_id is not None
-                and tok == self.eos_token_id):
-            reason = "stop_token"  # engine-level EOS (tokenizer-wide)
+        reason = self._stop_reason(req, tok)
         if reason is not None:
-            req.transition(RequestState.FINISHED)
-            req.finish_reason = reason
-            req.finish_time = now
-            self.scheduler.release(req)
-            self._offsets[slot] = self.T  # park
-            self._last_tok_time[slot] = None
-            self.registry.counter("serving/finished_total").inc()
+            self._finish_request(slot, req, reason, now)
 
     def _park_free_slots(self) -> None:
         """Reset the device-side state of every slot without a live occupant

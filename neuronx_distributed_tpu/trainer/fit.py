@@ -20,6 +20,16 @@ Design choices (TPU-native, not a PTL port):
 - **One jitted step.**  ``make_train_step``'s donated-buffer step is the
   whole hot path; the loop never touches device data except the metric
   scalars it prints.
+- **The hot path is asynchronous.**  ``prefetch=N`` stages batches onto the
+  device ahead of the step that consumes them
+  (:class:`~..data.prefetch.DevicePrefetcher`), and ``defer_metrics`` keeps
+  the step's loss/grad-norm as device futures, fetched with one explicit
+  packed ``device_get`` AFTER the next step is dispatched — the jit
+  analogue of torch-xla's ``MpDeviceLoader`` staging + lazy-dispatch
+  pipelining (SURVEY §L1): the device never idles waiting for the host.
+  ``transfer_guard="forbid"`` makes the no-implicit-transfer invariant
+  enforced (:mod:`~..obs.transfer_audit`), and the deferred loop is
+  parity-tested loss-identical to the synchronous one.
 - **LR/step state lives in the optimizer.**  Resume restores the optax
   count with the optimizer state, so schedules continue exactly (tested by
   the interrupted-vs-uninterrupted identity test).
@@ -136,6 +146,9 @@ def fit(
     callbacks: "tuple[Callback, ...] | list" = (),
     checkpoint_on_signal: bool = False,
     policy: "Any | None" = None,
+    prefetch: int = 0,
+    defer_metrics: "bool | str" = "auto",
+    transfer_guard: str = "off",
 ) -> FitResult:
     """Run the training loop: steps, eval cadence, checkpoint cadence with
     resume, scalar/throughput logging.
@@ -192,7 +205,51 @@ def fit(
         raise ``RetriesExhausted`` when exhausted; the optional step-latency
         watchdog warns or halts on stalled steps.  Actions taken are
         returned in ``FitResult.policy_events`` and counted in the obs
-        registry (``resilience/*_total``).
+        registry (``resilience/*_total``).  Policy actions force the
+        synchronous metrics path (see ``defer_metrics``) — exact
+        skip/rollback needs the step's loss on the host before the next
+        step is dispatched.
+      prefetch: staged-ahead depth for the device-prefetch input pipeline
+        (0 = off).  ``prefetch=N`` wraps the data source in a
+        :class:`~..data.prefetch.DevicePrefetcher`: a background thread
+        calls ``data(step)`` up to ``N`` steps ahead and
+        ``jax.device_put``'s each batch against the step's batch shardings,
+        so the jitted step never blocks on a host→device copy.
+        Step-indexed and rewindable: a policy rollback that rewinds the
+        step counter flushes and restages the pipeline at the rolled-back
+        step.  Requires ``batch_spec`` for non-pipelined models (the
+        staging target sharding).  The prefetcher is drained (thread
+        joined, staged batches dropped) on every exit path, including
+        early stop and signal checkpointing.
+      defer_metrics: ``"auto"`` (default) / ``True`` / ``False``.  When
+        deferred, ``m["loss"]``/``m["grad_norm"]`` stay device futures and
+        are fetched with ONE explicit packed ``device_get`` one step late —
+        step N's scalars are read after step N+1 is dispatched, so the
+        device never idles waiting for the host between steps (the
+        torch-xla ``MpDeviceLoader`` + lazy-dispatch overlap, SURVEY §L1,
+        in jit terms).  Per-step consumers (scalars, callbacks, obs flight
+        records) still see every step's host floats, in step order, one
+        dispatch behind.  ``"auto"`` defers only when the loop has no
+        consumer that needs same-step floats: no ``policy``, no armed
+        flight-recorder anomaly detectors, no ``timeline``, and no step
+        callbacks (a ``should_stop`` raised from a one-step-late hook
+        would stop one step later than the synchronous loop; pass
+        ``defer_metrics=True`` to accept that).  ``True`` with ``policy=``
+        raises.  The deferred loop is parity-tested loss-identical (exact
+        float equality on CPU) to the synchronous loop.  Eval-cadence
+        losses are routed through the same deferred fetch in BOTH modes,
+        so an eval never stalls the next train step's dispatch.
+      transfer_guard: ``"off"`` (default) / ``"forbid"``.  ``"forbid"``
+        wraps every steady-state step dispatch in
+        ``jax.transfer_guard("disallow")`` (via
+        :class:`~..obs.transfer_audit.TransferAudit`): an *implicit*
+        host↔device transfer inside the hot path raises instead of
+        silently draining the device — use with ``prefetch`` (host batches
+        would trip it) to make the no-sync invariant enforced, not
+        aspirational.  Cadence work (checkpoint saves, log prints) runs
+        outside the guard; metric fetches go through the audit's explicit
+        ``device_get`` and are counted
+        (``transfer/explicit_fetches_total``, ``train/host_blocked_ms``).
     """
     if checkpoint_on_signal:
         if not ckpt_dir:
@@ -277,6 +334,42 @@ def fit(
         def next_batch(step):
             return next(it)
 
+    from neuronx_distributed_tpu.obs.transfer_audit import TransferAudit
+
+    if transfer_guard not in ("off", "forbid"):
+        raise ValueError(
+            f"transfer_guard must be 'off' or 'forbid', got {transfer_guard!r}")
+    audit = TransferAudit(
+        obs_rt.registry if obs_rt is not None else None,
+        mode="forbid" if transfer_guard == "forbid" else "observe")
+
+    prefetcher = None
+    if prefetch:
+        from neuronx_distributed_tpu.data.prefetch import DevicePrefetcher
+        from neuronx_distributed_tpu.pipeline.engine import PipelinedModel
+        from neuronx_distributed_tpu.trainer.trainer import _batch_shardings
+
+        if batch_spec is not None:
+            stage_shardings = _batch_shardings(model.mesh, batch_spec)
+        elif isinstance(model, PipelinedModel):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from neuronx_distributed_tpu.parallel.mesh import BATCH_AXES
+
+            # every pipelined batch array is batch-dim-0 sharded; one
+            # sharding broadcasts over the batch tree
+            stage_shardings = NamedSharding(model.mesh, P(BATCH_AXES))
+        else:
+            raise ValueError(
+                "fit(prefetch=N) needs batch_spec: staged batches must be "
+                "device_put against the step's batch sharding (otherwise "
+                "they would land committed to one device and fight the "
+                "jitted step's placement)")
+        prefetcher = DevicePrefetcher(
+            next_batch, depth=prefetch, shardings=stage_shardings,
+            registry=obs_rt.registry if obs_rt is not None else None)
+        next_batch = prefetcher.get
+
     thr: Optional[Throughput] = None
     tokens_per_batch = None
     eval_history: list = []
@@ -291,6 +384,84 @@ def fit(
     for cb in cbs:
         cb.should_stop = False  # instances are reusable across fit() calls
         cb.on_fit_start(start_step, params, opt_state)
+
+    if defer_metrics not in ("auto", True, False):
+        raise ValueError(
+            f"defer_metrics must be 'auto', True or False, got {defer_metrics!r}")
+    if defer_metrics is True and policy is not None:
+        raise ValueError(
+            "defer_metrics=True is incompatible with policy=: skip/rollback "
+            "decisions need the step's loss on the host BEFORE the next "
+            "step is dispatched (the per-step sync IS the exactness "
+            "guarantee); drop the policy or use defer_metrics='auto'")
+    if defer_metrics is True and timeline is not None:
+        raise ValueError(
+            "defer_metrics=True is incompatible with timeline=: the "
+            "timeline's per-step device attribution is the in-event sync "
+            "the deferred mode removes; drop the timeline or use "
+            "defer_metrics='auto'")
+    if defer_metrics == "auto":
+        # defer only when nothing in the loop needs same-step host floats:
+        # a policy acts on them, flight detectors fire on them, a timeline
+        # times the sync, and a callback's should_stop would otherwise land
+        # one step late
+        deferred = (policy is None and timeline is None and not cbs
+                    and (obs_rt is None or not obs_rt.flight.detectors))
+    else:
+        deferred = bool(defer_metrics)
+
+    # one-step-delayed metric pipeline: at most one pending train step and
+    # one pending eval, each fetched with ONE explicit packed device_get
+    # AFTER the next step's dispatch (deferred mode) so the host wait
+    # overlaps device compute
+    pending: list = []       # [(step, m, timing dict)]
+    pending_eval: list = []  # [(eval_step, ev)]
+
+    def _flush_step_metrics() -> None:
+        nonlocal loss
+        if not pending:
+            return
+        pstep, pm, pt = pending.pop()
+        t_w = time.perf_counter()
+        fetched = audit.fetch((pm["loss"], pm["grad_norm"]), label="train")
+        wait_s = time.perf_counter() - t_w
+        ploss = perturb("fit/loss", float(fetched[0]), step=pstep)
+        pgrad = float(fetched[1])
+        loss = ploss
+        if obs_rt is not None:
+            # host_s = dispatch, device_s = the (overlapped) fetch wait; the
+            # two no longer tile one wall-clock step the way the sync loop's
+            # do — train/host_blocked_ms carries the overlap story
+            obs_rt.observe_step(
+                pstep, loss=ploss, grad_norm=pgrad, seq_per_sec=pt["seqs"],
+                step_time_s=pt["dispatch_s"] + wait_s, host_s=pt["dispatch_s"],
+                device_s=wait_s, data_wait_s=pt["data_wait_s"])
+        if scalars:
+            scalars.scalars(pstep, loss=ploss, grad_norm=pgrad,
+                            seq_per_sec=pt["seqs"])
+        step_metrics = dict(pm)
+        step_metrics.update(loss=ploss, grad_norm=pgrad, seq_per_sec=pt["seqs"])
+        for cb in cbs:
+            cb.on_step(pstep, step_metrics)
+        if log_every and (pstep % log_every == 0 or pstep == steps - 1):
+            if obs_rt is not None:
+                obs_rt.dump_scalars(pstep)
+            print(json.dumps({
+                "step": pstep, "loss": round(ploss, 4),
+                "seq_per_sec": round(pt["seqs"], 2),
+                "grad_norm": round(pgrad, 4),
+            }), flush=True)
+
+    def _flush_eval() -> None:
+        if not pending_eval:
+            return
+        estep, ev = pending_eval.pop()
+        eval_loss = float(audit.fetch(ev["loss"], label="train"))
+        eval_history.append((estep, eval_loss))
+        if scalars:
+            scalars.scalars(estep - 1, eval_loss=eval_loss)
+        for cb in cbs:
+            cb.on_eval(estep, {"eval_loss": eval_loss})
 
     prev_handlers = {}
     signal_seen: list = []
@@ -361,6 +532,9 @@ def fit(
                     logger.warning("obs: train-step HLO audit failed: %s", e)
             t0 = time.perf_counter()
             if timeline is not None:
+                # timeline implies the synchronous path (resolved above):
+                # the in-event float is what attributes device time to the
+                # step's trace slice
                 with timeline.event("train_step"):
                     params, opt_state, m = step_fn(params, opt_state, batch, rng)
                     t_dispatch = time.perf_counter()
@@ -368,15 +542,29 @@ def fit(
                 t_done = time.perf_counter()  # BEFORE the trace-file flush:
                 # step_time_s must compose identically with/without a timeline
                 timeline.mark_step_end(step)  # flushes the event buffer to disk
+                loss = perturb("fit/loss", loss, step=step)
+                seqs = thr.step()
+                grad_norm = float(m["grad_norm"])
             else:
-                params, opt_state, m = step_fn(params, opt_state, batch, rng)
+                with audit.section("fit/step"):
+                    params, opt_state, m = step_fn(params, opt_state, batch, rng)
                 t_dispatch = time.perf_counter()
-                loss = float(m["loss"])
-                t_done = time.perf_counter()
-            loss = perturb("fit/loss", loss, step=step)
-            seqs = thr.step()
-            grad_norm = float(m["grad_norm"])
-            if obs_rt is not None:
+                seqs = thr.step()
+                if deferred:
+                    # the pipelined fetch: publish step N-1's scalars now
+                    # that step N is in flight — the host blocks on a
+                    # device that is already doing useful work
+                    _flush_step_metrics()
+                    pending.append((step, m, {
+                        "seqs": seqs, "dispatch_s": t_dispatch - t0,
+                        "data_wait_s": data_wait_s}))
+                else:
+                    fetched = audit.fetch((m["loss"], m["grad_norm"]),
+                                          label="train")
+                    loss = perturb("fit/loss", float(fetched[0]), step=step)
+                    grad_norm = float(fetched[1])
+                    t_done = time.perf_counter()
+            if not deferred and obs_rt is not None:
                 obs_rt.observe_step(
                     step, loss=loss, grad_norm=grad_norm, seq_per_sec=seqs,
                     step_time_s=t_done - t0, host_s=t_dispatch - t0,
@@ -388,11 +576,16 @@ def fit(
                 if decision is not None and decision.action == "skip":
                     # discard the update: pre-step params/opt restored, the
                     # batch counts as consumed (scalars/eval/checkpoint/
-                    # callbacks do not fire for the discarded step)
+                    # callbacks do not fire for the discarded step).  A
+                    # pending eval from the PREVIOUS step's cadence is real
+                    # completed work — publish it before bailing out, as the
+                    # pre-deferral loop did at its cadence
+                    _flush_eval()
                     params, opt_state = snap
                     step += 1
                     continue
                 if decision is not None and decision.action == "rollback":
+                    _flush_eval()  # ditto: flush before the timeline rewinds
                     wait_for_checkpoint()
                     params, opt_state, _, user = load_checkpoint(
                         ckpt_dir, model_template=params,
@@ -413,33 +606,40 @@ def fit(
                     logger.warning("policy: rolled back to step %d (%s)",
                                    step, newest_tag(ckpt_dir))
                     continue
-            if scalars:
-                scalars.scalars(step, loss=loss, grad_norm=grad_norm,
-                                seq_per_sec=seqs)
-            step_metrics = dict(m)
-            step_metrics.update(loss=loss, grad_norm=grad_norm, seq_per_sec=seqs)
-            for cb in cbs:
-                cb.on_step(step, step_metrics)
-            if log_every and (step % log_every == 0 or step == steps - 1):
-                if obs_rt is not None:
-                    obs_rt.dump_scalars(step)
-                # stdout JSON lines — the launcher-harness contract the example
-                # scripts (and their tests) have always exposed
-                print(json.dumps({
-                    "step": step, "loss": round(loss, 4),
-                    "seq_per_sec": round(seqs, 2),
-                    "grad_norm": round(grad_norm, 4),
-                }), flush=True)
-            if eval_fn is not None and (step + 1) % eval_every == 0:
-                ev = eval_fn(params, eval_data(step))
-                eval_loss = float(ev["loss"])
-                eval_history.append((step + 1, eval_loss))
+            if not deferred:
                 if scalars:
-                    scalars.scalars(step, eval_loss=eval_loss)
+                    scalars.scalars(step, loss=loss, grad_norm=grad_norm,
+                                    seq_per_sec=seqs)
+                step_metrics = dict(m)
+                step_metrics.update(loss=loss, grad_norm=grad_norm,
+                                    seq_per_sec=seqs)
                 for cb in cbs:
-                    cb.on_eval(step + 1, {"eval_loss": eval_loss})
+                    cb.on_step(step, step_metrics)
+                if log_every and (step % log_every == 0 or step == steps - 1):
+                    if obs_rt is not None:
+                        obs_rt.dump_scalars(step)
+                    # stdout JSON lines — the launcher-harness contract the
+                    # example scripts (and their tests) have always exposed
+                    print(json.dumps({
+                        "step": step, "loss": round(loss, 4),
+                        "seq_per_sec": round(seqs, 2),
+                        "grad_norm": round(grad_norm, 4),
+                    }), flush=True)
+            _flush_eval()  # last cadence's eval: fetched one iteration late
+            if eval_fn is not None and (step + 1) % eval_every == 0:
+                # dispatch now, fetch on the NEXT iteration (or at loop
+                # exit): an eval cadence no longer stalls the next train
+                # step's dispatch behind a bare float() of its loss
+                pending_eval.append((step + 1, eval_fn(params, eval_data(step))))
             if ckpt_dir and ckpt_every and (step + 1) % ckpt_every == 0 \
                     and step + 1 < steps:
+                # a cadence save is already a device sync point (it reads
+                # the params), so the deferred pipeline flushes first: the
+                # step's scalars/log line become durable BEFORE the
+                # checkpoint that supersedes them — a crash mid-save can
+                # never lose a step that the resume won't re-run
+                _flush_step_metrics()
+                _flush_eval()
                 path = save_checkpoint(ckpt_dir, f"step_{step + 1}", params, opt_state,
                                        user_content={"step": step + 1,
                                                      "batches_consumed": step + 1},
@@ -453,6 +653,12 @@ def fit(
                 logger.info("callback requested stop after step %d", final_step)
                 break
             step += 1
+
+        # drain the metric pipeline: the last step's (and last eval's)
+        # deferred fetch lands before the final checkpoint and summary on
+        # every non-exception exit (loop end, early stop, signal)
+        _flush_step_metrics()
+        _flush_eval()
 
         ran_any = start_step < steps
         if not ran_any:
@@ -474,6 +680,16 @@ def fit(
             else:
                 wait_for_checkpoint()  # cadence save may be async: make it durable
     except BaseException as e:
+        # the step completed right before the crash may still sit in the
+        # deferred pipeline — land it in scalars/flight BEFORE the dump
+        # (pending was popped before any fetch, so a crash INSIDE the flush
+        # cannot recurse), but never let the flush mask the real exception
+        try:
+            _flush_step_metrics()
+            _flush_eval()
+        except Exception as flush_err:
+            logger.warning("deferred-metric flush failed during crash "
+                           "handling: %s", flush_err)
         if obs_rt is not None:
             # the crash dump is the flight recorder's whole purpose: persist
             # the last K steps before the exception unwinds the process — but
@@ -485,6 +701,11 @@ def fit(
                 logger.warning("obs: crash dump failed: %s", dump_err)
         raise
     finally:
+        if prefetcher is not None:
+            # every exit path drains the staging thread: no orphan worker
+            # after early stop / SIGTERM / crash, no stale staged batch
+            # surviving into a resumed run
+            prefetcher.close()
         # None = previous handler came from non-Python code and cannot be
         # re-installed from Python: SIG_DFL beats leaving OUR handler
         # appending to a list nothing reads anymore

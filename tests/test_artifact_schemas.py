@@ -158,6 +158,45 @@ def test_supervisor_events_validate_and_merge_into_report(tmp_path):
     assert report["health"]["restarts"] == 0
 
 
+def test_registry_metric_contract_for_async_hot_path(tmp_path):
+    """The prefetch / transfer-audit / host-blocked registry metrics are
+    declared in obs.schemas.REGISTRY_METRICS with their kinds, a live
+    emitter's registry validates against the declaration, its scalars.jsonl
+    dump stays schema-checked, and a kind mismatch is caught."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.data.prefetch import DevicePrefetcher
+    from neuronx_distributed_tpu.obs import TransferAudit
+    from neuronx_distributed_tpu.obs.schemas import (
+        REGISTRY_METRICS,
+        validate_registry_metrics,
+    )
+
+    assert {"data/prefetch_queue_depth", "data/prefetch_staged_ahead",
+            "data/prefetch_rewinds_total", "data/prefetch_wait_ms",
+            "train/host_blocked_ms", "serving/host_blocked_ms",
+            "transfer/explicit_fetches_total",
+            "transfer/fetch_wait_ms"} <= set(REGISTRY_METRICS)
+
+    reg = MetricRegistry()
+    audit = TransferAudit(reg)
+    with DevicePrefetcher(lambda s: np.full((2,), s, np.int32),
+                          depth=2, registry=reg) as pf:
+        staged = pf.get(0)
+    with audit.section("test"):
+        audit.fetch(staged, label="train")
+    validate_registry_metrics(reg)  # live kinds match the declaration
+
+    path = str(tmp_path / "scalars.jsonl")
+    reg.dump_jsonl(path, step=1)
+    assert validate_jsonl("scalars", path) > 8  # counters + histogram edges
+
+    bad = MetricRegistry()
+    bad.counter("train/host_blocked_ms")  # declared a histogram
+    with pytest.raises(ValueError, match="misfile"):
+        validate_registry_metrics(bad)
+
+
 def test_validate_record_rejects_bad_records():
     with pytest.raises(ValueError, match="missing required field"):
         validate_record("scalars", {"step": 1, "tag": "x", "time": 0.0})

@@ -5,7 +5,11 @@ Reads whatever exists of: an obs run dir (``scalars.jsonl`` registry dumps,
 streams (e.g. the trainer's ``--scalar-dir``), and extra timeline files —
 and emits a single JSON summary (stdout or ``--out``) plus an optional
 markdown rendering.  The "why was step N slow / why did the run die / how
-many bytes did this program move" questions answered from artifacts alone.
+many bytes did this program move / how much of each step was the host
+blocked on the device" questions answered from artifacts alone — the async
+hot path's ``train/host_blocked_ms`` / ``serving/host_blocked_ms`` and
+``data/prefetch_*`` metrics surface in the histograms section, and
+``health.host_blocked`` derives the per-subsystem blocked fraction.
 
 Usage:
     python tools/obs_report.py --run-dir /runs/r1/obs
