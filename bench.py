@@ -77,7 +77,6 @@ LADDER = [
 # seconds, so the long budget only ever bites on the first cold program.
 ATTEMPT_TIMEOUT_S = 2400
 PROBE_TIMEOUT_S = 420
-RETRY_SLEEP_S = 20
 # After two full-budget timeouts (cold compiles eating the window), do NOT
 # go straight to the CPU fallback: the watcher may have warmed OTHER rungs'
 # cache entries in an earlier window — replay exactly these two at a warm-
@@ -348,25 +347,31 @@ def _run_child(extra_args, timeout_s, env=None):
         return None
 
 
+def probe_tpu() -> "tuple[bool, str]":
+    """ONE bounded TPU-backend probe; returns ``(ok, err)``.  The r05 tail
+    showed the "tpu probe: timed out after 420s" line repeating — each
+    repeat burned PROBE_TIMEOUT_S re-learning the same dead tunnel.  The
+    ladder now probes exactly once per run and every consumer (rung gating,
+    recovery) reads the cached ``tpu_ok``/``last_err`` result instead of
+    re-probing."""
+    proc = _run_child(["--probe", "--platform=tpu"], PROBE_TIMEOUT_S)
+    ok = proc is not None and proc.returncode == 0
+    err = "" if ok else (
+        f"tpu probe: timed out after {PROBE_TIMEOUT_S}s" if proc is None
+        else f"tpu probe rc={proc.returncode}: "
+        + " | ".join((proc.stderr or "").strip().splitlines()[-3:])
+    )
+    if err:
+        print(err, file=sys.stderr)
+    return ok, err
+
+
 def parent_main() -> int:
-    last_err = ""
     # Step 1: bounded TPU-backend probe — a hung or broken plugin must not
     # consume the whole time budget (round-1 failure: init raised; observed
-    # alternative: init hangs indefinitely).
-    tpu_ok = False
-    for attempt in range(2):
-        proc = _run_child(["--probe", "--platform=tpu"], PROBE_TIMEOUT_S)
-        if proc is not None and proc.returncode == 0:
-            tpu_ok = True
-            break
-        last_err = (
-            f"tpu probe: timed out after {PROBE_TIMEOUT_S}s" if proc is None
-            else f"tpu probe rc={proc.returncode}: "
-            + " | ".join((proc.stderr or "").strip().splitlines()[-3:])
-        )
-        print(last_err, file=sys.stderr)
-        if attempt == 0:
-            time.sleep(RETRY_SLEEP_S)
+    # alternative: init hangs indefinitely).  Exactly one probe subprocess
+    # (and at most one failure line) per bench run.
+    tpu_ok, last_err = probe_tpu()
 
     # Step 2: measurement ladder, first success wins.  Two timed-out TPU
     # attempts stop the full-budget rungs (a compile-bound window, not an

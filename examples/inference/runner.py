@@ -205,8 +205,20 @@ def cmd_serve(args):
             print(json.dumps({"event": "token", "request_id": req.request_id,
                               "token": int(tok)}), flush=True)
 
+    paged_kw = {}
+    if args.page_size:
+        # paged KV: pool HBM is num_pages * page_bytes instead of B * T.
+        # Default pool = the contiguous engine's footprint in pages PLUS the
+        # reserved NULL page, so plain `--page-size N` is a true drop-in
+        # (every workload the contiguous engine admits still fits) with
+        # prefix reuse on top; shrink --num-pages to trade HBM for
+        # admission backpressure.
+        num_pages = args.num_pages or (
+            args.batch_size * (args.max_total_len // args.page_size) + 1)
+        paged_kw = dict(page_size=args.page_size, num_pages=num_pages)
     engine = ServingEngine(
-        model, rng=jax.random.PRNGKey(args.seed), stats_path=args.stats_out)
+        model, rng=jax.random.PRNGKey(args.seed), stats_path=args.stats_out,
+        **paged_kw)
     requests = [
         Request(
             request_id=i,
@@ -230,7 +242,7 @@ def cmd_serve(args):
     engine.close()
     snap = engine.registry.snapshot()
     ttfts = [o.ttft_ms for o in outputs.values() if o.ttft_ms is not None]
-    print(json.dumps({
+    summary = {
         "requests": len(outputs),
         "finished": int(snap.get("serving/finished_total", 0)),
         "tokens": int(snap.get("serving/tokens_total", 0)),
@@ -238,7 +250,13 @@ def cmd_serve(args):
         "wall_s": round(wall, 4),
         "tokens_per_s": (int(snap.get("serving/tokens_total", 0)) /
                          max(wall, 1e-9)),
-    }))
+    }
+    if args.page_size:
+        summary["kv_pages_in_use"] = int(snap.get("kvcache/pages_in_use", 0))
+        summary["prefix_hits"] = int(snap.get("kvcache/prefix_hits_total", 0))
+        summary["prefills_skipped"] = int(
+            snap.get("kvcache/prefill_skipped_total", 0))
+    print(json.dumps(summary))
 
 
 def cmd_benchmark(args):
@@ -326,6 +344,16 @@ def main():
                     help="serving_stats.jsonl output path")
     sp.add_argument("--quiet", action="store_true",
                     help="suppress per-token stream events")
+    sp.add_argument("--page-size", type=int, default=None,
+                    help="enable the paged KV cache with this page size in "
+                         "tokens (must divide --context-len and "
+                         "--max-total-len); repeated prompts then share "
+                         "prefix pages and skip prefill")
+    sp.add_argument("--num-pages", type=int, default=None,
+                    help="paged KV pool size in pages (default: the "
+                         "contiguous engine's batch*total footprint + the "
+                         "reserved NULL page; smaller pools trade HBM for "
+                         "admission backpressure)")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("spec-decode", help="speculative decoding: verify + time vs plain greedy")

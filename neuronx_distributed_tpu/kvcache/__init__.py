@@ -1,0 +1,49 @@
+"""Paged KV-cache subsystem (ISSUE 5 tentpole).
+
+Block-granular KV allocation with prefix reuse for the serving engine —
+PagedAttention's memory model (Kwon et al., SOSP '23) and RadixAttention's
+prefix sharing (Zheng et al., 2024) mapped onto static-shape JAX/pjit:
+
+- :mod:`.allocator` — :class:`BlockAllocator`: host-side free-list page
+  accounting with refcounted sharing, atomic allocation
+  (:class:`PoolExhausted` takes nothing), copy-on-write, and no-leak /
+  no-double-free invariant checks;
+- :mod:`.prefix` — :class:`PrefixIndex`: a page-granular token trie mapping
+  padded prompt prefixes to shared page chains (full-prompt hits carry the
+  prefill logits, so repeated prompts skip prefill compute), with LRU
+  eviction of refcount-0 chains;
+- :mod:`.pool` — :class:`PagePool`: the preallocated
+  ``[num_pages, page_size, kv_heads, head_dim]`` device arrays per layer
+  (kv over tp, page axis a global unsharded pool) plus sizing arithmetic.
+
+The serving integration lives one layer up:
+``serving.paged.PagedKVManager`` glues these onto the engine's slot table,
+``trace.ParallelInferenceModel`` compiles the paged phase programs
+(``decode_pages`` / ``write_page`` / ``copy_page``), and ``models.llama``
+carries the block-table gather/scatter decode path.
+"""
+
+from neuronx_distributed_tpu.kvcache.allocator import (
+    NULL_PAGE,
+    BlockAllocator,
+    PoolExhausted,
+)
+from neuronx_distributed_tpu.kvcache.pool import PagePool, init_page_pool_caches
+from neuronx_distributed_tpu.kvcache.prefix import (
+    PAD,
+    PrefixIndex,
+    is_padding_key,
+    page_keys,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "NULL_PAGE",
+    "PAD",
+    "PagePool",
+    "PoolExhausted",
+    "PrefixIndex",
+    "init_page_pool_caches",
+    "is_padding_key",
+    "page_keys",
+]

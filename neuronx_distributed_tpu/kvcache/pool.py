@@ -1,0 +1,125 @@
+"""Device-side page pool for the paged KV cache (PagedAttention, Kwon et
+al. SOSP '23, mapped onto static-shape pjit).
+
+The contiguous serving cache reserves ``[B, max_total_len]`` KV per slot —
+HBM scales with the *worst case* of every slot at once, and that, not
+compute, caps concurrency.  The page pool breaks the coupling: one
+preallocated ``[num_pages, page_size, kv_heads, head_dim]`` pair per layer,
+and requests hold integer *block tables* mapping their logical cache pages
+to physical pages.  Left-padding pages and unwritten decode tail pages
+back onto the shared NULL page (index 0, content never written), and prompt
+pages shared through the :class:`~.prefix.PrefixIndex` exist once.
+
+Shapes are static — the pool is one allocation for the process lifetime,
+pjit-compatible by construction: the decode program gathers ``pool[block
+table]`` (the same ``[B, T]`` view the contiguous path attends over, so the
+band-mask attention core is unchanged), and page writes are
+``dynamic_update_slice`` at traced page ids.  Sharding matches the
+contiguous caches: kv-heads over ``tp`` when divisible; the page axis is a
+GLOBAL pool and stays unsharded over ``dp`` (block tables address arbitrary
+pages — a dp-sharded page axis would turn every gather into a collective).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.parallel.mesh import (
+    TENSOR_AXIS,
+    get_mesh,
+    model_parallel_is_initialized,
+    named_sharding,
+)
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+def init_page_pool_caches(
+    num_layers: int,
+    num_pages: int,
+    page_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype: Any = jnp.bfloat16,
+) -> List[Tuple[jax.Array, jax.Array]]:
+    """Zero page-pool caches ``[NP, page, NKV, D]`` per layer, kv-heads
+    sharded over tp when divisible (the same policy as the contiguous
+    ``init_kv_caches``); the page axis is unsharded — it is a global pool."""
+    shape = (num_pages, page_size, num_kv_heads, head_dim)
+    caches = [
+        (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        for _ in range(num_layers)
+    ]
+    if model_parallel_is_initialized():
+        mesh = get_mesh()
+        kv_axes = (TENSOR_AXIS
+                   if num_kv_heads % mesh.shape[TENSOR_AXIS] == 0 else None)
+        if kv_axes is None and mesh.shape[TENSOR_AXIS] > 1:
+            logger.warning(
+                "page pool kv head dim (%d) not divisible by tp (%d); "
+                "replicating", num_kv_heads, mesh.shape[TENSOR_AXIS])
+        spec = named_sharding(None, None, kv_axes, None)
+        caches = jax.tree.map(lambda x: jax.device_put(x, spec), caches)
+    return caches
+
+
+class PagePool:
+    """The preallocated device pool plus its sizing arithmetic.
+
+    ``caches`` is the live pytree the engine threads through the compiled
+    paged phase fns (donated every decode step — treat the attribute as the
+    initial value, not a persistent view).  The class is deliberately thin:
+    page *accounting* lives in the host-side
+    :class:`~.allocator.BlockAllocator`, device *programs* on the serving
+    wrapper (``decode_pages`` / ``write_page`` / ``copy_page``)."""
+
+    def __init__(
+        self,
+        num_layers: int,
+        num_pages: int,
+        page_size: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype: Any = jnp.bfloat16,
+    ):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the NULL page), "
+                f"got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_layers = num_layers
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        self.caches = init_page_pool_caches(
+            num_layers, num_pages, page_size, num_kv_heads, head_dim, dtype)
+
+    @property
+    def page_bytes(self) -> int:
+        """HBM bytes one page costs across all layers (k + v)."""
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return (2 * self.num_layers * self.page_size * self.num_kv_heads
+                * self.head_dim * itemsize)
+
+    @property
+    def total_bytes(self) -> int:
+        return self.num_pages * self.page_bytes
+
+    @staticmethod
+    def pages_for_budget(budget_bytes: int, num_layers: int, page_size: int,
+                         num_kv_heads: int, head_dim: int,
+                         dtype: Any = jnp.bfloat16) -> int:
+        """How many pool pages a given HBM budget buys — the sizing half of
+        the paged-vs-contiguous comparison (a contiguous ``[B, T]`` cache's
+        budget is ``B * T / page_size`` pages)."""
+        itemsize = jnp.dtype(dtype).itemsize
+        per_page = (2 * num_layers * page_size * num_kv_heads * head_dim
+                    * itemsize)
+        return max(int(budget_bytes // per_page), 0)

@@ -387,7 +387,7 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None,
-                 segment_ids=None):
+                 segment_ids=None, block_table=None):
         cfg = self.config
         D = cfg.head_dim_
         q, k, v = GQAQKVColumnParallelLinear(
@@ -410,7 +410,38 @@ class LlamaAttention(nn.Module):
         if kv_cache is not None:
             # decode: write new k/v at cache_offset, attend over the cache
             ck, cv = kv_cache
-            if jnp.ndim(cache_offset) == 1:
+            if block_table is not None:
+                # paged decode (kvcache/ subsystem): the cache is the global
+                # page pool [NP, page, NKV, D] and block_table [B, PP] maps
+                # each slot's logical pages to physical ones.  Scatter the
+                # new token into its physical (page, in-page) cell, then
+                # gather the row's chain back into the same [B, T, NKV, D]
+                # view the contiguous path attends over — the band-mask core
+                # below is untouched, so paged decode is value-identical to
+                # the per-slot contiguous decode.  Single-token steps only.
+                if k.shape[1] != 1:
+                    raise ValueError(
+                        "the block-table decode path supports single-token "
+                        f"steps only, got {k.shape[1]} new positions")
+                if jnp.ndim(cache_offset) != 1:
+                    raise ValueError(
+                        "the block-table decode path needs per-slot offsets "
+                        "[B] (continuous-batching decode)")
+                NP, page = ck.shape[0], ck.shape[1]
+                PP = block_table.shape[1]
+                T = PP * page
+                page_idx = jnp.clip(cache_offset // page, 0, PP - 1)
+                in_off = cache_offset % page
+                phys = jnp.take_along_axis(
+                    block_table, page_idx[:, None], axis=1)[:, 0]
+                # a parked slot (offset >= T) writes nothing: route it out of
+                # range and let the scatter drop it
+                phys = jnp.where(cache_offset < T, phys, NP)
+                ck = ck.at[phys, in_off].set(
+                    k[:, 0].astype(ck.dtype), mode="drop")
+                cv = cv.at[phys, in_off].set(
+                    v[:, 0].astype(cv.dtype), mode="drop")
+            elif jnp.ndim(cache_offset) == 1:
                 # per-example write positions [B] (continuous batching: every
                 # slot decodes at its own offset).  Single-token steps only —
                 # a masked select over the time axis instead of a slice
@@ -428,7 +459,13 @@ class LlamaAttention(nn.Module):
                 ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_offset, axis=1)
                 cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_offset, axis=1)
             new_cache = (ck, cv)
-            k, v = ck, cv
+            if block_table is not None:
+                # attend over the gathered per-row view, not the raw pool
+                B_, T = x.shape[0], block_table.shape[1] * ck.shape[1]
+                k = ck[block_table].reshape(B_, T, ck.shape[2], ck.shape[3])
+                v = cv[block_table].reshape(B_, T, cv.shape[2], cv.shape[3])
+            else:
+                k, v = ck, cv
 
         # rematerialization is applied at block granularity in LlamaModel;
         # cached decode keeps the dense core (it needs the cache-offset mask)
@@ -497,12 +534,13 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None,
-                 segment_ids=None):
+                 segment_ids=None, block_table=None):
         cfg = self.config
         h, new_cache = LlamaAttention(cfg, name="attn")(
             RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                     name="input_norm")(x),
             positions, kv_cache, cache_offset, kv_valid, segment_ids,
+            block_table,
         )
         x = x + h
         normed = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -541,7 +579,7 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
-                 kv_valid=None, segment_ids=None):
+                 kv_valid=None, segment_ids=None, block_table=None):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
@@ -584,7 +622,8 @@ class LlamaModel(nn.Module):
                 cache = kv_caches[i] if kv_caches is not None else None
                 if kv_caches is not None:
                     h, c = LlamaBlock(cfg, name=f"layer_{i}")(
-                        h, positions, cache, cache_offset, kv_valid, segment_ids)
+                        h, positions, cache, cache_offset, kv_valid, segment_ids,
+                        block_table)
                 else:
                     h, c = block_cls(cfg, name=f"layer_{i}")(
                         h, positions, None, 0, kv_valid, segment_ids)
@@ -626,9 +665,10 @@ class LlamaForCausalLM(nn.Module):
         )
 
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
-                 kv_valid=None, segment_ids=None):
+                 kv_valid=None, segment_ids=None, block_table=None):
         h, new_caches = self.model(
-            ids, positions, kv_caches, cache_offset, kv_valid, segment_ids)
+            ids, positions, kv_caches, cache_offset, kv_valid, segment_ids,
+            block_table)
         if self.config.sequence_parallel and kv_caches is None:
             # gather the sequence back before the (batched) head matmul
             h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))

@@ -158,6 +158,36 @@ def _summarize_host_blocked(histograms: Dict[str, dict]) -> Dict[str, dict]:
     return out
 
 
+def _summarize_kvcache(scalars: Dict[str, dict]) -> Optional[dict]:
+    """Paged-KV health from the registry's ``kvcache/*`` scalars: pool
+    occupancy (in-use / total pages, with the prefix-cache-held share) and
+    prefix-reuse effectiveness (page hit rate, prefills skipped outright,
+    evictions, copy-on-writes).  None when the run served no paged engine."""
+    total = scalars.get("kvcache/pages_total")
+    if total is None or not total.get("last"):
+        return None
+
+    def last(tag):
+        s = scalars.get(tag)
+        return s["last"] if s else 0.0
+
+    hits = last("kvcache/prefix_hits_total")
+    misses = last("kvcache/prefix_misses_total")
+    return {
+        "pages_total": total["last"],
+        "pages_in_use": last("kvcache/pages_in_use"),
+        "pages_cached": last("kvcache/pages_cached"),
+        "occupancy": round(last("kvcache/pages_in_use") / total["last"], 4),
+        "prefix_hits": hits,
+        "prefix_misses": misses,
+        "prefix_hit_rate": (round(hits / (hits + misses), 4)
+                            if hits + misses else None),
+        "prefills_skipped": last("kvcache/prefill_skipped_total"),
+        "evictions": last("kvcache/evictions_total"),
+        "cow_copies": last("kvcache/cow_copies_total"),
+    }
+
+
 def _summarize_timeline(paths: Sequence[str]) -> dict:
     events = instants = 0
     dur_by_name: Dict[str, float] = {}
@@ -245,6 +275,8 @@ def build_report(
     anomalies = list(flight["warnings"]) if flight else []
     histograms = read_histograms(scalar_records)
     host_blocked = _summarize_host_blocked(histograms)
+    scalars = _summarize_scalars(scalar_records, frozenset(histograms))
+    kvcache = _summarize_kvcache(scalars)
     report = {
         "schema": OBS_REPORT_SCHEMA,
         "generated_at": time.time(),
@@ -256,7 +288,7 @@ def build_report(
             "timelines": timeline_paths,
             "supervisor_events": supervisor_events_path,
         },
-        "scalars": _summarize_scalars(scalar_records, frozenset(histograms)),
+        "scalars": scalars,
         "histograms": histograms,
         "flight": flight,
         "anomalies": anomalies,
@@ -266,6 +298,7 @@ def build_report(
         "health": {
             "anomaly_count": len(anomalies),
             "host_blocked": host_blocked,
+            "kvcache": kvcache,
             "total_collective_count": sum(
                 a.get("total_collective_count", 0) for a in audits),
             "total_collective_bytes": sum(
@@ -290,6 +323,18 @@ def render_markdown(report: dict) -> str:
         lines.append(
             f"- {sys_name} host-blocked: {hb['blocked_ms_total']:.1f} ms "
             f"across {hb['fetches']:.0f} fetches{frac}")
+    kv = h.get("kvcache")
+    if kv:
+        hit = (f"{kv['prefix_hit_rate']:.1%} prefix hit rate "
+               f"({kv['prefix_hits']:.0f}/{kv['prefix_hits'] + kv['prefix_misses']:.0f} pages)"
+               if kv["prefix_hit_rate"] is not None else "no prefix lookups")
+        lines.append(
+            f"- kv cache: {kv['pages_in_use']:.0f}/{kv['pages_total']:.0f} "
+            f"pages in use ({kv['occupancy']:.1%}, "
+            f"{kv['pages_cached']:.0f} held by the prefix cache); {hit}; "
+            f"{kv['prefills_skipped']:.0f} prefills skipped, "
+            f"{kv['evictions']:.0f} evictions, "
+            f"{kv['cow_copies']:.0f} cow copies")
     lines.append("")
 
     sup = report.get("supervisor")

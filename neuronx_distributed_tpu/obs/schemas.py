@@ -87,6 +87,17 @@ REGISTRY_METRICS: Dict[str, str] = {
     # packed decode fetch)
     "train/host_blocked_ms": "histogram",
     "serving/host_blocked_ms": "histogram",
+    # kvcache/ paged-KV subsystem (serving.paged.PagedKVManager +
+    # kvcache.allocator / kvcache.prefix) — pool occupancy and prefix-reuse
+    # effectiveness
+    "kvcache/pages_total": "gauge",
+    "kvcache/pages_in_use": "gauge",
+    "kvcache/pages_cached": "gauge",
+    "kvcache/prefix_hits_total": "counter",
+    "kvcache/prefix_misses_total": "counter",
+    "kvcache/prefill_skipped_total": "counter",
+    "kvcache/cow_copies_total": "counter",
+    "kvcache/evictions_total": "counter",
 }
 
 

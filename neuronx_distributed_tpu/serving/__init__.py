@@ -20,14 +20,21 @@ logits quarantine the one affected request (terminal ``FAILED`` state, slot
 freed, co-batch untouched), ``max_queue`` bounds the admission backlog
 (``BackpressureError``), ``step_timeout_s`` arms a step watchdog, and an
 attached ``obs`` hub gives ``replay_trace`` a crash flight dump.
+
+Paged KV mode (kvcache PR): ``ServingEngine(page_size=, num_pages=)`` swaps
+the per-slot contiguous KV reservation for the :mod:`~..kvcache` page pool —
+:mod:`.paged`'s :class:`PagedKVManager` owns block tables, page budgeting,
+prefix-cache reuse, and terminal-state reclamation.
 """
 
+from neuronx_distributed_tpu.kvcache.allocator import PoolExhausted
 from neuronx_distributed_tpu.serving.engine import (
     FAIL_NON_FINITE,
     SERVING_STATS_SCHEMA,
     ServingEngine,
     replay_trace,
 )
+from neuronx_distributed_tpu.serving.paged import PagedKVManager
 from neuronx_distributed_tpu.serving.request import (
     Request,
     RequestOutput,
@@ -44,6 +51,8 @@ __all__ = [
     "ServingEngine",
     "SERVING_STATS_SCHEMA",
     "FAIL_NON_FINITE",
+    "PagedKVManager",
+    "PoolExhausted",
     "Request",
     "RequestOutput",
     "RequestState",

@@ -63,6 +63,7 @@ from neuronx_distributed_tpu.serving.request import (
     RequestOutput,
     RequestState,
 )
+from neuronx_distributed_tpu.serving.paged import PagedKVManager
 from neuronx_distributed_tpu.serving.scheduler import (
     BackpressureError,
     SlotScheduler,
@@ -202,6 +203,17 @@ class ServingEngine:
       ``jax.transfer_guard("disallow")``: an implicit transfer in the hot
       path raises instead of silently draining the device.  Fetch/put
       counts and ``serving/host_blocked_ms`` export in every mode.
+
+    Paged KV mode (kvcache PR): ``page_size``/``num_pages`` replace the
+    contiguous ``[B, max_total_len]`` per-slot KV reservation with a global
+    page pool plus per-slot block tables — HBM is sized by ``num_pages``
+    (not ``B * T``), admission gates on *pages free*, every terminal state
+    reclaims its pages, and ``prefix_cache`` (default True) shares
+    page-aligned prompt prefixes across requests (an exact repeated prompt
+    skips prefill compute entirely).  Greedy paged decode is token-identical
+    to the contiguous engine (same band-mask attention over the gathered
+    page view — parity-tested); ``kvcache/*`` metrics (pool occupancy,
+    prefix hit/miss, evictions) export through the registry.
     """
 
     def __init__(
@@ -218,8 +230,15 @@ class ServingEngine:
         obs: Any = None,
         async_decode: bool = True,
         transfer_guard: str = "off",
+        page_size: Optional[int] = None,
+        num_pages: Optional[int] = None,
+        prefix_cache: bool = True,
     ):
-        for attr in ("prefill_one", "insert_slot", "decode_slots"):
+        attrs = ("prefill_one", "insert_slot", "decode_slots")
+        if page_size is not None:
+            attrs += ("decode_pages", "write_page", "insert_valid",
+                      "make_page_pool")
+        for attr in attrs:
             if not hasattr(model, attr):
                 raise TypeError(
                     f"model {type(model).__name__} has no {attr!r}: the "
@@ -231,12 +250,31 @@ class ServingEngine:
         self.B = cfg.batch_size
         self.C = cfg.context_len
         self.T = cfg.max_total_len
-        self.scheduler = SlotScheduler(self.B, self.C, self.T,
-                                       max_queue=max_queue)
         self.obs = obs
         if registry is None and obs is not None:
             registry = obs.registry
         self.registry = registry if registry is not None else MetricRegistry()
+        # paged KV mode (kvcache/ subsystem): KV lives in a global page pool
+        # sized by `num_pages`, slots carry int32 block tables, admission
+        # gates on pages free, and repeated prompts share prefix pages
+        self._kv: Optional[PagedKVManager] = None
+        if page_size is None and num_pages is not None:
+            raise ValueError(
+                "num_pages without page_size: paged mode is keyed on "
+                "page_size — pass both, or neither for the contiguous "
+                "engine")
+        if page_size is not None:
+            if num_pages is None:
+                raise ValueError(
+                    "paged mode needs num_pages (the pool size; size it "
+                    "with kvcache.PagePool.pages_for_budget)")
+            self._kv = PagedKVManager(
+                num_slots=self.B, context_len=self.C, max_total_len=self.T,
+                page_size=page_size, num_pages=num_pages,
+                registry=self.registry, prefix_cache=prefix_cache)
+        self.scheduler = SlotScheduler(
+            self.B, self.C, self.T, max_queue=max_queue,
+            page_gate=self._kv)
         self.step_timeout_s = step_timeout_s
         self._steps = 0
         if transfer_guard not in ("off", "forbid"):
@@ -249,6 +287,9 @@ class ServingEngine:
             mode="forbid" if transfer_guard == "forbid" else "observe")
         # in-flight decode: (packed [2,B] device array, active snapshot)
         self._pending: "Optional[tuple]" = None
+        # device mirror of the paged block tables (refreshed via the packed
+        # explicit put only when admission/termination changes them)
+        self._tables_dev = None
         # device mirrors of the per-slot sampling state, refreshed (one
         # explicit put each) only when admission changes the host copies
         self._sampling_dirty = True
@@ -269,8 +310,19 @@ class ServingEngine:
         self._stats_path = stats_path
         self._stats_f = None
 
-        # live device state: the batch as a resource pool
-        self.caches = model.empty_caches()
+        # live device state: the batch as a resource pool — contiguous
+        # [B, T] rows, or the global page pool in paged mode (the paged
+        # pool's HBM is num_pages * page_bytes, decoupled from B * T)
+        if self._kv is not None:
+            pool = model.make_page_pool(num_pages, page_size)
+            self.caches = pool.caches
+            logger.info(
+                "serving: paged KV pool: %d pages x %d tokens "
+                "(%.1f MiB; contiguous [B=%d, T=%d] would be %.1f MiB)",
+                num_pages, page_size, pool.total_bytes / 2**20, self.B,
+                self.T, pool.page_bytes * self.B * self.T / page_size / 2**20)
+        else:
+            self.caches = model.empty_caches()
         self.valid = jnp.zeros((self.B, self.T), jnp.int32)
         self._offsets = np.full((self.B,), self.T, np.int32)  # T = parked
         self._next_tok = np.zeros((self.B,), np.int32)
@@ -376,6 +428,8 @@ class ServingEngine:
 
         self.registry.gauge("serving/queue_depth").set(self.scheduler.queue_depth)
         self.registry.gauge("serving/slots_active").set(self.scheduler.active_count)
+        if self._kv is not None:
+            self._kv.export_gauges()
 
         # step watchdog: a slow engine step is the host-side signature of a
         # recompile, a device stall, or a wedged model call — the gauge/
@@ -435,19 +489,63 @@ class ServingEngine:
     # -- internals ---------------------------------------------------------
 
     def _prefill_into_slot(self, slot: int, req: Request, outputs: list) -> None:
-        """Single-request prefill, KV/validity slot-insert, first token."""
+        """Single-request prefill, KV/validity slot-insert, first token.
+
+        Paged mode replaces the contiguous row insert with block-table
+        assembly: prefix-cache lookup (an exact full-prompt hit returns the
+        cached prefill logits and skips ``prefill_one`` entirely), atomic
+        page allocation, page-aligned writes of only the UNCACHED prompt
+        pages, and prefix-index registration.  A failure mid-admission
+        reclaims every page, fails the one request, and re-raises."""
         L = req.prompt_len
         ids = np.zeros((1, self.C), np.int32)
         ids[0, self.C - L:] = req.prompt_ids  # LEFT-padded to the traced width
-        valid_ctx = jnp.asarray(
-            (np.arange(self.C) >= self.C - L).astype(np.int32))[None, :]
-        logits, row_caches = self.model.prefill_one(jnp.asarray(ids), valid_ctx)
-        logits = perturb("serving/prefill_logits", logits,
-                         request_id=req.request_id, engine_step=self._steps)
+        valid_np = (np.arange(self.C) >= self.C - L).astype(np.int32)
+        valid_ctx = jnp.asarray(valid_np)[None, :]
         row_valid = jnp.concatenate(
             [valid_ctx, jnp.zeros((1, self.T - self.C), jnp.int32)], axis=1)
-        self.caches, self.valid = self.model.insert_slot(
-            self.caches, row_caches, self.valid, row_valid, slot)
+        prefilled_fresh = False  # paged: freshly prefilled chain to register
+        if self._kv is not None:
+            try:
+                cached = self._kv.admit_slot(slot, req, ids[0], valid_np,
+                                             engine_step=self._steps)
+            except BaseException as e:
+                now = self._clock()
+                self._fail_slot_state(slot, req, now,
+                                      reason=f"page_alloc:{type(e).__name__}")
+                logger.warning(
+                    "serving: request %d failed mid-page-allocation (%s) — "
+                    "every page reclaimed, slot %d freed", req.request_id,
+                    e, slot)
+                outputs.append(self._emit(req, now))
+                raise
+            if cached is not None:
+                # exact full-prompt prefix hit: the chain's pages already
+                # hold this prompt's KV and the payload is the prefill's
+                # last-position logits — no prefill compute at all
+                logits = jnp.asarray(cached)
+            else:
+                logits, row_caches = self.model.prefill_one(
+                    jnp.asarray(ids), valid_ctx)
+                logits = perturb("serving/prefill_logits", logits,
+                                 request_id=req.request_id,
+                                 engine_step=self._steps)
+                for lp, phys in self._kv.fresh_pages(slot):
+                    self.caches = self.model.write_page(
+                        self.caches, row_caches, lp, phys)
+                # prefix-index registration waits for the finite-logits
+                # gate below: a poisoned prefill must fail ITS request
+                # only, never become a cached payload every future
+                # identical prompt replays
+                prefilled_fresh = True
+            self.valid = self.model.insert_valid(self.valid, row_valid, slot)
+        else:
+            logits, row_caches = self.model.prefill_one(
+                jnp.asarray(ids), valid_ctx)
+            logits = perturb("serving/prefill_logits", logits,
+                             request_id=req.request_id, engine_step=self._steps)
+            self.caches, self.valid = self.model.insert_slot(
+                self.caches, row_caches, self.valid, row_valid, slot)
 
         s = req.sampling
         if s.temperature > 0.0 and self._rng is not None:
@@ -472,8 +570,13 @@ class ServingEngine:
         now = self._clock()
         self.registry.counter("serving/admitted_total").inc()
         if not bool(first[1][0]):
+            # quarantine BEFORE prefix-index registration: the pages and
+            # logits of a poisoned prefill die with this request instead of
+            # becoming a cached chain every identical prompt would replay
             self._fail_slot(slot, req, outputs, now)
             return
+        if prefilled_fresh:
+            self._kv.finish_insert(slot, np.asarray(logits))
         tok = int(first[0][0])
         req.transition(RequestState.DECODE)
         req.first_token_time = now
@@ -496,9 +599,14 @@ class ServingEngine:
         for slot, req in active:
             tok_idx[slot] = len(req.generated)
 
-        logits, self.caches, self.valid = self.model.decode_slots(
-            jnp.asarray(self._next_tok)[:, None], self._offsets,
-            self.caches, self.valid)
+        if self._kv is not None:
+            logits, self.caches, self.valid = self.model.decode_pages(
+                jnp.asarray(self._next_tok)[:, None], self._offsets,
+                self._kv.tables, self.caches, self.valid)
+        else:
+            logits, self.caches, self.valid = self.model.decode_slots(
+                jnp.asarray(self._next_tok)[:, None], self._offsets,
+                self.caches, self.valid)
         logits = perturb("serving/decode_logits", logits,
                          engine_step=self._steps)
         toks_f = _sample_rows(
@@ -584,11 +692,25 @@ class ServingEngine:
             tok_idx[slot] = len(req.generated)
         # eager slicing of a stacked [3, B] array would bind scalar start
         # indices host-side (an implicit transfer the guard rejects), so the
-        # per-step inputs stage as one explicit pytree put instead
-        tok, offs, tidx = self._audit.put((
-            self._next_tok[:, None].copy(), self._offsets.copy(), tok_idx))
-        logits, self.caches, self.valid = self.model.decode_slots(
-            tok, offs, self.caches, self.valid)
+        # per-step inputs stage as one explicit pytree put instead; in paged
+        # mode a dirty block table rides the SAME put (still one explicit
+        # host→device crossing per step) and a clean one reuses its mirror
+        staged = [self._next_tok[:, None].copy(), self._offsets.copy(),
+                  tok_idx]
+        if self._kv is not None and (self._kv.tables_dirty
+                                     or self._tables_dev is None):
+            staged.append(self._kv.tables.copy())
+            put = self._audit.put(tuple(staged))
+            tok, offs, tidx, self._tables_dev = put
+            self._kv.tables_dirty = False
+        else:
+            tok, offs, tidx = self._audit.put(tuple(staged))
+        if self._kv is not None:
+            logits, self.caches, self.valid = self.model.decode_pages(
+                tok, offs, self._tables_dev, self.caches, self.valid)
+        else:
+            logits, self.caches, self.valid = self.model.decode_slots(
+                tok, offs, self.caches, self.valid)
         logits = perturb("serving/decode_logits", logits,
                          engine_step=self._steps)
         if self._sampling_dirty:
@@ -634,26 +756,33 @@ class ServingEngine:
 
     def _finish_request(self, slot: int, req: Request, reason: str,
                         now: float) -> None:
-        """Terminal FINISHED bookkeeping: state, slot release, park."""
+        """Terminal FINISHED bookkeeping: state, slot release, park, and
+        (paged) page reclamation."""
         req.transition(RequestState.FINISHED)
         req.finish_reason = reason
         req.finish_time = now
         self.scheduler.release(req)
         self._offsets[slot] = self.T  # park
         self._last_tok_time[slot] = None
+        if self._kv is not None:
+            self._kv.release_slot(slot)
         self.registry.counter("serving/finished_total").inc()
 
-    def _fail_slot_state(self, slot: int, req: Request, now: float) -> None:
-        """Quarantine bookkeeping for one numerically poisoned request:
-        terminal ``FAILED`` state, slot freed and parked (the next
-        ``insert_slot`` overwrites the poisoned KV rows; a parked row's
-        logits are ignored meanwhile), the rest of the batch untouched."""
+    def _fail_slot_state(self, slot: int, req: Request, now: float,
+                         reason: str = FAIL_NON_FINITE) -> None:
+        """Quarantine bookkeeping for one failed request: terminal
+        ``FAILED`` state, slot freed and parked (the next insert overwrites
+        the poisoned KV; a parked row's logits are ignored meanwhile), its
+        KV pages reclaimed in paged mode, the rest of the batch
+        untouched."""
         req.transition(RequestState.FAILED)
-        req.finish_reason = FAIL_NON_FINITE
+        req.finish_reason = reason
         req.finish_time = now
         self.scheduler.release(req)
         self._offsets[slot] = self.T  # park
         self._last_tok_time[slot] = None
+        if self._kv is not None:
+            self._kv.release_slot(slot)
         self.registry.counter("serving/failed_total").inc()
 
     def _fail_slot(self, slot: int, req: Request, outputs: list,
@@ -688,6 +817,8 @@ class ServingEngine:
             if slot not in live:
                 self._offsets[slot] = self.T
                 self._last_tok_time[slot] = None
+                if self._kv is not None:  # idempotent page reclamation
+                    self._kv.release_slot(slot)
 
     def _emit(self, req: Request, now: float) -> RequestOutput:
         out = RequestOutput.from_request(req, now)

@@ -1,0 +1,247 @@
+"""Paged-KV state manager for the serving engine.
+
+Glues the :mod:`~neuronx_distributed_tpu.kvcache` subsystem (host-side
+:class:`BlockAllocator` + :class:`PrefixIndex`, device-side page pool) onto
+the engine's slot table: per-slot block tables, worst-case page budgeting
+for the scheduler's admission gate, prefix-cache lookup/insert around
+prefill, and page reclamation on every terminal state.
+
+Allocation discipline (the chaos contract):
+
+- a request's ENTIRE worst-case page need — non-padding prompt pages it
+  cannot reuse plus every decode page up to ``max_new_tokens`` — is taken
+  at admission, so decode can never hit pool exhaustion mid-request;
+- the admission path is transactional: any failure mid-allocation (the
+  ``serving/page_alloc`` fault point sits between the prompt-page and
+  decode-page allocations) releases every page and reference taken so far
+  before re-raising — a crashed request leaks nothing;
+- pool exhaustion surfaces as the scheduler's retryable
+  ``BackpressureError`` at submit (page-aware backlog bound) or as a
+  queued request waiting its turn — never as a partial allocation.
+
+Prompt pages live page-aligned in ``[0, context_len)`` and decode writes
+start at ``context_len``, so shared prefix pages are immutable by
+construction and sharing needs no copy-on-write on this path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional
+
+import numpy as np
+
+from neuronx_distributed_tpu.kvcache.allocator import NULL_PAGE, BlockAllocator
+from neuronx_distributed_tpu.kvcache.prefix import (
+    PrefixIndex,
+    is_padding_key,
+    page_keys,
+)
+from neuronx_distributed_tpu.resilience.faults import fault_point
+from neuronx_distributed_tpu.serving.request import Request
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+PAGES_TOTAL = "kvcache/pages_total"
+PAGES_IN_USE = "kvcache/pages_in_use"
+PAGES_CACHED = "kvcache/pages_cached"
+PREFIX_HITS_TOTAL = "kvcache/prefix_hits_total"
+PREFIX_MISSES_TOTAL = "kvcache/prefix_misses_total"
+PREFILL_SKIPPED_TOTAL = "kvcache/prefill_skipped_total"
+
+
+class PagedKVManager:
+    """Host-side paged-KV bookkeeping for one engine (pure numpy — the
+    device pool and its compiled programs live on the serving wrapper).
+
+    Implements the scheduler's ``page_gate`` protocol
+    (:meth:`pages_needed` / :meth:`pages_free` / :meth:`pages_capacity`)
+    and the engine's slot lifecycle (:meth:`admit_slot` →
+    :meth:`fresh_pages` writes → :meth:`finish_insert`;
+    :meth:`release_slot` on any terminal state).
+    """
+
+    def __init__(self, *, num_slots: int, context_len: int, max_total_len: int,
+                 page_size: int, num_pages: int, registry: Any = None,
+                 prefix_cache: bool = True):
+        if context_len % page_size != 0 or max_total_len % page_size != 0:
+            raise ValueError(
+                f"page_size {page_size} must divide context_len "
+                f"{context_len} and max_total_len {max_total_len} — "
+                "page-aligned prompts are what make shared prefix pages "
+                "immutable (decode writes start at the prefill boundary)")
+        self.B = num_slots
+        self.C = context_len
+        self.T = max_total_len
+        self.page_size = page_size
+        self.pages_per_slot = max_total_len // page_size
+        self.ctx_pages = context_len // page_size
+        self.registry = registry
+        self.alloc = BlockAllocator(num_pages, registry=registry)
+        self.index = (PrefixIndex(self.alloc, registry=registry)
+                      if prefix_cache else None)
+        # per-slot logical→physical page map; NULL_PAGE backs every hole
+        self.tables = np.full((num_slots, self.pages_per_slot), NULL_PAGE,
+                              np.int32)
+        self.tables_dirty = True  # device mirror refresh flag (async engine)
+        self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
+        self._slot_fresh: List[List[tuple]] = [[] for _ in range(num_slots)]
+        self._slot_keys: List[Optional[list]] = [None] * num_slots
+        if registry is not None:
+            registry.gauge(PAGES_TOTAL).set(self.alloc.capacity)
+            registry.gauge(PAGES_IN_USE)
+            registry.gauge(PAGES_CACHED)
+            for c in (PREFIX_HITS_TOTAL, PREFIX_MISSES_TOTAL,
+                      PREFILL_SKIPPED_TOTAL):
+                registry.counter(c)
+
+    # -- scheduler page-gate protocol --------------------------------------
+
+    def pages_needed(self, req: Request) -> int:
+        """Worst-case pages the request can hold at once: its non-padding
+        prompt pages (no prefix-hit credit — hits only shrink the real
+        allocation) plus every decode page through ``max_new_tokens``."""
+        L = min(req.prompt_len, self.C)
+        n_ctx = self.ctx_pages - (self.C - L) // self.page_size
+        n_dec = math.ceil(req.max_new_tokens / self.page_size)
+        return n_ctx + n_dec
+
+    def pages_free(self) -> int:
+        """Pages an admission could use right now: the free list plus what
+        LRU eviction of unpinned cached chains would reclaim."""
+        free = self.alloc.free_count
+        if self.index is not None:
+            free += self.index.evictable_pages()
+        return free
+
+    def pages_capacity(self) -> int:
+        return self.alloc.capacity
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def admit_slot(self, slot: int, req: Request, ids_row, valid_row,
+                   engine_step: int = 0):
+        """Build the slot's block table: prefix-cache lookup, then atomic
+        allocation of the remaining prompt pages and all decode pages
+        (evicting LRU cached chains first when the free list is short).
+        Returns the cached prefill logits on an exact full-prompt hit (the
+        engine skips ``prefill_one`` entirely), else None.
+
+        Transactional: on ANY failure every page/reference taken so far is
+        released before the exception propagates."""
+        keys = page_keys(ids_row, valid_row, self.page_size)[:self.ctx_pages]
+        matched: List[int] = []
+        payload = None
+        if self.index is not None:
+            matched, payload = self.index.lookup(keys)
+        taken = [p for p in matched if p != NULL_PAGE]  # refs we now hold
+        try:
+            table = np.full((self.pages_per_slot,), NULL_PAGE, np.int32)
+            for lp, p in enumerate(matched):
+                table[lp] = p
+            # prompt pages beyond the cached prefix; all-padding pages ride
+            # the NULL page (masked out of every attention) for free
+            todo = [lp for lp in range(len(matched), self.ctx_pages)
+                    if not is_padding_key(keys[lp])]
+            n_dec = math.ceil(req.max_new_tokens / self.page_size)
+            self._ensure_free(len(todo) + n_dec)
+            ctx_fresh = self.alloc.alloc(len(todo))
+            taken += ctx_fresh
+            fresh = []
+            for lp, p in zip(todo, ctx_fresh):
+                table[lp] = p
+                fresh.append((lp, p))
+            # chaos hook: a crash between the prompt-page and decode-page
+            # allocations must leak nothing (tests/test_kvcache.py)
+            fault_point("serving/page_alloc", request_id=req.request_id,
+                        engine_step=engine_step)
+            dec = self.alloc.alloc(n_dec)
+            taken += dec
+            for i, p in enumerate(dec):
+                table[self.ctx_pages + i] = p
+        except BaseException:
+            for p in taken:
+                self.alloc.free(p)
+            raise
+        self._slot_pages[slot] = taken
+        self._slot_fresh[slot] = fresh
+        self._slot_keys[slot] = keys
+        self.tables[slot] = table
+        self.tables_dirty = True
+        n_hit = sum(1 for lp, p in enumerate(matched)
+                    if not is_padding_key(keys[lp]))
+        full_hit = payload is not None and len(matched) == self.ctx_pages
+        if self.registry is not None:
+            self.registry.counter(PREFIX_HITS_TOTAL).inc(n_hit)
+            self.registry.counter(PREFIX_MISSES_TOTAL).inc(len(todo))
+            if full_hit:
+                self.registry.counter(PREFILL_SKIPPED_TOTAL).inc()
+        return payload if full_hit else None
+
+    def fresh_pages(self, slot: int) -> List[tuple]:
+        """``[(logical_page, phys_page), ...]`` the engine must fill from
+        the prefill row caches — cached-prefix (and padding) pages are
+        absent, so their writes are skipped entirely."""
+        return list(self._slot_fresh[slot])
+
+    def finish_insert(self, slot: int, payload: Any) -> None:
+        """Register the slot's prompt chain (with the prefill's
+        last-position logits as the full-hit payload) in the prefix index
+        once its pages hold real KV."""
+        if self.index is None or self._slot_keys[slot] is None:
+            return
+        keys = self._slot_keys[slot]
+        pages = [int(p) for p in self.tables[slot][:self.ctx_pages]]
+        self.index.insert(keys, pages, payload=payload)
+
+    def release_slot(self, slot: int) -> None:
+        """Drop every page reference the slot holds (exclusive pages return
+        to the free list; shared prefix pages decref) and null its block
+        table.  Idempotent — terminal paths and the sweep's park can both
+        call it."""
+        pages = self._slot_pages[slot]
+        if not pages and self._slot_keys[slot] is None:
+            return
+        for p in pages:
+            self.alloc.free(p)
+        self._slot_pages[slot] = []
+        self._slot_fresh[slot] = []
+        self._slot_keys[slot] = None
+        self.tables[slot] = NULL_PAGE
+        self.tables_dirty = True
+
+    # -- internals ---------------------------------------------------------
+
+    def _ensure_free(self, n: int) -> None:
+        """Make room for an allocation of ``n`` by evicting LRU unpinned
+        cached chains — the admission gate already verified
+        free + evictable covers the worst case, so a miss here is a bug the
+        allocator's :class:`PoolExhausted` will surface loudly."""
+        short = n - self.alloc.free_count
+        if short > 0 and self.index is not None:
+            self.index.evict(short)
+
+    def export_gauges(self) -> None:
+        if self.registry is None:
+            return
+        self.registry.gauge(PAGES_TOTAL).set(self.alloc.capacity)
+        self.registry.gauge(PAGES_IN_USE).set(self.alloc.in_use)
+        self.registry.gauge(PAGES_CACHED).set(
+            self.index.evictable_pages() if self.index is not None else 0)
+
+    def assert_invariants(self) -> None:
+        """Allocator + index invariants, plus the slot-table contract: every
+        non-NULL table entry of an occupied slot is an allocated page, and
+        slot-held references account one-to-one."""
+        self.alloc.assert_invariants()
+        if self.index is not None:
+            self.index.assert_invariants()
+        for slot in range(self.B):
+            for p in self._slot_pages[slot]:
+                assert self.alloc.refcount(p) >= 1, (
+                    f"slot {slot} references freed page {p}")
+            held = {int(p) for p in self.tables[slot] if p != NULL_PAGE}
+            assert held <= set(self._slot_pages[slot]), (
+                f"slot {slot} table points at pages it holds no reference "
+                f"on: {sorted(held - set(self._slot_pages[slot]))}")

@@ -47,15 +47,24 @@ class SlotScheduler:
       at the prefill boundary, so this — not ``prompt_len +
       max_new_tokens`` — is the binding cache-capacity bound);
     - when ``max_queue`` is set, the *excess* backlog (queued requests
-      beyond the free slots the next ``admit`` can immediately grant) is
-      bounded: exceeding it raises :class:`BackpressureError` (transient;
-      retryable) so overload is rejected at the edge instead of
-      accumulating unbounded backlog.  A burst of ``free_count +
-      max_queue`` submissions always fits.
+      beyond what the next ``admit`` can immediately grant) is bounded:
+      exceeding it raises :class:`BackpressureError` (transient; retryable)
+      so overload is rejected at the edge instead of accumulating unbounded
+      backlog.  A burst of ``free_count + max_queue`` submissions always
+      fits (slot-only mode);
+    - with a ``page_gate`` (the paged-KV engine's admission adapter —
+      ``pages_needed(request)``, ``pages_free()``, ``pages_capacity()``)
+      admission gates on *pages free* instead of slots alone: a request
+      whose worst-case page need exceeds the pool capacity is a permanent
+      :class:`AdmissionError`, the FCFS head waits (blocking the queue —
+      no size-based bypass, so small requests cannot starve big ones) until
+      both a slot and its pages are free, and the backpressure bound counts
+      page-limited grants, so a pool-exhausted engine rejects overload with
+      the same retryable :class:`BackpressureError`.
     """
 
     def __init__(self, num_slots: int, context_len: int, max_total_len: int,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None, page_gate=None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_queue is not None and max_queue < 1:
@@ -64,6 +73,7 @@ class SlotScheduler:
         self.context_len = context_len
         self.max_total_len = max_total_len
         self.max_queue = max_queue
+        self.page_gate = page_gate
         self._queue: deque = deque()
         self._slots: List[Optional[Request]] = [None] * num_slots
         self._slot_of: Dict[int, int] = {}
@@ -90,6 +100,27 @@ class SlotScheduler:
             (slot, self._slots[slot]) for slot in self._slot_of.values()
         )
 
+    def _grantable_now(self, extra: Optional[Request] = None) -> int:
+        """How many queued requests (FCFS order, plus ``extra`` at the tail)
+        the next ``admit`` could grant right now, bounded by free slots and
+        — under a ``page_gate`` — by free KV pages (worst-case per-request
+        need; prefix hits only make the real allocation smaller)."""
+        reqs = list(self._queue) + ([extra] if extra is not None else [])
+        slots = self.free_count
+        if self.page_gate is None:
+            return min(len(reqs), slots)
+        pages = self.page_gate.pages_free()
+        n = 0
+        for req in reqs:
+            if n >= slots:
+                break
+            need = self.page_gate.pages_needed(req)
+            if need > pages:
+                break  # FCFS: nobody jumps the blocked head
+            pages -= need
+            n += 1
+        return n
+
     # -- lifecycle ---------------------------------------------------------
 
     def submit(self, request: Request, now: Optional[float] = None) -> None:
@@ -111,12 +142,22 @@ class SlotScheduler:
                 f"({self.context_len} + {request.max_new_tokens}) > "
                 f"max_total_len {self.max_total_len} (decode slots start at "
                 "the prefill boundary)")
+        if self.page_gate is not None:
+            need = self.page_gate.pages_needed(request)
+            cap = self.page_gate.pages_capacity()
+            if need > cap:
+                raise AdmissionError(
+                    f"request {request.request_id}: needs {need} KV pages "
+                    f"> pool capacity {cap}; it can never be admitted")
         if self.max_queue is not None \
-                and len(self._queue) - self.free_count >= self.max_queue:
+                and len(self._queue) + 1 - self._grantable_now(request) \
+                > self.max_queue:
             raise BackpressureError(
                 f"request {request.request_id}: admission backlog full "
-                f"({len(self._queue)} queued, {self.free_count} free slots, "
-                f"max_queue {self.max_queue}); retry after the backlog "
+                f"({len(self._queue)} queued, {self.free_count} free slots"
+                + (f", {self.page_gate.pages_free()} free KV pages"
+                   if self.page_gate is not None else "")
+                + f", max_queue {self.max_queue}); retry after the backlog "
                 "drains")
         request.submit_time = time.monotonic() if now is None else now
         self._by_id[request.request_id] = request
@@ -177,7 +218,17 @@ class SlotScheduler:
         granted request to PREFILL; returns ``[(slot, request), ...]``."""
         now = time.monotonic() if now is None else now
         grants: List[Tuple[int, Request]] = []
+        # page budget tracked across the loop: the engine only ALLOCATES
+        # after admit() returns, so each grant must reserve its worst-case
+        # need against this call's free-page snapshot
+        budget = (self.page_gate.pages_free()
+                  if self.page_gate is not None else None)
         while self._queue and self.free_count > 0:
+            if budget is not None:
+                need = self.page_gate.pages_needed(self._queue[0])
+                if need > budget:
+                    break  # FCFS head waits for pages; nobody jumps it
+                budget -= need
             req = self._queue.popleft()
             slot = next(i for i, r in enumerate(self._slots) if r is None)
             self._slots[slot] = req

@@ -730,6 +730,125 @@ class ParallelInferenceModel(_ServingBase):
         return fn(caches, row_caches, valid.astype(jnp.int32),
                   jnp.asarray(row_valid, jnp.int32), jnp.int32(slot))
 
+    # -- paged-KV phase fns (kvcache/ subsystem; serving paged mode) --------
+
+    def make_page_pool(self, num_pages: int, page_size: int):
+        """A :class:`~..kvcache.pool.PagePool` shaped/sharded for this
+        model's layers and cache dtype — the device half of the paged
+        serving engine's KV state."""
+        from neuronx_distributed_tpu.kvcache.pool import PagePool
+
+        return PagePool(self.num_layers, num_pages, page_size,
+                        self.num_kv_heads, self.head_dim,
+                        self.config.kv_cache_dtype)
+
+    def _pool_out_shardings(self, caches):
+        from jax.sharding import NamedSharding
+
+        return jax.tree.map(
+            lambda x: x.sharding
+            if isinstance(getattr(x, "sharding", None), NamedSharding)
+            else None,
+            caches)
+
+    def _decode_pages_fn(self, params, tok, offsets, block_table, caches, valid):
+        """The paged twin of :meth:`_decode_slots_fn`: same per-slot offsets,
+        validity update, and mask-derived positions, but the KV state is the
+        page pool + block tables (the model scatters the new token into its
+        physical page and attends over the gathered per-row view).  An
+        offset of ``T`` parks an idle slot."""
+        T = valid.shape[1]
+        hot = jnp.arange(T)[None, :] == offsets[:, None]  # [B, T]
+        valid = jnp.where(hot, 1, valid)  # the new token becomes a key
+        before = jnp.where(jnp.arange(T)[None, :] < offsets[:, None], valid, 0)
+        positions = jnp.sum(before, axis=1, keepdims=True).astype(jnp.int32)
+        logits, caches = self.module.apply(
+            params, tok, positions, caches, offsets, kv_valid=valid,
+            block_table=block_table,
+        )
+        return logits[:, -1, :], caches, valid
+
+    def decode_pages(self, tok, offsets, block_table, caches, valid):
+        """Compiled paged per-slot decode step (page pool donated).
+        ``block_table`` is the ``[B, max_total_len // page_size]`` int32
+        logical→physical page map; ``caches`` the pool pytree."""
+        if not hasattr(self, "_serving_cache"):
+            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        fn = self._serving_cache.get("decode_pages")
+        if fn is None:
+            fn = jax.jit(
+                self._decode_pages_fn, donate_argnums=(4,),
+                out_shardings=(None, self._pool_out_shardings(caches),
+                               self._io_shardings["batch"](None)))
+            self._serving_cache.put("decode_pages", fn)
+        return fn(self.params, tok, jnp.asarray(offsets, jnp.int32),
+                  jnp.asarray(block_table, jnp.int32), caches, valid)
+
+    def _write_page_fn(self, caches, row_caches, lp, phys):
+        """Write logical page ``lp`` of a prefilled one-row cache into
+        physical page ``phys`` of the pool (both traced scalars — ONE
+        compiled program serves every page of every admission)."""
+        def wr(c, r):
+            page = c.shape[1]
+            chunk = jax.lax.dynamic_slice_in_dim(r, lp * page, page, axis=1)
+            return jax.lax.dynamic_update_slice(
+                c, chunk.astype(c.dtype), (phys, 0, 0, 0))
+
+        return jax.tree.map(wr, caches, row_caches)
+
+    def write_page(self, caches, row_caches, logical_page, phys_page):
+        """Compiled page-aligned prefill write (pool donated): page
+        ``logical_page`` of the ``prefill_one`` row caches lands in pool
+        page ``phys_page``.  Cached-prefix pages are simply never written —
+        the caller skips them entirely."""
+        if not hasattr(self, "_serving_cache"):
+            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        fn = self._serving_cache.get("write_page")
+        if fn is None:
+            fn = jax.jit(self._write_page_fn, donate_argnums=(0,),
+                         out_shardings=self._pool_out_shardings(caches))
+            self._serving_cache.put("write_page", fn)
+        return fn(caches, row_caches, jnp.int32(logical_page),
+                  jnp.int32(phys_page))
+
+    def _copy_page_fn(self, caches, src, dst):
+        def cp(c):
+            row = jax.lax.dynamic_slice_in_dim(c, src, 1, axis=0)
+            return jax.lax.dynamic_update_slice(c, row, (dst, 0, 0, 0))
+
+        return jax.tree.map(cp, caches)
+
+    def copy_page(self, caches, src_page, dst_page):
+        """Compiled pool-internal page copy (pool donated) — the device half
+        of the allocator's copy-on-write: duplicate a shared page before
+        writing the copy."""
+        if not hasattr(self, "_serving_cache"):
+            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        fn = self._serving_cache.get("copy_page")
+        if fn is None:
+            fn = jax.jit(self._copy_page_fn, donate_argnums=(0,),
+                         out_shardings=self._pool_out_shardings(caches))
+            self._serving_cache.put("copy_page", fn)
+        return fn(caches, jnp.int32(src_page), jnp.int32(dst_page))
+
+    def _insert_valid_fn(self, valid, row_valid, slot):
+        return jax.lax.dynamic_update_slice_in_dim(
+            valid, row_valid, slot, axis=0)
+
+    def insert_valid(self, valid, row_valid, slot):
+        """Compiled validity-row insert (donated) — the paged admission's
+        slice of :meth:`insert_slot`: block tables carry the KV, so only the
+        validity row needs writing."""
+        if not hasattr(self, "_serving_cache"):
+            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        fn = self._serving_cache.get("insert_valid")
+        if fn is None:
+            fn = jax.jit(self._insert_valid_fn, donate_argnums=(0,),
+                         out_shardings=self._io_shardings["batch"](None))
+            self._serving_cache.put("insert_valid", fn)
+        return fn(valid.astype(jnp.int32), jnp.asarray(row_valid, jnp.int32),
+                  jnp.int32(slot))
+
     def _build(self):
         from jax.sharding import NamedSharding
 

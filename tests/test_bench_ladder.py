@@ -25,7 +25,6 @@ def _run(monkeypatch, capsys, behavior):
     """behavior(args, timeout) -> _Proc | None; returns the printed JSON."""
     monkeypatch.setattr(bench, "_run_child",
                         lambda extra, t, env=None: behavior(extra, t))
-    monkeypatch.setattr(bench, "RETRY_SLEEP_S", 0)
     rc = bench.parent_main()
     out = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
     assert rc == 0 and len(out) == 1
@@ -85,9 +84,11 @@ def test_recovery_exhausted_emits_cpu_smoke(monkeypatch, capsys):
 
 def test_dead_tunnel_goes_straight_to_cpu(monkeypatch, capsys):
     tpu_measured = []
+    probes = []
 
     def behavior(extra, t):
         if "--probe" in extra:
+            probes.append(extra)
             return None  # probe timeout
         if "--platform=tpu" in extra:
             tpu_measured.append(extra)
@@ -97,6 +98,9 @@ def test_dead_tunnel_goes_straight_to_cpu(monkeypatch, capsys):
 
     d = _run(monkeypatch, capsys, behavior)
     assert d["value"] == 5.0 and not tpu_measured
+    # the probe result is cached for the whole run: ONE probe subprocess
+    # (and one timeout line), not one per retry/rung
+    assert len(probes) == 1
 
 
 def test_total_failure_still_one_json_line(monkeypatch, capsys):

@@ -1,6 +1,6 @@
-"""Serving benchmark on the local chip — one JSON line.
+"""Serving benchmark on the local chip — one JSON line per measurement.
 
-Two modes:
+Three modes:
 
 - default: static-batch decode latency through the serving engine's
   neuronperf-equivalent harness (`trace.engine.benchmark`: context-encode
@@ -12,6 +12,13 @@ Two modes:
   inter-token p50/p99, and goodput against the static lockstep `generate`
   baseline over the same prompts — the utilization gap iteration-level
   scheduling closes.  Writes a schema-checked `serving_stats.jsonl`.
+- `--paged`: paged vs contiguous KV at a FIXED HBM budget.  The contiguous
+  engine's `[B, T]` reservation defines the budget; the paged engine gets
+  the same bytes as a page pool but twice the slots, and both replay the
+  same shared-system-prompt Poisson workload.  One JSON line each
+  (`"mode": "contiguous"` / `"mode": "paged"`): max concurrent requests,
+  TTFT / inter-token p50/p99, goodput, and (paged) the prefix-page hit
+  rate + prefills skipped — the kvcache/ subsystem's acceptance numbers.
 """
 
 from __future__ import annotations
@@ -69,6 +76,8 @@ def run_continuous(args, model, vocab_size: int) -> dict:
     warm.submit(Request(request_id=-1, prompt_ids=prompts[0],
                         max_new_tokens=min(2, args.max_new_tokens)))
     warm.run_until_complete(max_steps=1000)
+    warm.close()
+    del warm  # drop its device caches before the measured engine allocates
     pad = np.zeros((B, C), np.int32)
     jax.block_until_ready(model.generate(
         jnp.asarray(pad), args.max_new_tokens,
@@ -125,6 +134,135 @@ def run_continuous(args, model, vocab_size: int) -> dict:
     }
 
 
+def _drive_workload(engine, arrivals, requests):
+    """Replay the workload tracking peak slot concurrency; returns
+    ``(outputs, wall_s, peak_concurrent)``."""
+    import time as _time
+
+    from neuronx_distributed_tpu.serving import replay_trace
+
+    peak = [0]
+    orig_step = engine.step
+
+    def step():
+        out = orig_step()
+        peak[0] = max(peak[0], engine.scheduler.active_count)
+        return out
+
+    engine.step = step
+    t0 = _time.monotonic()
+    outputs = replay_trace(engine, arrivals, requests)
+    wall = _time.monotonic() - t0
+    return outputs, wall, peak[0]
+
+
+def run_paged(args, module, params, cfg, icfg) -> int:
+    """Paged vs contiguous at a fixed HBM budget over one shared-system-
+    prompt workload; prints one JSON line per mode."""
+    import dataclasses
+
+    import numpy as np
+
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.serving import Request, ServingEngine
+    from neuronx_distributed_tpu.trace import ParallelInferenceModel
+
+    B, C, T = args.batch_size, args.context_len, args.max_total_len
+    page = args.page_size
+    if C % page or T % page:
+        raise SystemExit(f"--page-size {page} must divide --context-len {C} "
+                         f"and --max-total-len {T}")
+    # the fixed budget: exactly the contiguous engine's [B, T] reservation,
+    # expressed in pages (the paged pool spends one of them on the shared
+    # NULL page — honest accounting, the paged scheme pays its overhead)
+    budget_pages = B * (T // page)
+    paged_slots = args.paged_slots or 2 * B
+    model_c = ParallelInferenceModel(module, params, icfg)
+    model_p = ParallelInferenceModel(
+        module, params, dataclasses.replace(icfg, batch_size=paged_slots))
+
+    # shared-system-prompt workload: fixed-length prompts (equal padding is
+    # what makes page-aligned prefixes shareable) opening with a common
+    # system preamble.  Half-width prompts are the case paged serving is
+    # FOR: the contiguous engine reserves [T] per slot regardless, the
+    # paged engine holds only the real prompt + decode pages (padding pages
+    # ride the NULL page, the shared preamble's pages exist once).
+    rs = np.random.RandomState(args.seed)
+    n = args.num_requests
+    L = max(C // 2, 1)
+    sys_len = max(L // 2, 1)
+    sys_ids = rs.randint(1, cfg.vocab_size, size=sys_len).tolist()
+    prompts = [
+        sys_ids + rs.randint(1, cfg.vocab_size, size=L - sys_len).tolist()
+        for _ in range(n)
+    ]
+    # burst arrival (everything at t=0): the measurement is how many
+    # requests the KV budget can hold IN FLIGHT at once, so the backlog —
+    # not the arrival tempo — must be the limiter
+    arrivals = np.zeros(n)
+
+    def requests():
+        return [Request(request_id=i, prompt_ids=prompts[i],
+                        max_new_tokens=args.max_new_tokens)
+                for i in range(n)]
+
+    def measure(model, paged):
+        kw = dict(page_size=page, num_pages=budget_pages) if paged else {}
+        # warm every compiled phase on a throwaway engine (same model ⇒
+        # shared compiled-fn caches) so compile time never pollutes TTFT
+        warm = ServingEngine(model, registry=MetricRegistry(), **kw)
+        warm.submit(Request(request_id=-1,
+                            prompt_ids=rs.randint(1, cfg.vocab_size,
+                                                  size=L).tolist(),
+                            max_new_tokens=min(2, args.max_new_tokens)))
+        warm.run_until_complete(max_steps=1000)
+        warm.close()
+        del warm  # its device KV must not double the measured HBM footprint
+        engine = ServingEngine(model, registry=MetricRegistry(), **kw)
+        outputs, wall, peak = _drive_workload(engine, arrivals, requests())
+        snap = engine.registry.snapshot()
+        total_tokens = sum(len(o.token_ids) for o in outputs.values())
+        ttfts = [o.ttft_ms for o in outputs.values() if o.ttft_ms is not None]
+        inter = [ms for o in outputs.values() for ms in o.intertoken_ms]
+        rec = {
+            "metric": "serving_paged",
+            "mode": "paged" if paged else "contiguous",
+            "hbm_budget_pages": budget_pages,
+            "page_size": page,
+            "slots": model.config.batch_size,
+            "num_requests": n,
+            "max_concurrent": peak,
+            "finished": sum(1 for o in outputs.values()
+                            if o.state == "finished"),
+            "ttft_ms": _percentiles(ttfts),
+            "intertoken_ms": _percentiles(inter),
+            "goodput_tok_s": total_tokens / max(wall, 1e-9),
+            "wall_s": round(wall, 4),
+        }
+        if paged:
+            hits = snap.get("kvcache/prefix_hits_total", 0.0)
+            misses = snap.get("kvcache/prefix_misses_total", 0.0)
+            rec["prefix_hit_rate"] = (
+                round(hits / (hits + misses), 4) if hits + misses else None)
+            rec["prefills_skipped"] = snap.get(
+                "kvcache/prefill_skipped_total", 0.0)
+            rec["evictions"] = snap.get("kvcache/evictions_total", 0.0)
+        return rec
+
+    base = {"config": {"batch": B, "context": C, "max_total": T,
+                       "max_new": args.max_new_tokens}}
+    rec_c = measure(model_c, paged=False)
+    print(json.dumps({**rec_c, **base}))
+    rec_p = measure(model_p, paged=True)
+    print(json.dumps({**rec_p, **base}))
+    if rec_p["max_concurrent"] <= rec_c["max_concurrent"]:
+        print(f"serve_bench: paged sustained {rec_p['max_concurrent']} "
+              f"concurrent <= contiguous {rec_c['max_concurrent']} at the "
+              "same HBM budget", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--tiny", action="store_true", help="CPU smoke config")
@@ -135,6 +273,15 @@ def main() -> int:
     p.add_argument("--continuous", action="store_true",
                    help="continuous-batching mode: Poisson arrivals through "
                         "serving.ServingEngine vs the static generate baseline")
+    p.add_argument("--paged", action="store_true",
+                   help="paged-KV mode: paged vs contiguous engines at the "
+                        "same HBM budget on a shared-system-prompt workload "
+                        "(one JSON line each)")
+    p.add_argument("--page-size", type=int, default=8,
+                   help="KV page size in tokens (paged mode; must divide "
+                        "context/total lengths)")
+    p.add_argument("--paged-slots", type=int, default=None,
+                   help="paged engine slot count (default: 2x --batch-size)")
     p.add_argument("--num-requests", type=int, default=16)
     p.add_argument("--arrival-rate", type=float, default=20.0,
                    help="Poisson arrival rate, requests/s")
@@ -176,6 +323,12 @@ def main() -> int:
         args.batch_size = 3
         print("serve_bench: --continuous with --batch-size 1 is a serial "
               "run; using batch size 3", file=sys.stderr)
+    if args.paged and args.batch_size == 1:
+        # a 1-slot contiguous baseline is degenerate for a concurrency
+        # comparison (and its 1-row budget leaves the pool no headroom)
+        args.batch_size = 2
+        print("serve_bench: --paged with --batch-size 1 is a serial "
+              "baseline; using batch size 2", file=sys.stderr)
 
     if args.tiny:
         cfg = LlamaConfig.tiny(max_seq_len=args.max_total_len,
@@ -209,6 +362,8 @@ def main() -> int:
         max_total_len=args.max_total_len,
         kv_cache_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
     )
+    if args.paged:
+        return run_paged(args, module, params, cfg, icfg)
     model = ParallelInferenceModel(module, params, icfg)
     n_params = sum(int(x.size) for x in jax.tree.leaves(params))
     base = {

@@ -116,6 +116,11 @@ def run_extra_jobs(results_path: str) -> None:
     jobs = [
         ("tp_allreduce", [sys.executable, os.path.join(REPO, "tools", "ici_bench.py")]),
         ("serving_latency", [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")]),
+        # paged vs contiguous KV at a fixed HBM budget (kvcache/ subsystem):
+        # max concurrency, TTFT/inter-token percentiles, prefix-hit rate
+        ("serving_paged", [sys.executable,
+                           os.path.join(REPO, "tools", "serve_bench.py"),
+                           "--paged"]),
         # standalone kernel programs compile fast: block-size evidence fits
         # any window even when the full train step's compile does not
         ("flash_autotune", [sys.executable,
