@@ -23,6 +23,12 @@ framework-native analogue of the reference's
   # continuous-batching serving demo (Poisson arrivals, streamed tokens)
   python examples/inference/runner.py serve --preset tiny --batch-size 3 \
       --context-len 16 --max-total-len 32 --num-requests 6 --rate 50
+
+  # batched speculative serving over paged KV (--draft equal to --preset
+  # is the draft == target control: acceptance 1.0, tokens/step ~ k+1)
+  python examples/inference/runner.py serve --preset tiny --batch-size 3 \
+      --context-len 16 --max-total-len 64 --page-size 8 \
+      --draft tiny --spec-k 4 --num-requests 6
 """
 
 import argparse
@@ -216,6 +222,16 @@ def cmd_serve(args):
         num_pages = args.num_pages or (
             args.batch_size * (args.max_total_len // args.page_size) + 1)
         paged_kw = dict(page_size=args.page_size, num_pages=num_pages)
+    if args.draft:
+        # speculative serving: a co-batched draft proposes --spec-k tokens
+        # per slot per step, the target verifies them in one batched chunk.
+        # The draft preset shares the target's seed, so `--draft` equal to
+        # `--preset` is the draft == target control (acceptance 1.0).
+        if not args.page_size:
+            raise SystemExit("--draft needs --page-size: speculative "
+                             "serving runs over the paged KV cache")
+        _, _, _, draft = build_model(args, preset=args.draft)
+        paged_kw.update(draft=draft, spec_k=args.spec_k)
     engine = ServingEngine(
         model, rng=jax.random.PRNGKey(args.seed), stats_path=args.stats_out,
         **paged_kw)
@@ -256,6 +272,15 @@ def cmd_serve(args):
         summary["prefix_hits"] = int(snap.get("kvcache/prefix_hits_total", 0))
         summary["prefills_skipped"] = int(
             snap.get("kvcache/prefill_skipped_total", 0))
+    if args.draft:
+        proposed = snap.get("serving/spec_proposed_total", 0.0)
+        rounds = snap.get("serving/spec_rounds_total", 0.0)
+        summary["tokens_per_step"] = (
+            round(snap.get("serving/spec_committed_total", 0.0) / rounds, 4)
+            if rounds else None)
+        summary["acceptance_rate"] = (
+            round(snap.get("serving/spec_accepted_total", 0.0) / proposed, 4)
+            if proposed else None)
     print(json.dumps(summary))
 
 
@@ -354,6 +379,14 @@ def main():
                          "contiguous engine's batch*total footprint + the "
                          "reserved NULL page; smaller pools trade HBM for "
                          "admission backpressure)")
+    sp.add_argument("--draft", default=None,
+                    help="enable speculative serving with this draft-model "
+                         "preset (same family/seed as the target, so a "
+                         "preset equal to --preset is the draft == target "
+                         "control); needs --page-size")
+    sp.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens proposed per slot per round "
+                         "(speculative serving; requires --draft)")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("spec-decode", help="speculative decoding: verify + time vs plain greedy")

@@ -27,7 +27,7 @@ a fresh page (the caller device-copies the content and writes the copy).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from neuronx_distributed_tpu.utils.logger import get_logger
 
@@ -138,6 +138,24 @@ class BlockAllocator:
         else:
             self._refs[page] = rc - 1
         self.version += 1
+
+    def free_tail(self, pages: "Iterable[int]") -> int:
+        """Release a whole TAIL of page references in one call — the
+        speculative-decoding rollback path: a rejected draft tail (or a
+        terminal slot's worst-case overshoot reservation) rolls back by
+        refcount alone, no device copy.  NULL pages in the list are skipped
+        (block-table holes ride through uniformly).  Returns how many pages
+        actually returned to the free list (shared prefix pages only
+        decref).  Each drop is the same accounting as :meth:`free`, so the
+        no-leak/no-double-free invariants hold unchanged."""
+        freed = 0
+        for p in pages:
+            if p == NULL_PAGE:
+                continue
+            exclusive = self._refs.get(p) == 1
+            self.free(p)
+            freed += int(exclusive)
+        return freed
 
     def cow(self, page: int) -> Tuple[int, bool]:
         """Copy-on-write: make ``page`` writable for a caller holding one

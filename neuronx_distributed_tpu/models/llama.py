@@ -414,15 +414,14 @@ class LlamaAttention(nn.Module):
                 # paged decode (kvcache/ subsystem): the cache is the global
                 # page pool [NP, page, NKV, D] and block_table [B, PP] maps
                 # each slot's logical pages to physical ones.  Scatter the
-                # new token into its physical (page, in-page) cell, then
-                # gather the row's chain back into the same [B, T, NKV, D]
-                # view the contiguous path attends over — the band-mask core
-                # below is untouched, so paged decode is value-identical to
-                # the per-slot contiguous decode.  Single-token steps only.
-                if k.shape[1] != 1:
-                    raise ValueError(
-                        "the block-table decode path supports single-token "
-                        f"steps only, got {k.shape[1]} new positions")
+                # S new tokens into their physical (page, in-page) cells —
+                # token s of slot b lands at logical index offset[b] + s —
+                # then gather the row's chain back into the same
+                # [B, T, NKV, D] view the contiguous path attends over; the
+                # band-mask core below is untouched, so paged decode is
+                # value-identical to the per-slot contiguous decode.  S == 1
+                # is the serving decode step; S == k+1 is the speculative
+                # verification chunk.
                 if jnp.ndim(cache_offset) != 1:
                     raise ValueError(
                         "the block-table decode path needs per-slot offsets "
@@ -430,17 +429,18 @@ class LlamaAttention(nn.Module):
                 NP, page = ck.shape[0], ck.shape[1]
                 PP = block_table.shape[1]
                 T = PP * page
-                page_idx = jnp.clip(cache_offset // page, 0, PP - 1)
-                in_off = cache_offset % page
-                phys = jnp.take_along_axis(
-                    block_table, page_idx[:, None], axis=1)[:, 0]
+                Sn = k.shape[1]
+                idx = cache_offset[:, None] + jnp.arange(Sn)[None, :]  # [B, Sn]
+                page_idx = jnp.clip(idx // page, 0, PP - 1)
+                in_off = idx % page
+                phys = jnp.take_along_axis(block_table, page_idx, axis=1)
                 # a parked slot (offset >= T) writes nothing: route it out of
                 # range and let the scatter drop it
-                phys = jnp.where(cache_offset < T, phys, NP)
+                phys = jnp.where(idx < T, phys, NP)
                 ck = ck.at[phys, in_off].set(
-                    k[:, 0].astype(ck.dtype), mode="drop")
+                    k.astype(ck.dtype), mode="drop")
                 cv = cv.at[phys, in_off].set(
-                    v[:, 0].astype(cv.dtype), mode="drop")
+                    v.astype(cv.dtype), mode="drop")
             elif jnp.ndim(cache_offset) == 1:
                 # per-example write positions [B] (continuous batching: every
                 # slot decodes at its own offset).  Single-token steps only —

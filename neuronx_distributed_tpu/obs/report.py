@@ -188,6 +188,34 @@ def _summarize_kvcache(scalars: Dict[str, dict]) -> Optional[dict]:
     }
 
 
+def _summarize_speculative(scalars: Dict[str, dict]) -> Optional[dict]:
+    """Speculative-decoding health from the ``serving/spec_*_total``
+    counters: draft acceptance rate (accepted/proposed — draft quality) and
+    committed tokens per engine round (the tokens-per-step headline — the
+    whole point of speculating is pushing it past 1).  None when the run
+    served no speculative engine."""
+    proposed = scalars.get("serving/spec_proposed_total")
+    if proposed is None or not proposed.get("last"):
+        return None
+
+    def last(tag):
+        s = scalars.get(tag)
+        return s["last"] if s else 0.0
+
+    p = proposed["last"]
+    a = last("serving/spec_accepted_total")
+    rounds = last("serving/spec_rounds_total")
+    committed = last("serving/spec_committed_total")
+    return {
+        "proposed": p,
+        "accepted": a,
+        "acceptance_rate": round(a / p, 4) if p else None,
+        "rounds": rounds,
+        "committed": committed,
+        "tokens_per_round": round(committed / rounds, 4) if rounds else None,
+    }
+
+
 def _summarize_timeline(paths: Sequence[str]) -> dict:
     events = instants = 0
     dur_by_name: Dict[str, float] = {}
@@ -277,6 +305,7 @@ def build_report(
     host_blocked = _summarize_host_blocked(histograms)
     scalars = _summarize_scalars(scalar_records, frozenset(histograms))
     kvcache = _summarize_kvcache(scalars)
+    speculative = _summarize_speculative(scalars)
     report = {
         "schema": OBS_REPORT_SCHEMA,
         "generated_at": time.time(),
@@ -299,6 +328,7 @@ def build_report(
             "anomaly_count": len(anomalies),
             "host_blocked": host_blocked,
             "kvcache": kvcache,
+            "speculative": speculative,
             "total_collective_count": sum(
                 a.get("total_collective_count", 0) for a in audits),
             "total_collective_bytes": sum(
@@ -335,6 +365,15 @@ def render_markdown(report: dict) -> str:
             f"{kv['prefills_skipped']:.0f} prefills skipped, "
             f"{kv['evictions']:.0f} evictions, "
             f"{kv['cow_copies']:.0f} cow copies")
+    spec = h.get("speculative")
+    if spec:
+        rate = (f"{spec['acceptance_rate']:.1%} acceptance"
+                if spec["acceptance_rate"] is not None else "no proposals")
+        tps = (f"{spec['tokens_per_round']:.2f} tokens/step"
+               if spec["tokens_per_round"] is not None else "no rounds")
+        lines.append(
+            f"- speculative: {tps} over {spec['rounds']:.0f} rounds; {rate} "
+            f"({spec['accepted']:.0f}/{spec['proposed']:.0f} draft tokens)")
     lines.append("")
 
     sup = report.get("supervisor")

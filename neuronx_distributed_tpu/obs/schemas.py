@@ -41,12 +41,17 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "tpu_watch": {"ts": str, "kind": str},
     # one line of serving_stats.jsonl (serving.engine.ServingEngine) —
     # one record per TERMINAL request; ttft_ms is null for requests that
-    # never produced a token (cancelled/timed out while queued)
+    # never produced a token (cancelled/timed out while queued).  v2 adds
+    # the speculative-decoding accounting: draft tokens proposed/accepted
+    # for the request and its acceptance rate (null when the engine never
+    # speculated for it — including every non-spec engine)
     "serving_stats": {
         "schema": str, "time": _NUM, "request_id": int, "state": str,
         "finish_reason": (str, type(None)), "prompt_len": int,
         "new_tokens": int, "queue_ms": _NUM,
         "ttft_ms": (int, float, type(None)), "total_ms": _NUM,
+        "spec_proposed": int, "spec_accepted": int,
+        "acceptance_rate": (int, float, type(None)),
     },
     # one line of supervisor_events.jsonl (resilience.supervisor.Supervisor)
     # — events: start / exit / restart / giveup / success; extra keys carry
@@ -98,6 +103,13 @@ REGISTRY_METRICS: Dict[str, str] = {
     "kvcache/prefill_skipped_total": "counter",
     "kvcache/cow_copies_total": "counter",
     "kvcache/evictions_total": "counter",
+    # serving speculative decoding (serving.engine draft-k-verify rounds):
+    # proposed/accepted measure draft quality, committed/rounds is the
+    # tokens-per-step headline
+    "serving/spec_proposed_total": "counter",
+    "serving/spec_accepted_total": "counter",
+    "serving/spec_committed_total": "counter",
+    "serving/spec_rounds_total": "counter",
 }
 
 

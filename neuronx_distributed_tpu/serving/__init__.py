@@ -25,6 +25,14 @@ Paged KV mode (kvcache PR): ``ServingEngine(page_size=, num_pages=)`` swaps
 the per-slot contiguous KV reservation for the :mod:`~..kvcache` page pool —
 :mod:`.paged`'s :class:`PagedKVManager` owns block tables, page budgeting,
 prefix-cache reuse, and terminal-state reclamation.
+
+Speculative decoding (spec PR): ``ServingEngine(draft=, spec_k=)`` (paged
+mode only) turns every decode step into a batched per-slot draft-k-verify
+round — multi-token commit through one target verification forward,
+rejected tails rolled back by page accounting, greedy output
+token-identical to the plain engine, sampled output exactly distributed as
+plain sampling via the residual-distribution correction, acceptance-rate
+telemetry per request.
 """
 
 from neuronx_distributed_tpu.kvcache.allocator import PoolExhausted

@@ -68,12 +68,18 @@ from neuronx_distributed_tpu.serving.scheduler import (
     BackpressureError,
     SlotScheduler,
 )
-from neuronx_distributed_tpu.trace.engine import _sample_logits, request_rng
+from neuronx_distributed_tpu.trace.engine import (
+    SPEC_ACCEPT_SALT,
+    SPEC_RESIDUAL_SALT,
+    _filtered_logits,
+    _sample_logits,
+    request_rng,
+)
 from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
-SERVING_STATS_SCHEMA = "serving_stats/1"
+SERVING_STATS_SCHEMA = "serving_stats/2"
 
 FAIL_NON_FINITE = "non_finite_logits"
 
@@ -99,6 +105,96 @@ def _sample_rows(logits, base_keys, tok_idx, temperature, top_k, top_p):
         return tok, jnp.all(jnp.isfinite(lg.astype(jnp.float32)))
 
     return jax.vmap(row)(logits, base_keys, tok_idx, temperature, top_k, top_p)
+
+
+@jax.jit
+def _propose_rows(logits, base_keys, tok_idx, temperature, top_k, top_p):
+    """Row-wise draft proposal: exactly :func:`_sample_rows`'s draw (same
+    per-request ``fold_in(base_keys[b], tok_idx[b])`` stream, so with
+    ``draft == target`` the proposals ARE the plain-sampling tokens), but
+    additionally returns the per-row FILTERED draft logits — the q
+    distribution the proposal was drawn from, which the speculative accept
+    test needs verbatim."""
+    def row(lg, key, idx, t, k, p):
+        qf = _filtered_logits(lg, t, k, p)
+        tok = _sample_logits(lg, jax.random.fold_in(key, idx), t, k, p)
+        return tok, qf, jnp.all(jnp.isfinite(lg.astype(jnp.float32)))
+
+    return jax.vmap(row)(logits, base_keys, tok_idx, temperature, top_k, top_p)
+
+
+@jax.jit
+def _spec_accept(vlogits, q_filt, props, base_keys, tok_idx, temperature,
+                 top_k, top_p, draft_finite):
+    """Per-slot draft-k-verify accept/commit for one speculative round, all
+    on device — the batched (per-slot, no lockstep) twin of the solo
+    ``speculative_generate`` round.
+
+    ``vlogits [B, S=k+1, V]`` are the target's raw verification logits
+    (position ``i`` judges proposal ``i+1`` — the shifted-logits trick);
+    ``q_filt [B, k, V]`` the filtered draft distributions; ``props [B, k]``
+    the proposals; ``tok_idx [B]`` the generated-token index of each slot's
+    first proposal.  Greedy rows accept while the target argmax agrees and
+    take the target's token at the first disagreement (or the bonus
+    position); sampled rows run the standard Leviathan et al. accept/reject
+    — accept with prob ``min(1, p/q)`` on per-token salted coins, resample
+    the first rejection from the residual ``norm(max(p - q, 0))`` — so
+    ``draft == target`` accepts everything and reproduces plain sampling
+    bit-for-bit.
+
+    Returns the round's ENTIRE device→host payload packed as one
+    ``[k+3, B]`` int32 array: rows ``0..k`` the candidate commit tokens
+    (proposals 0..a-1 then the corrective/bonus token at row ``a``; rows
+    past ``a`` are garbage the host ignores), row ``k+1`` the accept count
+    ``a``, row ``k+2`` the per-slot finite flag (target AND draft)."""
+    K = props.shape[1]
+
+    def row(pl, qf, pr, key, idx, t, tk, tp):
+        finite = jnp.all(jnp.isfinite(pl.astype(jnp.float32)))
+        greedy = jnp.argmax(pl, axis=-1).astype(jnp.int32)  # [K+1]
+        pf = _filtered_logits(pl, t, tk, tp)                # [K+1, V]
+        p_probs = jax.nn.softmax(pf[:K], axis=-1)           # [K, V]
+        q_probs = jax.nn.softmax(qf, axis=-1)               # [K, V]
+        px = jnp.take_along_axis(p_probs, pr[:, None], axis=-1)[:, 0]
+        qx = jnp.take_along_axis(q_probs, pr[:, None], axis=-1)[:, 0]
+        coin_keys = jax.vmap(lambda j: jax.random.fold_in(
+            jax.random.fold_in(key, SPEC_ACCEPT_SALT), idx + j)
+        )(jnp.arange(K, dtype=jnp.int32))
+        u = jax.vmap(jax.random.uniform)(coin_keys)         # [K]
+        acc_sampled = u < jnp.minimum(1.0, px / jnp.maximum(qx, 1e-20))
+        acc_greedy = greedy[:K] == pr
+        accept = jnp.where(t > 0.0, acc_sampled, acc_greedy)
+        lead = jnp.cumprod(accept.astype(jnp.int32))
+        a = jnp.sum(lead).astype(jnp.int32)  # leading accepts, 0..K
+        # position a's extra token: residual resample on a rejection,
+        # one fresh target draw on a full accept (a == K)
+        p_a = jnp.take(p_probs, jnp.minimum(a, K - 1), axis=0)
+        q_a = jnp.take(q_probs, jnp.minimum(a, K - 1), axis=0)
+        res = jnp.maximum(p_a - q_a, 0.0)
+        res_sum = jnp.sum(res)
+        # degenerate all-zero residual (p <= q everywhere off the sample)
+        # falls back to p itself — both are exact draws from p
+        dist = jnp.where(res_sum > 0, res / jnp.maximum(res_sum, 1e-20), p_a)
+        corr_sampled = jax.random.categorical(
+            jax.random.fold_in(
+                jax.random.fold_in(key, SPEC_RESIDUAL_SALT), idx + a),
+            jnp.log(jnp.maximum(dist, 1e-20))).astype(jnp.int32)
+        # full-accept bonus: straight from p_K with the plain-sampling
+        # token-index key — bit-identical to the non-speculative draw
+        bonus_sampled = jax.random.categorical(
+            jax.random.fold_in(key, idx + K), pf[K]).astype(jnp.int32)
+        sampled_extra = jnp.where(a == K, bonus_sampled, corr_sampled)
+        extra = jnp.where(t > 0.0, sampled_extra, jnp.take(greedy, a))
+        commit = jnp.concatenate(
+            [pr, jnp.zeros((1,), jnp.int32)]).at[a].set(extra)
+        return commit, a, finite
+
+    commit, acc, finite = jax.vmap(row)(
+        vlogits, q_filt, props, base_keys, tok_idx, temperature, top_k, top_p)
+    finite = jnp.logical_and(finite, draft_finite)
+    return jnp.concatenate(
+        [commit.T.astype(jnp.int32), acc[None, :].astype(jnp.int32),
+         finite[None, :].astype(jnp.int32)], axis=0)
 
 
 @jax.jit
@@ -214,6 +310,21 @@ class ServingEngine:
     to the contiguous engine (same band-mask attention over the gathered
     page view — parity-tested); ``kvcache/*`` metrics (pool occupancy,
     prefix hit/miss, evictions) export through the registry.
+
+    Speculative decoding (spec PR): ``draft=`` (a second
+    ``ParallelInferenceModel`` sharing the target's tokenizer and serving
+    shapes) + ``spec_k=`` turn every decode step into a batched per-slot
+    draft-k-verify round — the serving generalization of the solo
+    ``trace.speculative_generate``.  Paged mode only: accepted tokens
+    scatter into block-table pages through the verify step itself, rejected
+    tails roll back by host-side offset rewind against the worst-case
+    ``spec_k``-token page reservation made at admission (no device copy),
+    and stop tokens are detected inside an accepted run.  Greedy output is
+    token-identical to the non-speculative engine; sampled acceptance uses
+    the standard residual-distribution correction, so ``draft == target``
+    reproduces plain sampling bit-for-bit.  Per-request acceptance rates
+    land in ``serving_stats.jsonl`` and the ``serving/spec_*_total``
+    counters (committed/rounds is the tokens-per-step headline).
     """
 
     def __init__(
@@ -233,11 +344,15 @@ class ServingEngine:
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         prefix_cache: bool = True,
+        draft: Any = None,
+        spec_k: int = 0,
     ):
         attrs = ("prefill_one", "insert_slot", "decode_slots")
         if page_size is not None:
             attrs += ("decode_pages", "write_page", "insert_valid",
                       "make_page_pool")
+        if spec_k:
+            attrs += ("verify_pages",)
         for attr in attrs:
             if not hasattr(model, attr):
                 raise TypeError(
@@ -250,6 +365,46 @@ class ServingEngine:
         self.B = cfg.batch_size
         self.C = cfg.context_len
         self.T = cfg.max_total_len
+        # speculative decoding (draft-k-verify): a co-batched draft model
+        # proposes spec_k tokens per slot per round, one batched target
+        # verification scores them all, accepted runs commit multi-token
+        if (draft is None) != (spec_k == 0):
+            raise ValueError(
+                "speculative decoding needs BOTH draft= and spec_k= (got "
+                f"draft={'set' if draft is not None else 'None'}, "
+                f"spec_k={spec_k})")
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        self._spec_k = int(spec_k)
+        self._draft_model = draft
+        if spec_k:
+            if page_size is None:
+                raise ValueError(
+                    "speculative serving runs over the paged KV cache "
+                    "(rejected tails roll back by page accounting): pass "
+                    "page_size=/num_pages= alongside draft=/spec_k=")
+            for attr in ("prefill_one", "insert_slot", "decode_slots",
+                         "empty_caches"):
+                if not hasattr(draft, attr):
+                    raise TypeError(
+                        f"draft {type(draft).__name__} has no {attr!r}: the "
+                        "draft needs the same per-slot serving surface as "
+                        "the target")
+            dcfg = draft.config
+            for f in ("batch_size", "context_len", "max_total_len"):
+                if getattr(dcfg, f) != getattr(cfg, f):
+                    raise ValueError(
+                        f"target/draft serving shapes differ on {f}: "
+                        f"{getattr(cfg, f)} vs {getattr(dcfg, f)}")
+            tv = getattr(getattr(model, "module", None), "config", None)
+            dv = getattr(getattr(draft, "module", None), "config", None)
+            if (tv is not None and dv is not None
+                    and getattr(tv, "vocab_size", None)
+                    != getattr(dv, "vocab_size", None)):
+                raise ValueError(
+                    f"target/draft vocab_size differ ({tv.vocab_size} vs "
+                    f"{dv.vocab_size}): speculative decoding needs one "
+                    "shared tokenizer")
         self.obs = obs
         if registry is None and obs is not None:
             registry = obs.registry
@@ -271,10 +426,11 @@ class ServingEngine:
             self._kv = PagedKVManager(
                 num_slots=self.B, context_len=self.C, max_total_len=self.T,
                 page_size=page_size, num_pages=num_pages,
-                registry=self.registry, prefix_cache=prefix_cache)
+                registry=self.registry, prefix_cache=prefix_cache,
+                spec_overshoot=self._spec_k)
         self.scheduler = SlotScheduler(
             self.B, self.C, self.T, max_queue=max_queue,
-            page_gate=self._kv)
+            page_gate=self._kv, reserve_extra=self._spec_k)
         self.step_timeout_s = step_timeout_s
         self._steps = 0
         if transfer_guard not in ("off", "forbid"):
@@ -324,6 +480,13 @@ class ServingEngine:
         else:
             self.caches = model.empty_caches()
         self.valid = jnp.zeros((self.B, self.T), jnp.int32)
+        # the draft's KV state stays CONTIGUOUS [B, T]: its rollback is free
+        # (rejected slots sit past the rewound offset, index-based causal
+        # masking hides them, the next round overwrites them) so it needs no
+        # page accounting — only the target's paged pool does
+        if self._spec_k:
+            self._draft_caches = draft.empty_caches()
+            self._draft_valid = jnp.zeros((self.B, self.T), jnp.int32)
         self._offsets = np.full((self.B,), self.T, np.int32)  # T = parked
         self._next_tok = np.zeros((self.B,), np.int32)
         self._last_tok_time: List[Optional[float]] = [None] * self.B
@@ -347,6 +510,12 @@ class ServingEngine:
         for c in ("admitted", "finished", "cancelled", "timed_out", "tokens",
                   "rejected", "failed", "slow_steps"):
             reg.counter(f"serving/{c}_total")
+        if self._spec_k:
+            # speculative throughput accounting: committed/rounds is the
+            # tokens-per-step headline, accepted/proposed the draft quality
+            for c in ("spec_proposed", "spec_accepted", "spec_committed",
+                      "spec_rounds"):
+                reg.counter(f"serving/{c}_total")
 
     # -- request surface ---------------------------------------------------
 
@@ -405,18 +574,23 @@ class ServingEngine:
         for slot, req in self.scheduler.admit(now):
             self._prefill_into_slot(slot, req, outputs)
 
-        # 3) decode
+        # 3) decode: one single-token batched step, or — speculative mode —
+        # one draft-k-verify round committing up to k+1 tokens per slot
         if self.async_decode:
             # pipelined: collect the in-flight step's packed results (one
             # explicit fetch + cheap stop detection), dispatch the next
             # decode, THEN run the collected step's host-side work (stream
             # callbacks, telemetry, stats) while the device computes
             with self._audit.section("serving/decode"):
-                post = self._collect_decode()
+                post = (self._spec_collect() if self._spec_k
+                        else self._collect_decode())
                 active = [(slot, req) for slot, req in self.scheduler.active()
                           if req.state is RequestState.DECODE]
                 if active:
-                    self._dispatch_decode(active)
+                    if self._spec_k:
+                        self._spec_dispatch(active)
+                    else:
+                        self._dispatch_decode(active)
             self._finish_decode(post, outputs)
         else:
             # synchronous reference engine: one fully-processed decode per
@@ -424,7 +598,11 @@ class ServingEngine:
             active = [(slot, req) for slot, req in self.scheduler.active()
                       if req.state is RequestState.DECODE]
             if active:
-                self._decode_step(active, outputs)
+                if self._spec_k:
+                    self._spec_dispatch(active)
+                    self._finish_decode(self._spec_collect(), outputs)
+                else:
+                    self._decode_step(active, outputs)
 
         self.registry.gauge("serving/queue_depth").set(self.scheduler.queue_depth)
         self.registry.gauge("serving/slots_active").set(self.scheduler.active_count)
@@ -546,6 +724,18 @@ class ServingEngine:
                              request_id=req.request_id, engine_step=self._steps)
             self.caches, self.valid = self.model.insert_slot(
                 self.caches, row_caches, self.valid, row_valid, slot)
+
+        if self._spec_k:
+            # the draft prefills the same prompt into its own contiguous
+            # slot row — it runs even on a target prefix-cache hit (the
+            # draft's KV is not paged/shared), and its row is simply
+            # overwritten at the next insert if this admission fails
+            _, drow_caches = self._draft_model.prefill_one(
+                jnp.asarray(ids), valid_ctx)
+            self._draft_caches, self._draft_valid = \
+                self._draft_model.insert_slot(
+                    self._draft_caches, drow_caches, self._draft_valid,
+                    row_valid, slot)
 
         s = req.sampling
         if s.temperature > 0.0 and self._rng is not None:
@@ -724,11 +914,167 @@ class ServingEngine:
             self._temps_dev, self._topks_dev, self._topps_dev)
         self._pending = (_pack_tokens(toks, finite), list(active))
 
+    def _spec_dispatch(self, active: list) -> None:
+        """Dispatch one speculative draft-k-verify round for the current
+        active set and leave the packed ``[k+3, B]`` result in flight.
+
+        The draft proposes ``k`` tokens per slot (k batched single-token
+        decodes on its contiguous caches, sampling from the same
+        per-request streams as the plain engine), the target scores the
+        whole ``[B, k+1]`` chunk in ONE ``verify_pages`` call that also
+        scatters the chunk into the paged pool, and the accept/commit math
+        runs on device (:func:`_spec_accept`) so the round's device→host
+        traffic stays ONE packed fetch — the ``[2, B]`` single-token payload
+        widened to ``[k+3, B]``.  All per-round host→device traffic stages
+        as the same ONE packed explicit put as the plain path (per-step
+        draft offsets and token indices are precomputed host-side as
+        ``[k, B]`` arrays — no eager scalar arithmetic for the transfer
+        guard to reject)."""
+        k = self._spec_k
+        tok_idx = np.zeros((self.B,), np.int32)
+        for slot, req in active:
+            tok_idx[slot] = len(req.generated)
+        offs_steps = self._offsets[None, :] + np.arange(k, dtype=np.int32)[:, None]
+        tidx_steps = tok_idx[None, :] + np.arange(k, dtype=np.int32)[:, None]
+        staged = [self._next_tok[:, None].copy(), self._offsets.copy(),
+                  tok_idx, offs_steps, tidx_steps]
+        if self._kv.tables_dirty or self._tables_dev is None:
+            staged.append(self._kv.tables.copy())
+            tok, offs, tidx, offs_j, tidx_j, self._tables_dev = \
+                self._audit.put(tuple(staged))
+            self._kv.tables_dirty = False
+        else:
+            tok, offs, tidx, offs_j, tidx_j = self._audit.put(tuple(staged))
+        if self._sampling_dirty:
+            self._keys_dev, self._temps_dev, self._topks_dev, \
+                self._topps_dev = self._audit.put(
+                    (self._base_keys.copy(), self._temps.copy(),
+                     self._topks.copy(), self._topps.copy()))
+            self._sampling_dirty = False
+        draft = self._draft_model
+        dtok = tok
+        props, q_filts, dfin = [], [], None
+        for j in range(k):
+            dlogits, self._draft_caches, self._draft_valid = \
+                draft.decode_slots(dtok, offs_j[j], self._draft_caches,
+                                   self._draft_valid)
+            dlogits = perturb("serving/draft_logits", dlogits,
+                              engine_step=self._steps, round_pos=j)
+            ptoks, qf, fin = _propose_rows(
+                dlogits, self._keys_dev, tidx_j[j], self._temps_dev,
+                self._topks_dev, self._topps_dev)
+            props.append(ptoks)
+            q_filts.append(qf)
+            dfin = fin if dfin is None else jnp.logical_and(dfin, fin)
+            dtok = ptoks[:, None]
+        chunk = jnp.concatenate([tok] + [t[:, None] for t in props], axis=1)
+        vlogits, self.caches, self.valid = self.model.verify_pages(
+            chunk, offs, self._tables_dev, self.caches, self.valid)
+        vlogits = perturb("serving/verify_logits", vlogits,
+                          engine_step=self._steps)
+        packed = _spec_accept(
+            vlogits, jnp.stack(q_filts, axis=1), jnp.stack(props, axis=1),
+            self._keys_dev, tidx, self._temps_dev, self._topks_dev,
+            self._topps_dev, dfin)
+        self._pending = (packed, list(active), props[-1])
+
+    def _spec_collect(self) -> list:
+        """Collect the in-flight speculative round: ONE explicit packed
+        fetch, then per-slot commit — append the accepted run (clipped to
+        the request's remaining budget and cut at the first stop token),
+        advance the slot's write offset by exactly the committed length,
+        quarantine non-finite slots, and dispatch the draft catch-up write
+        for fully-accepted slots (the one proposal the draft sampled but
+        never wrote).
+
+        The offset rewind IS the rollback of a rejected tail: the verify
+        step wrote ``k+1`` tokens but only ``m`` stay committed; the tail
+        past ``offset + m`` sits in pages reserved at admission (pure
+        host-side accounting, no device copy), index-based causal masking
+        hides its stale keys, and later rounds overwrite them before any
+        query can attend that far."""
+        if self._pending is None:
+            return []
+        packed_dev, active, last_prop = self._pending
+        self._pending = None
+        k = self._spec_k
+        packed = self._audit.fetch(packed_dev, label="serving")  # [k+3, B]
+        commit, acc, finite = packed[:k + 1], packed[k + 1], packed[k + 2]
+        now = self._clock()
+        post: list = []
+        ingest = np.full((self.B,), self.T, np.int32)
+        need_ingest = False
+        reg = self.registry
+        for slot, req in active:
+            if req.state is not RequestState.DECODE:
+                continue  # swept while the round was in flight
+            if not finite[slot]:
+                self._fail_slot_state(slot, req, now)
+                post.append(("fail", slot, req, 0, None, now))
+                continue
+            a = int(acc[slot])
+            req.spec_proposed += k
+            req.spec_accepted += a
+            reg.counter("serving/spec_proposed_total").inc(k)
+            reg.counter("serving/spec_accepted_total").inc(a)
+            reg.counter("serving/spec_rounds_total").inc()
+            rem = req.max_new_tokens - len(req.generated)
+            plan = min(a + 1, rem)
+            last = self._last_tok_time[slot]
+            gap_ms = (now - last) * 1e3 if last is not None else None
+            toks: list = []
+            reason = None
+            for i in range(plan):
+                t = int(commit[i, slot])
+                req.generated.append(t)
+                toks.append(t)
+                reg.counter("serving/tokens_total").inc()
+                reason = self._stop_reason(req, t)
+                if reason is not None:
+                    break  # stop inside the accepted run: commit up to it
+            m = len(toks)
+            reg.counter("serving/spec_committed_total").inc(m)
+            self._offsets[slot] += m
+            self._last_tok_time[slot] = now
+            if reason is not None:
+                self._finish_request(slot, req, reason, now)
+            else:
+                self._next_tok[slot] = toks[-1]
+                if m == k + 1:
+                    # full accept, still decoding: the draft's own cache
+                    # never ingested its last proposal — catch it up so
+                    # draft positions stay aligned with the target's
+                    ingest[slot] = self._offsets[slot] - 1
+                    need_ingest = True
+            # the round's m tokens share its wall-clock gap evenly, so
+            # inter-token percentiles measure the effective per-token rate
+            per_tok_ms = gap_ms / m if (gap_ms is not None and m) else None
+            post.append(("tokens", slot, req, toks, per_tok_ms, now))
+        if need_ingest:
+            (ing_offs,) = self._audit.put((ingest,))
+            _, self._draft_caches, self._draft_valid = \
+                self._draft_model.decode_slots(
+                    last_prop[:, None], ing_offs, self._draft_caches,
+                    self._draft_valid)
+        return post
+
     def _finish_decode(self, post: list, outputs: list) -> None:
         """The collected step's deferred host work — stream callbacks,
         inter-token telemetry, terminal emission (stats serialization) —
         run while the next decode executes on the device."""
         for kind, slot, req, tok, ms, now in post:
+            if kind == "tokens":
+                # one speculative round's committed run (tok is a list)
+                for t in tok:
+                    if ms is not None:
+                        req.intertoken_ms.append(ms)
+                        self.registry.histogram(
+                            "serving/intertoken_ms", MS_BUCKETS).observe(ms)
+                    if req.stream_cb is not None:
+                        req.stream_cb(req, t)
+                if req.done:
+                    outputs.append(self._emit(req, now))
+                continue
             if kind == "fail":
                 logger.warning(
                     "serving: request %d failed (%s) after %d tokens — "
@@ -836,6 +1182,10 @@ class ServingEngine:
                 "queue_ms": out.queue_ms,
                 "ttft_ms": out.ttft_ms,
                 "total_ms": out.total_ms,
+                # speculative decoding accounting (zeros / null off spec)
+                "spec_proposed": out.spec_proposed,
+                "spec_accepted": out.spec_accepted,
+                "acceptance_rate": out.acceptance_rate,
             }
             self._stats_f.write(json.dumps(rec) + "\n")
             self._stats_f.flush()

@@ -64,7 +64,7 @@ class PagedKVManager:
 
     def __init__(self, *, num_slots: int, context_len: int, max_total_len: int,
                  page_size: int, num_pages: int, registry: Any = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, spec_overshoot: int = 0):
         if context_len % page_size != 0 or max_total_len % page_size != 0:
             raise ValueError(
                 f"page_size {page_size} must divide context_len "
@@ -77,6 +77,11 @@ class PagedKVManager:
         self.page_size = page_size
         self.pages_per_slot = max_total_len // page_size
         self.ctx_pages = context_len // page_size
+        # speculative decoding writes up to `spec_overshoot` tokens past a
+        # request's committed budget during verification (rejected tails are
+        # rolled back by offset rewind, never un-written) — the worst-case
+        # reservation must back those writes too
+        self.spec_overshoot = spec_overshoot
         self.registry = registry
         self.alloc = BlockAllocator(num_pages, registry=registry)
         self.index = (PrefixIndex(self.alloc, registry=registry)
@@ -101,11 +106,16 @@ class PagedKVManager:
     def pages_needed(self, req: Request) -> int:
         """Worst-case pages the request can hold at once: its non-padding
         prompt pages (no prefix-hit credit — hits only shrink the real
-        allocation) plus every decode page through ``max_new_tokens``."""
+        allocation) plus every decode page through ``max_new_tokens`` (and,
+        under speculative decoding, the ``spec_overshoot`` verification
+        tail — decode can never hit pool exhaustion mid-round)."""
         L = min(req.prompt_len, self.C)
         n_ctx = self.ctx_pages - (self.C - L) // self.page_size
-        n_dec = math.ceil(req.max_new_tokens / self.page_size)
-        return n_ctx + n_dec
+        return n_ctx + self._decode_pages_needed(req)
+
+    def _decode_pages_needed(self, req: Request) -> int:
+        return math.ceil(
+            (req.max_new_tokens + self.spec_overshoot) / self.page_size)
 
     def pages_free(self) -> int:
         """Pages an admission could use right now: the free list plus what
@@ -144,7 +154,7 @@ class PagedKVManager:
             # the NULL page (masked out of every attention) for free
             todo = [lp for lp in range(len(matched), self.ctx_pages)
                     if not is_padding_key(keys[lp])]
-            n_dec = math.ceil(req.max_new_tokens / self.page_size)
+            n_dec = self._decode_pages_needed(req)
             self._ensure_free(len(todo) + n_dec)
             ctx_fresh = self.alloc.alloc(len(todo))
             taken += ctx_fresh
@@ -198,13 +208,15 @@ class PagedKVManager:
     def release_slot(self, slot: int) -> None:
         """Drop every page reference the slot holds (exclusive pages return
         to the free list; shared prefix pages decref) and null its block
-        table.  Idempotent — terminal paths and the sweep's park can both
-        call it."""
+        table — one batch :meth:`~..kvcache.allocator.BlockAllocator.free_tail`
+        covering the committed chain, any rejected speculative tail, and the
+        worst-case overshoot reservation alike (host-side accounting only;
+        the device pages are never touched).  Idempotent — terminal paths
+        and the sweep's park can both call it."""
         pages = self._slot_pages[slot]
         if not pages and self._slot_keys[slot] is None:
             return
-        for p in pages:
-            self.alloc.free(p)
+        self.alloc.free_tail(pages)
         self._slot_pages[slot] = []
         self._slot_fresh[slot] = []
         self._slot_keys[slot] = None

@@ -100,6 +100,12 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     intertoken_ms: List[float] = dataclasses.field(default_factory=list)
+    # speculative-decoding accounting (engine-owned; zero on non-spec
+    # engines): draft tokens proposed / accepted for THIS request —
+    # verdict-level, so a token accepted but clipped by the output-length
+    # budget still counts (the rate measures draft quality, not the clip)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     def __post_init__(self):
         self.prompt_ids = [int(t) for t in self.prompt_ids]
@@ -149,6 +155,16 @@ class RequestOutput:
     ttft_ms: Optional[float]
     total_ms: float
     intertoken_ms: Tuple[float, ...] = ()
+    # speculative decoding: draft tokens proposed/accepted for this request;
+    # acceptance_rate is None when the engine never speculated for it
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+
+    @property
+    def acceptance_rate(self) -> Optional[float]:
+        if self.spec_proposed <= 0:
+            return None
+        return self.spec_accepted / self.spec_proposed
 
     @staticmethod
     def from_request(req: Request, now: float) -> "RequestOutput":
@@ -169,4 +185,6 @@ class RequestOutput:
                 if req.first_token_time is not None else None),
             total_ms=max(now - submit, 0.0) * 1e3,
             intertoken_ms=tuple(req.intertoken_ms),
+            spec_proposed=req.spec_proposed,
+            spec_accepted=req.spec_accepted,
         )

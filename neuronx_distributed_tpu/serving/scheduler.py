@@ -64,14 +64,22 @@ class SlotScheduler:
     """
 
     def __init__(self, num_slots: int, context_len: int, max_total_len: int,
-                 max_queue: Optional[int] = None, page_gate=None):
+                 max_queue: Optional[int] = None, page_gate=None,
+                 reserve_extra: int = 0):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if reserve_extra < 0:
+            raise ValueError(f"reserve_extra must be >= 0, got {reserve_extra}")
         self.num_slots = num_slots
         self.context_len = context_len
         self.max_total_len = max_total_len
+        # cache slots past max_new_tokens every request must leave free —
+        # speculative decoding's verification step writes up to spec_k
+        # tokens beyond the committed budget before rolling rejected tails
+        # back, so the envelope check must reserve them
+        self.reserve_extra = reserve_extra
         self.max_queue = max_queue
         self.page_gate = page_gate
         self._queue: deque = deque()
@@ -136,12 +144,18 @@ class SlotScheduler:
             raise AdmissionError(
                 f"request {request.request_id}: prompt_len "
                 f"{request.prompt_len} > context_len {self.context_len}")
-        if self.context_len + request.max_new_tokens > self.max_total_len:
+        if (self.context_len + request.max_new_tokens + self.reserve_extra
+                > self.max_total_len):
+            extra = (f" + {self.reserve_extra} spec reserve"
+                     if self.reserve_extra else "")
             raise AdmissionError(
-                f"request {request.request_id}: context_len + max_new_tokens "
-                f"({self.context_len} + {request.max_new_tokens}) > "
+                f"request {request.request_id}: context_len + max_new_tokens"
+                f" ({self.context_len} + {request.max_new_tokens}{extra}) > "
                 f"max_total_len {self.max_total_len} (decode slots start at "
-                "the prefill boundary)")
+                "the prefill boundary"
+                + ("; speculative verification writes up to spec_k tokens "
+                   "past the budget before rolling back" if
+                   self.reserve_extra else "") + ")")
         if self.page_gate is not None:
             need = self.page_gate.pages_needed(request)
             cap = self.page_gate.pages_capacity()

@@ -61,6 +61,20 @@ logger = get_logger(__name__)
 # serving process must not grow compile caches without limit
 COMPILED_CACHE_SIZE = 8
 
+# the serving phase-fn family is wider than the per-shape caches: paged +
+# contiguous phase fns plus one verify program per speculative chunk width
+# must coexist without evicting each other (an eviction on the serving hot
+# path is a silent recompile every engine step — the spec tests assert
+# trace/compiled_cache_evictions_total stays 0)
+SERVING_CACHE_SIZE = 2 * COMPILED_CACHE_SIZE
+
+# salted per-request sub-streams for speculative decoding: accept coins and
+# residual resampling must not collide with the token-index sampling stream
+# (shared by the solo speculative_generate and the serving engine's batched
+# draft-k-verify)
+SPEC_ACCEPT_SALT = 7919
+SPEC_RESIDUAL_SALT = 104729
+
 
 class _CompiledLRU:
     """Small LRU for lazily-jitted executables, keyed by shape-ish tuples.
@@ -676,12 +690,20 @@ class ParallelInferenceModel(_ServingBase):
         )
         return logits[:, -1, :], caches, valid
 
+    def _serving_lru(self, reset=False):
+        """Get-or-create the shared serving phase-fn cache — the ONE place
+        that owns its capacity (the paged + contiguous + per-chunk-width
+        verify programs must coexist without evictions)."""
+        if reset or not hasattr(self, "_serving_cache"):
+            self._serving_cache = _CompiledLRU(
+                "serving_phase", capacity=SERVING_CACHE_SIZE, owner=self)
+        return self._serving_cache
+
     def decode_slots(self, tok, offsets, caches, valid):
         """Compiled per-slot decode step (lazily jitted, cache donated);
         ``offsets`` is the per-slot next-write index ``[B]`` (``T`` = idle).
         Outputs pinned to the AOT executables' shardings."""
-        if not hasattr(self, "_serving_cache"):
-            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        self._serving_lru()
         fn = self._serving_cache.get("decode_slots")
         if fn is None:
             io = self._io_shardings
@@ -696,8 +718,7 @@ class ParallelInferenceModel(_ServingBase):
         slot-inserted request's prefill is numerically identical to a solo
         ``generate``'s.  The returned one-row caches feed
         :meth:`insert_slot`."""
-        if not hasattr(self, "_serving_cache"):
-            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        self._serving_lru()
         fn = self._serving_cache.get("prefill_one")
         if fn is None:
             fn = jax.jit(self._context_fn)
@@ -719,8 +740,7 @@ class ParallelInferenceModel(_ServingBase):
     def insert_slot(self, caches, row_caches, valid, row_valid, slot):
         """Compiled slot insert (live caches + validity donated — requests
         enter the batch without copying the other slots)."""
-        if not hasattr(self, "_serving_cache"):
-            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        self._serving_lru()
         fn = self._serving_cache.get("insert_slot")
         if fn is None:
             io = self._io_shardings
@@ -772,8 +792,7 @@ class ParallelInferenceModel(_ServingBase):
         """Compiled paged per-slot decode step (page pool donated).
         ``block_table`` is the ``[B, max_total_len // page_size]`` int32
         logical→physical page map; ``caches`` the pool pytree."""
-        if not hasattr(self, "_serving_cache"):
-            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        self._serving_lru()
         fn = self._serving_cache.get("decode_pages")
         if fn is None:
             fn = jax.jit(
@@ -782,6 +801,47 @@ class ParallelInferenceModel(_ServingBase):
                                self._io_shardings["batch"](None)))
             self._serving_cache.put("decode_pages", fn)
         return fn(self.params, tok, jnp.asarray(offsets, jnp.int32),
+                  jnp.asarray(block_table, jnp.int32), caches, valid)
+
+    def _verify_pages_fn(self, params, toks, offsets, block_table, caches, valid):
+        """Score a ``[B, S]`` chunk at PER-SLOT offsets against the page
+        pool — the batched target-verification step of speculative decoding
+        (the per-slot generalization of :meth:`_score_chunk_fn`): token
+        ``s`` of slot ``b`` is written at cache index ``offsets[b] + s``
+        (the model's multi-token block-table scatter) and position ``i``'s
+        logits judge the draft's proposal ``i+1`` — the shifted-logits
+        verification trick.  An offset of ``T`` parks an idle slot (all its
+        writes drop, its logits are garbage the caller ignores).  Returns
+        ``(logits [B, S, V], caches, valid)``."""
+        B, S = toks.shape
+        T = valid.shape[1]
+        idx = offsets[:, None] + jnp.arange(S)[None, :]  # [B, S] write indices
+        hot = jnp.any(jnp.arange(T)[None, None, :] == idx[:, :, None], axis=1)
+        valid = jnp.where(hot, 1, valid)  # the chunk's tokens become keys
+        counts = jnp.cumsum(valid, axis=1) - valid  # valid keys strictly before
+        positions = jnp.take_along_axis(counts, jnp.clip(idx, 0, T - 1), axis=1)
+        logits, caches = self.module.apply(
+            params, toks, positions.astype(jnp.int32), caches, offsets,
+            kv_valid=valid, block_table=block_table,
+        )
+        return logits, caches, valid
+
+    def verify_pages(self, toks, offsets, block_table, caches, valid):
+        """Compiled batched speculative-verification step (page pool
+        donated), lazily jitted per chunk width ``S = k + 1`` so one program
+        serves every round at a given draft depth.  Outputs pinned to the
+        AOT executables' shardings like :meth:`decode_pages`."""
+        self._serving_lru()
+        key = ("verify_pages", int(toks.shape[1]))
+        fn = self._serving_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                self._verify_pages_fn, donate_argnums=(4,),
+                out_shardings=(None, self._pool_out_shardings(caches),
+                               self._io_shardings["batch"](None)))
+            self._serving_cache.put(key, fn)
+        return fn(self.params, toks.astype(jnp.int32),
+                  jnp.asarray(offsets, jnp.int32),
                   jnp.asarray(block_table, jnp.int32), caches, valid)
 
     def _write_page_fn(self, caches, row_caches, lp, phys):
@@ -801,8 +861,7 @@ class ParallelInferenceModel(_ServingBase):
         ``logical_page`` of the ``prefill_one`` row caches lands in pool
         page ``phys_page``.  Cached-prefix pages are simply never written —
         the caller skips them entirely."""
-        if not hasattr(self, "_serving_cache"):
-            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        self._serving_lru()
         fn = self._serving_cache.get("write_page")
         if fn is None:
             fn = jax.jit(self._write_page_fn, donate_argnums=(0,),
@@ -822,8 +881,7 @@ class ParallelInferenceModel(_ServingBase):
         """Compiled pool-internal page copy (pool donated) — the device half
         of the allocator's copy-on-write: duplicate a shared page before
         writing the copy."""
-        if not hasattr(self, "_serving_cache"):
-            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        self._serving_lru()
         fn = self._serving_cache.get("copy_page")
         if fn is None:
             fn = jax.jit(self._copy_page_fn, donate_argnums=(0,),
@@ -839,8 +897,7 @@ class ParallelInferenceModel(_ServingBase):
         """Compiled validity-row insert (donated) — the paged admission's
         slice of :meth:`insert_slot`: block tables carry the KV, so only the
         validity row needs writing."""
-        if not hasattr(self, "_serving_cache"):
-            self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        self._serving_lru()
         fn = self._serving_cache.get("insert_valid")
         if fn is None:
             fn = jax.jit(self._insert_valid_fn, donate_argnums=(0,),
@@ -926,7 +983,7 @@ class ParallelInferenceModel(_ServingBase):
                 params_spec, ids_spec, off_spec, cache_spec, valid_spec
             ).compile()
         self._loop_cache = _CompiledLRU("decode_loop", owner=self)
-        self._serving_cache = _CompiledLRU("serving_phase", owner=self)
+        self._serving_lru(reset=True)
         self._arg_specs = (
             params_spec, ids_spec, vctx_spec, tok_spec, off_spec, cache_spec,
             valid_spec,
@@ -1012,8 +1069,9 @@ def speculative_generate(
         raise ValueError("temperature sampling requires an rng key")
     # token-index keys match generate()'s fold_in(rng, i) stream, so with
     # draft == target the sampled output is bit-identical to plain sampling;
-    # accept coins and residual resampling use salted sub-streams
-    _ACC, _RES = 7919, 104729
+    # accept coins and residual resampling use salted sub-streams (the same
+    # salts as the serving engine's batched draft-k-verify)
+    _ACC, _RES = SPEC_ACCEPT_SALT, SPEC_RESIDUAL_SALT
 
     valid_ctx = target._valid_ctx(prompt_lens)
     tail = jnp.zeros((B, T - C), jnp.int32)

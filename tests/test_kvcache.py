@@ -93,6 +93,24 @@ def test_allocator_refcounts_and_double_free():
     alloc.assert_invariants()
 
 
+def test_allocator_free_tail_batch_release():
+    """free_tail (the speculative rollback release): one call drops a whole
+    tail of references — NULL holes skipped, shared pages only decref'd —
+    and reports how many pages actually returned to the free list."""
+    alloc = BlockAllocator(num_pages=8)
+    pages = alloc.alloc(4)
+    alloc.retain(pages[1])  # shared with a (simulated) prefix chain
+    freed = alloc.free_tail([NULL_PAGE, *pages, NULL_PAGE])
+    assert freed == 3  # the shared page survives with one reference
+    assert alloc.refcount(pages[1]) == 1 and alloc.free_count == 6
+    alloc.assert_invariants()
+    with pytest.raises(ValueError, match="double free"):
+        alloc.free_tail([pages[0]])
+    alloc.free_tail([pages[1]])
+    assert alloc.free_count == 7 and alloc.in_use == 0
+    alloc.assert_invariants()
+
+
 def test_allocator_cow_semantics():
     from neuronx_distributed_tpu.obs import MetricRegistry
 

@@ -19,6 +19,14 @@ Three modes:
   (`"mode": "contiguous"` / `"mode": "paged"`): max concurrent requests,
   TTFT / inter-token p50/p99, goodput, and (paged) the prefix-page hit
   rate + prefills skipped — the kvcache/ subsystem's acceptance numbers.
+- `--spec`: batched speculative decoding over the paged engine vs the
+  PR-5 paged baseline, `draft == target` (the measured control: every
+  proposal must be accepted, so tokens/step ≈ k+1 by construction and any
+  shortfall is engine overhead, not draft quality).  One JSON line for the
+  baseline plus one per k in `--spec-ks` (default 2,4,8): tokens/step
+  (committed/rounds), acceptance rate, TTFT / inter-token p50/p99, goodput.
+  rc 1 when a k >= 2 rung commits <= 1 token/step or its greedy outputs
+  diverge from the baseline's.
 """
 
 from __future__ import annotations
@@ -263,6 +271,115 @@ def run_paged(args, module, params, cfg, icfg) -> int:
     return 0
 
 
+def run_spec(args, module, params, cfg, icfg) -> int:
+    """Speculative draft-k-verify vs the plain paged engine over one Poisson
+    workload, draft == target; prints one JSON line per rung."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.serving import Request, ServingEngine
+    from neuronx_distributed_tpu.trace import ParallelInferenceModel
+
+    B, C, T = args.batch_size, args.context_len, args.max_total_len
+    page = args.page_size
+    if C % page or T % page:
+        raise SystemExit(f"--page-size {page} must divide --context-len {C} "
+                         f"and --max-total-len {T}")
+    ks = sorted({int(x) for x in args.spec_ks.split(",")})
+    if any(k < 1 for k in ks):
+        raise SystemExit(f"--spec-ks must be >= 1, got {args.spec_ks}")
+    if C + args.max_new_tokens + max(ks) > T:
+        raise SystemExit(
+            f"--context-len {C} + --max-new-tokens {args.max_new_tokens} + "
+            f"k {max(ks)} exceeds --max-total-len {T}: the verification "
+            "step writes up to k tokens past the budget before rolling back")
+    # the spec engine reserves ceil((max_new + k)/page) decode pages per
+    # slot; the drop-in pool (contiguous footprint + NULL page) covers it
+    num_pages = B * (T // page) + 1
+    model = ParallelInferenceModel(module, params, icfg)
+
+    rs = np.random.RandomState(args.seed)
+    n = args.num_requests
+    prompts = [
+        rs.randint(1, cfg.vocab_size,
+                   size=rs.randint(max(2, C // 4), C + 1)).tolist()
+        for _ in range(n)
+    ]
+    gaps = rs.exponential(1.0 / args.arrival_rate, size=n)
+    arrivals = np.cumsum(gaps) - gaps[0]
+
+    def requests():
+        return [Request(request_id=i, prompt_ids=prompts[i],
+                        max_new_tokens=args.max_new_tokens)
+                for i in range(n)]
+
+    def measure(spec_k):
+        kw = dict(page_size=page, num_pages=num_pages)
+        if spec_k:
+            # draft == target: the SAME compiled model proposes and
+            # verifies, so acceptance is 1.0 up to numerics
+            kw.update(draft=model, spec_k=spec_k)
+        # warm every compiled phase on a throwaway engine (same model ⇒
+        # shared compiled-fn caches) so compile time never pollutes TTFT
+        warm = ServingEngine(model, registry=MetricRegistry(), **kw)
+        warm.submit(Request(request_id=-1, prompt_ids=prompts[0],
+                            max_new_tokens=min(2, args.max_new_tokens)))
+        warm.run_until_complete(max_steps=1000)
+        warm.close()
+        del warm
+        engine = ServingEngine(model, registry=MetricRegistry(), **kw)
+        outputs, wall, peak = _drive_workload(engine, arrivals, requests())
+        engine.close()
+        snap = engine.registry.snapshot()
+        total_tokens = sum(len(o.token_ids) for o in outputs.values())
+        ttfts = [o.ttft_ms for o in outputs.values() if o.ttft_ms is not None]
+        inter = [ms for o in outputs.values() for ms in o.intertoken_ms]
+        proposed = snap.get("serving/spec_proposed_total", 0.0)
+        accepted = snap.get("serving/spec_accepted_total", 0.0)
+        rounds = snap.get("serving/spec_rounds_total", 0.0)
+        committed = snap.get("serving/spec_committed_total", 0.0)
+        rec = {
+            "metric": "serving_spec",
+            "mode": "spec" if spec_k else "baseline",
+            "spec_k": spec_k,
+            "num_requests": n,
+            "finished": sum(1 for o in outputs.values()
+                            if o.state == "finished"),
+            "tokens_per_step": (round(committed / rounds, 4) if rounds
+                                else (1.0 if not spec_k else None)),
+            "acceptance_rate": (round(accepted / proposed, 4) if proposed
+                                else None),
+            "ttft_ms": _percentiles(ttfts),
+            "intertoken_ms": _percentiles(inter),
+            "goodput_tok_s": total_tokens / max(wall, 1e-9),
+            "wall_s": round(wall, 4),
+            "max_concurrent": peak,
+        }
+        return rec, {i: list(o.token_ids) for i, o in outputs.items()}
+
+    base = {"config": {"batch": B, "context": C, "max_total": T,
+                       "max_new": args.max_new_tokens, "page_size": page}}
+    rec0, base_tokens = measure(0)
+    print(json.dumps({**rec0, **base}))
+    rc = 0
+    for k in ks:
+        rec, tokens = measure(k)
+        identical = tokens == base_tokens
+        rec["identical_to_baseline"] = identical
+        print(json.dumps({**rec, **base}))
+        if k >= 2 and (rec["tokens_per_step"] is None
+                       or rec["tokens_per_step"] <= 1.0):
+            print(f"serve_bench: spec k={k} committed "
+                  f"{rec['tokens_per_step']} tokens/step <= 1 with "
+                  "draft == target", file=sys.stderr)
+            rc = 1
+        if not identical:
+            print(f"serve_bench: spec k={k} greedy outputs diverged from "
+                  "the paged baseline", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--tiny", action="store_true", help="CPU smoke config")
@@ -282,6 +399,13 @@ def main() -> int:
                         "context/total lengths)")
     p.add_argument("--paged-slots", type=int, default=None,
                    help="paged engine slot count (default: 2x --batch-size)")
+    p.add_argument("--spec", action="store_true",
+                   help="speculative-decoding mode: draft-k-verify over the "
+                        "paged engine vs the plain paged baseline, "
+                        "draft == target (one JSON line per rung; rc 1 if "
+                        "tokens/step <= 1 at k >= 2 or outputs diverge)")
+    p.add_argument("--spec-ks", default="2,4,8",
+                   help="comma-separated draft depths for the --spec sweep")
     p.add_argument("--num-requests", type=int, default=16)
     p.add_argument("--arrival-rate", type=float, default=20.0,
                    help="Poisson arrival rate, requests/s")
@@ -329,6 +453,12 @@ def main() -> int:
         args.batch_size = 2
         print("serve_bench: --paged with --batch-size 1 is a serial "
               "baseline; using batch size 2", file=sys.stderr)
+    if args.spec and args.batch_size == 1:
+        # tokens/step must be measured with speculation co-batched across
+        # slots, not in a degenerate serial engine
+        args.batch_size = 2
+        print("serve_bench: --spec with --batch-size 1 is a serial run; "
+              "using batch size 2", file=sys.stderr)
 
     if args.tiny:
         cfg = LlamaConfig.tiny(max_seq_len=args.max_total_len,
@@ -364,6 +494,8 @@ def main() -> int:
     )
     if args.paged:
         return run_paged(args, module, params, cfg, icfg)
+    if args.spec:
+        return run_spec(args, module, params, cfg, icfg)
     model = ParallelInferenceModel(module, params, icfg)
     n_params = sum(int(x.size) for x in jax.tree.leaves(params))
     base = {

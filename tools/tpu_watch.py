@@ -121,6 +121,12 @@ def run_extra_jobs(results_path: str) -> None:
         ("serving_paged", [sys.executable,
                            os.path.join(REPO, "tools", "serve_bench.py"),
                            "--paged"]),
+        # batched speculative decoding over paged KV vs the paged baseline
+        # (draft == target control): tokens/step per k, acceptance rate,
+        # TTFT/inter-token percentiles
+        ("serving_spec", [sys.executable,
+                          os.path.join(REPO, "tools", "serve_bench.py"),
+                          "--spec"]),
         # standalone kernel programs compile fast: block-size evidence fits
         # any window even when the full train step's compile does not
         ("flash_autotune", [sys.executable,
