@@ -168,17 +168,21 @@ def cmd_spec_decode(args):
 
 
 def cmd_serve(args):
-    """Continuous-batching serving demo: drive ``ServingEngine`` from a JSONL
-    prompt file (``{"prompt_ids": [...], "max_new_tokens"?, "temperature"?}``
-    per line; random prompts when no file) with Poisson arrivals, streaming
-    each token as a JSONL event and ending with one stats line."""
+    """Continuous-batching serving demo: drive ``ServingEngine`` (or, with
+    ``--replicas N``, a ``FleetRouter`` over N in-process replicas) from a
+    JSONL prompt file (``{"prompt_ids": [...], "max_new_tokens"?,
+    "temperature"?}`` per line; random prompts when no file) with Poisson
+    arrivals, streaming each token as a JSONL event and ending with one
+    stats line."""
     import time
 
     import jax
     import numpy as np
 
+    from neuronx_distributed_tpu.obs import MetricRegistry
     from neuronx_distributed_tpu.serving import (
-        Request, SamplingParams, ServingEngine, replay_trace)
+        FleetRouter, Replica, Request, SamplingParams, ServingEngine,
+        poisson_arrivals, replay, summarize_outputs)
 
     cfg, _, _, model = build_model(args)
     rs = np.random.RandomState(args.seed)
@@ -203,8 +207,7 @@ def cmd_serve(args):
     if not specs:
         raise SystemExit("serve: no prompts (empty --prompts file or "
                          "--num-requests 0)")
-    gaps = rs.exponential(1.0 / args.rate, size=len(specs))
-    arrivals = np.cumsum(gaps) - gaps[0]
+    arrivals = poisson_arrivals(len(specs), args.rate, rs)
 
     def stream(req, tok):
         if not args.quiet:
@@ -232,9 +235,24 @@ def cmd_serve(args):
                              "serving runs over the paged KV cache")
         _, _, _, draft = build_model(args, preset=args.draft)
         paged_kw.update(draft=draft, spec_k=args.spec_k)
-    engine = ServingEngine(
-        model, rng=jax.random.PRNGKey(args.seed), stats_path=args.stats_out,
-        **paged_kw)
+    fleet = args.replicas > 1
+    if fleet:
+        # in-process fleet: N engines share the one compiled model (one
+        # set of device params) but each owns its KV state; --stats-out
+        # becomes the router's router_stats.jsonl instead of a single
+        # engine's serving_stats.jsonl
+        def factory():
+            return ServingEngine(
+                model, rng=jax.random.PRNGKey(args.seed),
+                registry=MetricRegistry(), **paged_kw)
+
+        target = FleetRouter(
+            [Replica(i, factory) for i in range(args.replicas)],
+            policy=args.routing, seed=args.seed, stats_path=args.stats_out)
+    else:
+        target = engine = ServingEngine(
+            model, rng=jax.random.PRNGKey(args.seed),
+            stats_path=args.stats_out, **paged_kw)
     requests = [
         Request(
             request_id=i,
@@ -248,13 +266,36 @@ def cmd_serve(args):
     ]
 
     def done(out):
-        print(json.dumps({"event": "done", "request_id": out.request_id,
-                          "state": out.state, "tokens": list(out.token_ids)}),
-              flush=True)
+        ev = {"event": "done", "request_id": out.request_id,
+              "state": out.state, "tokens": list(out.token_ids)}
+        if fleet:  # the id the caller submitted, pre-re-keying
+            ev["client_id"] = target.client_id(out.request_id)
+        print(json.dumps(ev), flush=True)
 
     t0 = time.monotonic()
-    outputs = replay_trace(engine, arrivals, requests, on_output=done)
+    outputs = replay(target, arrivals, requests, on_output=done)
     wall = time.monotonic() - t0
+    if fleet:
+        snap = target.registry.snapshot()
+        prefix = target.fleet_prefix_stats()
+        target.close()
+        hits = snap.get("router/affinity_hits_total", 0.0)
+        misses = snap.get("router/affinity_misses_total", 0.0)
+        summary = summarize_outputs(outputs, wall)
+        summary.update({
+            "replicas": args.replicas,
+            "routing": target.policy.name,
+            "dispatched": int(snap.get("router/dispatched_total", 0)),
+            "requeued": int(snap.get("router/requeued_total", 0)),
+            "failovers": int(snap.get("router/failovers_total", 0)),
+            "affinity_hit_rate": (round(hits / (hits + misses), 4)
+                                  if hits + misses else None),
+        })
+        if args.page_size:
+            summary["fleet_prefix_hit_rate"] = prefix["prefix_hit_rate"]
+            summary["prefills_skipped"] = prefix["prefills_skipped"]
+        print(json.dumps(summary))
+        return
     engine.close()
     snap = engine.registry.snapshot()
     ttfts = [o.ttft_ms for o in outputs.values() if o.ttft_ms is not None]
@@ -387,6 +428,17 @@ def main():
     sp.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per slot per round "
                          "(speculative serving; requires --draft)")
+    sp.add_argument("--replicas", type=int, default=1,
+                    help="serve through a FleetRouter over this many "
+                         "in-process engine replicas (1 = a bare engine); "
+                         "--stats-out then writes router_stats.jsonl")
+    sp.add_argument("--routing", default="prefix_affinity",
+                    choices=["round_robin", "random", "least_loaded",
+                             "prefix_affinity"],
+                    help="fleet dispatch policy (with --replicas > 1); "
+                         "prefix_affinity needs --page-size to have "
+                         "fingerprints to steer by, else it degrades to "
+                         "least-loaded")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("spec-decode", help="speculative decoding: verify + time vs plain greedy")

@@ -37,7 +37,9 @@ without compiling anything.
 
 from __future__ import annotations
 
-from typing import Any, Iterator, List, Optional, Sequence, Tuple
+import hashlib
+import struct
+from typing import Any, Iterator, List, Optional, Sequence, Set, Tuple
 
 from neuronx_distributed_tpu.kvcache.allocator import NULL_PAGE, BlockAllocator
 
@@ -72,6 +74,41 @@ def is_padding_key(key: PageKey) -> bool:
     """True when the page holds no real token (all left-padding) — such
     pages map to the NULL page and cost nothing."""
     return all(t == PAD for t in key)
+
+
+# -- chain fingerprints (fleet router shadow index) --------------------------
+#
+# A fleet router steering by prefix affinity needs to know which replica's
+# PrefixIndex likely holds a prompt's leading page chain WITHOUT holding the
+# chain itself (the router is a front door over N replicas, possibly across
+# process boundaries).  A *chain fingerprint* is a stable 64-bit rolling hash
+# of a page-key chain: fp_0 = ROOT_FINGERPRINT, fp_n = H(fp_{n-1}, key_n).
+# blake2b (not Python ``hash``) so fingerprints agree across processes and
+# across runs — the contract between a live index's
+# :meth:`PrefixIndex.chain_fingerprints` export and the router-side shadow.
+
+ROOT_FINGERPRINT = 0
+
+
+def chain_fingerprint(parent_fp: int, key: PageKey) -> int:
+    """Extend a chain fingerprint by one page key (rolling, order-sensitive:
+    the fingerprint of a chain depends on every key before it)."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(int(parent_fp).to_bytes(8, "little"))
+    h.update(struct.pack(f"<{len(key)}q", *key))
+    return int.from_bytes(h.digest(), "little")
+
+
+def prefix_fingerprints(keys: Sequence[PageKey]) -> List[int]:
+    """Fingerprint of every leading chain of ``keys``: element ``i`` is the
+    fingerprint of ``keys[:i+1]``.  The router hashes a prompt's page keys
+    once and matches depths against a replica shadow set."""
+    fps: List[int] = []
+    fp = ROOT_FINGERPRINT
+    for key in keys:
+        fp = chain_fingerprint(fp, key)
+        fps.append(fp)
+    return fps
 
 
 class _Node:
@@ -169,6 +206,23 @@ class PrefixIndex:
         self._version += 1
         if payload is not None and node is not self._root:
             node.payload = payload
+
+    def chain_fingerprints(self) -> Set[int]:
+        """Fingerprint of every chain the index currently caches (one per
+        node — each node terminates the chain of keys from the root down to
+        it).  The truth a fleet router's per-replica shadow approximates;
+        :meth:`~..serving.fleet.FleetRouter` resyncs from it after a replica
+        restart so the shadow never credits an index that no longer holds
+        the pages."""
+        out: Set[int] = set()
+        stack = [(self._root, ROOT_FINGERPRINT)]
+        while stack:
+            node, fp = stack.pop()
+            for child in node.children.values():
+                cfp = chain_fingerprint(fp, child.key)
+                out.add(cfp)
+                stack.append((child, cfp))
+        return out
 
     # -- eviction ----------------------------------------------------------
 

@@ -216,6 +216,41 @@ def _summarize_speculative(scalars: Dict[str, dict]) -> Optional[dict]:
     }
 
 
+def _summarize_fleet(scalars: Dict[str, dict]) -> Optional[dict]:
+    """Fleet-router health from the ``router/*`` registry scalars: pool
+    size still in rotation, dispatch/requeue/failover accounting (requeues
+    and failovers above 0 mean replicas died mid-run and their work moved),
+    and the affinity story — how often the shadow steered a fingerprinted
+    request to a replica already holding its pages, and the pool-wide
+    prefix hit rate that steering exists to raise.  None when the run
+    served no fleet."""
+    dispatched = scalars.get("router/dispatched_total")
+    if dispatched is None or not dispatched.get("last"):
+        return None
+
+    def last(tag):
+        s = scalars.get(tag)
+        return s["last"] if s else 0.0
+
+    hits = last("router/affinity_hits_total")
+    misses = last("router/affinity_misses_total")
+    return {
+        "replicas_alive": last("router/replicas_alive"),
+        "dispatched": dispatched["last"],
+        "requeued": last("router/requeued_total"),
+        "failovers": last("router/failovers_total"),
+        "restarts": last("router/restarts_total"),
+        "retired": last("router/retired_total"),
+        "affinity_hits": hits,
+        "affinity_misses": misses,
+        "affinity_hit_rate": (round(hits / (hits + misses), 4)
+                              if hits + misses else None),
+        "fleet_prefix_hit_rate": (
+            round(last("router/fleet_prefix_hit_rate"), 4)
+            if scalars.get("router/fleet_prefix_hit_rate") else None),
+    }
+
+
 def _summarize_timeline(paths: Sequence[str]) -> dict:
     events = instants = 0
     dur_by_name: Dict[str, float] = {}
@@ -306,6 +341,7 @@ def build_report(
     scalars = _summarize_scalars(scalar_records, frozenset(histograms))
     kvcache = _summarize_kvcache(scalars)
     speculative = _summarize_speculative(scalars)
+    fleet = _summarize_fleet(scalars)
     report = {
         "schema": OBS_REPORT_SCHEMA,
         "generated_at": time.time(),
@@ -329,6 +365,7 @@ def build_report(
             "host_blocked": host_blocked,
             "kvcache": kvcache,
             "speculative": speculative,
+            "fleet": fleet,
             "total_collective_count": sum(
                 a.get("total_collective_count", 0) for a in audits),
             "total_collective_bytes": sum(
@@ -365,6 +402,22 @@ def render_markdown(report: dict) -> str:
             f"{kv['prefills_skipped']:.0f} prefills skipped, "
             f"{kv['evictions']:.0f} evictions, "
             f"{kv['cow_copies']:.0f} cow copies")
+    fleet = h.get("fleet")
+    if fleet:
+        aff = (f"{fleet['affinity_hit_rate']:.1%} affinity hits "
+               f"({fleet['affinity_hits']:.0f}/"
+               f"{fleet['affinity_hits'] + fleet['affinity_misses']:.0f})"
+               if fleet["affinity_hit_rate"] is not None
+               else "no fingerprinted dispatches")
+        pool = (f", pool prefix hit rate {fleet['fleet_prefix_hit_rate']:.1%}"
+                if fleet["fleet_prefix_hit_rate"] is not None else "")
+        lines.append(
+            f"- fleet: {fleet['replicas_alive']:.0f} replica(s) in rotation; "
+            f"{fleet['dispatched']:.0f} dispatches, "
+            f"{fleet['requeued']:.0f} requeued over "
+            f"{fleet['failovers']:.0f} failover(s) "
+            f"({fleet['restarts']:.0f} restarts, "
+            f"{fleet['retired']:.0f} retired); {aff}{pool}")
     spec = h.get("speculative")
     if spec:
         rate = (f"{spec['acceptance_rate']:.1%} acceptance"

@@ -53,6 +53,19 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "spec_proposed": int, "spec_accepted": int,
         "acceptance_rate": (int, float, type(None)),
     },
+    # one line of router_stats.jsonl (serving.fleet.router.FleetRouter) —
+    # one record per TERMINAL request across the whole fleet: which replica
+    # finished it, how many times it was dispatched/requeued (requeues > 0
+    # means it survived a failover), how many leading prompt pages the
+    # affinity shadow matched at dispatch, and the routing policy in force.
+    # replica is -1 for requests that never reached an engine (router-held
+    # cancellation / total capacity loss).
+    "router_stats": {
+        "schema": str, "time": _NUM, "request_id": int, "client_id": int,
+        "replica": int, "state": str, "finish_reason": (str, type(None)),
+        "dispatches": int, "requeues": int, "affinity_pages": int,
+        "new_tokens": int, "policy": str,
+    },
     # one line of supervisor_events.jsonl (resilience.supervisor.Supervisor)
     # — events: start / exit / restart / giveup / success; extra keys carry
     # the event payload (pid, rc, cause, backoff_s, resume_tag, ...)
@@ -110,6 +123,25 @@ REGISTRY_METRICS: Dict[str, str] = {
     "serving/spec_accepted_total": "counter",
     "serving/spec_committed_total": "counter",
     "serving/spec_rounds_total": "counter",
+    # serving fleet router (serving.fleet.router.FleetRouter) — pool-wide
+    # admission accounting.  dispatched counts placements (a requeued
+    # request is dispatched again), failovers counts replica deaths the
+    # router drained, affinity hits/misses split fingerprinted dispatches
+    # by whether the shadow matched any leading pages.  Per-replica
+    # `router/replica<N>/alive|load` gauges ride alongside as extras
+    # (dynamic names — deliberately outside this floor).
+    "router/dispatched_total": "counter",
+    "router/requeued_total": "counter",
+    "router/failovers_total": "counter",
+    "router/restarts_total": "counter",
+    "router/retired_total": "counter",
+    "router/affinity_hits_total": "counter",
+    "router/affinity_misses_total": "counter",
+    "router/replicas_alive": "gauge",
+    "router/queue_depth": "gauge",
+    "router/inflight": "gauge",
+    "router/affinity_hit_rate": "gauge",
+    "router/fleet_prefix_hit_rate": "gauge",
 }
 
 

@@ -89,6 +89,37 @@ def classify_exit(rc: int, log_tail: str) -> str:
     return f"exit_{rc}"
 
 
+class RestartBackoff:
+    """Exponential-backoff restart budget: ``next_delay()`` consumes one
+    unit of the budget and returns the backoff before the next attempt
+    (``base * 2^(restarts-1)``, capped), or ``None`` when the budget is
+    exhausted.  The ONE restart-discipline implementation shared by the
+    training :class:`Supervisor` and the serving fleet's
+    :class:`~..serving.fleet.Replica` — a crashed replica re-enters rotation
+    on exactly the same schedule a crashed trainer does."""
+
+    def __init__(self, max_restarts: int, base_s: float = 0.5,
+                 max_s: float = 30.0):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        self.max_restarts = max_restarts
+        self.base_s = base_s
+        self.max_s = max_s
+        self.restarts = 0
+
+    @property
+    def exhausted(self) -> bool:
+        return self.restarts >= self.max_restarts
+
+    def next_delay(self) -> Optional[float]:
+        """Consume one restart; returns the backoff seconds, or None when
+        the crash budget is spent (caller gives up / retires)."""
+        if self.exhausted:
+            return None
+        self.restarts += 1
+        return min(self.base_s * (2 ** (self.restarts - 1)), self.max_s)
+
+
 @dataclasses.dataclass
 class SupervisorResult:
     """Outcome of :meth:`Supervisor.run`."""
@@ -126,6 +157,7 @@ class Supervisor:
         log_path: Optional[str] = None,
         env: Optional[dict] = None,
         cwd: Optional[str] = None,
+        on_exit=None,
         clock=time.monotonic,
         sleep=time.sleep,
     ):
@@ -135,6 +167,11 @@ class Supervisor:
             raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
         self.argv = list(argv)
         self.max_restarts = max_restarts
+        # drain/requeue hook: called as on_exit(attempt, rc, cause) after
+        # every child exit, BEFORE any restart decision — a fleet controller
+        # supervising a serving replica uses it to requeue the replica's
+        # in-flight requests on siblings while this child is down
+        self.on_exit = on_exit
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
         self.timeout_s = timeout_s
@@ -216,7 +253,8 @@ class Supervisor:
     def run(self) -> SupervisorResult:
         t_start = self._clock()
         attempt = 1
-        restarts = 0
+        budget = RestartBackoff(self.max_restarts, base_s=self.backoff_base_s,
+                                max_s=self.backoff_max_s)
         causes: List[str] = []
         try:
             while True:
@@ -236,26 +274,34 @@ class Supervisor:
                     timed_out = True
                 cause = "timeout" if timed_out else classify_exit(
                     rc, self._log_tail())
+                if self.on_exit is not None:
+                    # the fleet drain/requeue window: the child is down, no
+                    # restart decision has been made — a hook failure is
+                    # loud but must not take the supervisor down with it
+                    try:
+                        self.on_exit(attempt, rc, cause)
+                    except Exception:
+                        logger.exception(
+                            "supervisor: on_exit hook failed (attempt %d, "
+                            "rc %d, cause %s)", attempt, rc, cause)
                 self._emit("exit", attempt, rc=rc, cause=cause,
                            runtime_s=round(runtime_s, 3),
                            resume_tag=newest_complete_tag(self.ckpt_dir))
                 if rc == 0:
-                    self._emit("success", attempt, restarts=restarts)
+                    self._emit("success", attempt, restarts=budget.restarts)
                     return SupervisorResult(
-                        ok=True, attempts=attempt, restarts=restarts,
+                        ok=True, attempts=attempt, restarts=budget.restarts,
                         final_rc=0, total_runtime_s=self._clock() - t_start,
                         causes=causes, events_path=self.events_path)
                 causes.append(cause)
-                if restarts >= self.max_restarts:
+                backoff = budget.next_delay()
+                if backoff is None:
                     self._emit("giveup", attempt, rc=rc,
-                               restarts=restarts, cause=cause)
+                               restarts=budget.restarts, cause=cause)
                     return SupervisorResult(
-                        ok=False, attempts=attempt, restarts=restarts,
+                        ok=False, attempts=attempt, restarts=budget.restarts,
                         final_rc=rc, total_runtime_s=self._clock() - t_start,
                         causes=causes, events_path=self.events_path)
-                restarts += 1
-                backoff = min(self.backoff_base_s * (2 ** (restarts - 1)),
-                              self.backoff_max_s)
                 attempt += 1
                 self._emit("restart", attempt, backoff_s=round(backoff, 3),
                            cause=cause)

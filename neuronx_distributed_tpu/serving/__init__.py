@@ -33,14 +33,32 @@ rejected tails rolled back by page accounting, greedy output
 token-identical to the plain engine, sampled output exactly distributed as
 plain sampling via the residual-distribution correction, acceptance-rate
 telemetry per request.
+
+Fleet mode (fleet PR): :mod:`.fleet`'s :class:`FleetRouter` fronts N
+``Replica``-wrapped engines with globally-unique request ids, pluggable
+routing (round-robin / random / load-aware / prefix-affinity over a
+host-side shadow of each replica's prefix chains) and zero-loss failover
+(crash -> drain -> requeue on siblings -> warm restart).  :mod:`.driver`
+is the shared Poisson drive loop — it takes an engine or a router.
 """
 
 from neuronx_distributed_tpu.kvcache.allocator import PoolExhausted
+from neuronx_distributed_tpu.serving.driver import (
+    poisson_arrivals,
+    replay,
+    summarize_outputs,
+)
 from neuronx_distributed_tpu.serving.engine import (
     FAIL_NON_FINITE,
     SERVING_STATS_SCHEMA,
     ServingEngine,
     replay_trace,
+)
+from neuronx_distributed_tpu.serving.fleet import (
+    FleetRouter,
+    FleetUnavailableError,
+    Replica,
+    ReplicaState,
 )
 from neuronx_distributed_tpu.serving.paged import PagedKVManager
 from neuronx_distributed_tpu.serving.request import (

@@ -58,6 +58,7 @@ import numpy as np
 from neuronx_distributed_tpu.obs import MS_BUCKETS, MetricRegistry
 from neuronx_distributed_tpu.obs.transfer_audit import TransferAudit
 from neuronx_distributed_tpu.resilience.faults import perturb
+from neuronx_distributed_tpu.serving.driver import replay as driver_replay
 from neuronx_distributed_tpu.serving.request import (
     Request,
     RequestOutput,
@@ -209,47 +210,13 @@ def _pack_tokens(toks, finite):
 
 def replay_trace(engine: "ServingEngine", arrivals, requests,
                  on_output=None, clock=time.monotonic, sleep=time.sleep):
-    """Replay an arrival trace through a live engine: submit each request
-    when its arrival time (seconds from replay start) passes, stepping the
-    engine in between and sleeping only when idle ahead of the next
-    arrival.  The ONE drive loop shared by ``tools/serve_bench.py
-    --continuous`` and the runner's ``serve`` subcommand.  Returns
-    ``{request_id: RequestOutput}``; ``on_output`` additionally fires per
-    terminal request as it completes (streaming hooks ride on the requests
-    themselves via ``stream_cb``).
-
-    An unhandled exception out of the drive loop dumps the engine's obs
-    flight record first (when the engine carries an ``obs=`` hub) — the
-    serving twin of ``fit()``'s crash path: the last K engine steps become a
-    persisted artifact instead of lost scrollback."""
-    if len(arrivals) != len(requests):
-        raise ValueError(
-            f"arrivals ({len(arrivals)}) and requests ({len(requests)}) "
-            "must pair up")
-    outputs = {}
-    t0 = clock()
-    next_i = 0
-    try:
-        while next_i < len(requests) or engine.has_work:
-            now = clock() - t0
-            while next_i < len(requests) and arrivals[next_i] <= now:
-                engine.submit(requests[next_i])
-                next_i += 1
-            if engine.has_work:
-                for out in engine.step():
-                    outputs[out.request_id] = out
-                    if on_output is not None:
-                        on_output(out)
-            elif next_i < len(requests):
-                sleep(min(arrivals[next_i] - now, 0.05))
-    except BaseException as e:
-        # telemetry IO must never mask the real crash
-        try:
-            engine.dump_flight(f"crash:{type(e).__name__}")
-        except Exception as dump_err:
-            logger.warning("serving: crash flight dump failed: %s", dump_err)
-        raise
-    return outputs
+    """Replay an arrival trace through a live engine — the historical name
+    for :func:`~.driver.replay`, which since the fleet PR drives a
+    :class:`~.fleet.FleetRouter` through the same loop.  Kept as the
+    engine-flavored alias; see ``serving/driver.py`` for the contract
+    (including the crash flight dump on an unhandled exception)."""
+    return driver_replay(engine, arrivals, requests, on_output=on_output,
+                         clock=clock, sleep=sleep)
 
 
 class ServingEngine:

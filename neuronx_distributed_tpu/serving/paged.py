@@ -223,6 +223,14 @@ class PagedKVManager:
         self.tables[slot] = NULL_PAGE
         self.tables_dirty = True
 
+    def prefix_fingerprints(self):
+        """Chain fingerprints of every prompt chain the live prefix index
+        holds (empty set without a prefix cache) — the fleet router's
+        shadow-resync source after a replica restart."""
+        if self.index is None:
+            return set()
+        return self.index.chain_fingerprints()
+
     # -- internals ---------------------------------------------------------
 
     def _ensure_free(self, n: int) -> None:

@@ -37,6 +37,7 @@ export path.
 from __future__ import annotations
 
 import dataclasses
+import numbers
 import time
 from typing import Any, Callable, Optional, Sequence
 
@@ -121,7 +122,21 @@ def request_rng(rng: jax.Array, request_id: int) -> jax.Array:
     key, so a sampled request's output depends only on ``(rng, request_id,
     token index)`` — never on which requests it happens to be co-batched
     with.  Shared convention between ``generate(request_ids=...)`` and the
-    continuous-batching :class:`~..serving.ServingEngine`."""
+    continuous-batching :class:`~..serving.ServingEngine`.
+
+    Ids wider than 32 bits — the serving fleet's router-assigned
+    ``(namespace << 32) | seq`` globals — fold the high word first, so two
+    requests whose ids differ only in namespace draw disjoint streams.  Ids
+    below 2**32 keep their historical single-fold streams bit-identical
+    (traced int32 ids from ``generate(request_ids=...)`` can never exceed
+    them).  Any host-side integral id counts (numpy scalars included —
+    ``jnp.uint32`` would otherwise silently truncate a wide ``np.int64``
+    into a colliding stream); traced values stay single-fold."""
+    if isinstance(request_id, numbers.Integral):
+        request_id = int(request_id)
+        if request_id > 0xFFFFFFFF:
+            rng = jax.random.fold_in(rng, jnp.uint32(request_id >> 32))
+            request_id = request_id & 0xFFFFFFFF
     return jax.random.fold_in(rng, jnp.uint32(request_id))
 
 

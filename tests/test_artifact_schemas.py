@@ -30,7 +30,8 @@ def test_schema_list_is_complete():
     """The artifact kinds the framework documents all have schemas."""
     assert {"scalars", "flight_record", "flight_step", "anomaly",
             "hlo_audit", "tpu_watch", "obs_report",
-            "serving_stats", "supervisor_event"} <= set(SCHEMAS)
+            "serving_stats", "supervisor_event",
+            "router_stats"} <= set(SCHEMAS)
 
 
 def test_committed_tpu_watch_results_validate():
@@ -135,6 +136,68 @@ def test_serving_stats_schema(tmp_path):
     with pytest.raises(ValueError, match="expected"):
         bad = dict(recs[0], new_tokens="8")
         validate_record("serving_stats", bad)
+
+
+def test_router_stats_schema_and_fleet_report_line(tmp_path):
+    """One router_stats record per terminal fleet request (the live-emitter
+    path is validated end-to-end in tests/test_fleet.py), the ``router/*``
+    registry metrics are declared with their kinds, and the obs report
+    grows a fleet health section from them."""
+    from neuronx_distributed_tpu.obs.schemas import (
+        REGISTRY_METRICS,
+        validate_registry_metrics,
+    )
+    from neuronx_distributed_tpu.serving.fleet import ROUTER_STATS_SCHEMA
+
+    recs = [
+        # a request that survived a failover: dispatched twice, requeued once
+        {"schema": ROUTER_STATS_SCHEMA, "time": 1.0, "request_id": 1 << 32,
+         "client_id": 0, "replica": 2, "state": "finished",
+         "finish_reason": "length", "dispatches": 2, "requeues": 1,
+         "affinity_pages": 3, "new_tokens": 8, "policy": "prefix_affinity"},
+        # a router-held cancellation: never reached an engine
+        {"schema": ROUTER_STATS_SCHEMA, "time": 2.0,
+         "request_id": (1 << 32) | 1, "client_id": 1, "replica": -1,
+         "state": "cancelled", "finish_reason": "cancelled", "dispatches": 0,
+         "requeues": 0, "affinity_pages": 0, "new_tokens": 0,
+         "policy": "prefix_affinity"},
+    ]
+    path = tmp_path / "router_stats.jsonl"
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    assert validate_jsonl("router_stats", str(path)) == 2
+    with pytest.raises(ValueError, match="missing required field"):
+        validate_record("router_stats", {"schema": ROUTER_STATS_SCHEMA})
+    with pytest.raises(ValueError, match="expected"):
+        validate_record("router_stats", dict(recs[0], requeues=None))
+
+    assert {"router/dispatched_total", "router/requeued_total",
+            "router/failovers_total", "router/affinity_hits_total",
+            "router/replicas_alive",
+            "router/fleet_prefix_hit_rate"} <= set(REGISTRY_METRICS)
+
+    # a live router's registry validates, and its scalars grow the report's
+    # fleet health line
+    reg = MetricRegistry()
+    for _ in range(3):
+        reg.counter("router/dispatched_total").inc()
+    reg.counter("router/requeued_total").inc()
+    reg.counter("router/failovers_total").inc()
+    reg.counter("router/affinity_hits_total").inc(2)
+    reg.counter("router/affinity_misses_total").inc()
+    reg.gauge("router/replicas_alive").set(4)
+    validate_registry_metrics(reg)
+    reg.dump_jsonl(str(tmp_path / "scalars.jsonl"), step=1)
+
+    from neuronx_distributed_tpu.obs.report import build_report, render_markdown
+
+    report = build_report(run_dir=str(tmp_path))
+    validate_record("obs_report", report)
+    fleet = report["health"]["fleet"]
+    assert fleet["dispatched"] == 3.0 and fleet["failovers"] == 1.0
+    assert fleet["affinity_hit_rate"] == round(2 / 3, 4)
+    assert "- fleet: 4 replica(s) in rotation" in render_markdown(report)
 
 
 def test_supervisor_events_validate_and_merge_into_report(tmp_path):

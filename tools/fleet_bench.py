@@ -1,0 +1,372 @@
+"""Fleet benchmark: the serving/fleet/ subsystem's acceptance rungs — one
+JSON line per rung, rc 1 when any rung fails.
+
+Three rungs over one compiled model (replicas share the device params; each
+engine owns its KV state):
+
+- ``scale``: a burst backlog through N=4 replicas vs a fleet of one.
+  Replicas share one host here, so wall clock cannot show the win; goodput
+  is accounted under the parallel-replica model instead — finished tokens
+  over the BUSIEST replica's cumulative ``step()`` wall time (on silicon
+  each replica is its own chip and the busiest one IS the wall clock).
+  Fails unless the N=4 fleet sustains >= 3x the one-replica goodput.
+
+- ``affinity``: a shared-system-prompt trace (G groups, each opening with
+  its own long preamble) dispatched by ``random`` vs ``prefix_affinity``.
+  Random scatters a group across replicas, so every replica pays the
+  group's prefill; affinity steers a group to the replica already holding
+  its pages.  Fails unless affinity's aggregate prefix-page hit rate
+  (summed over every replica's ``kvcache/*`` counters) is STRICTLY higher.
+
+- ``failover``: the same fleet with a mid-run replica kill injected
+  through the ``NXD_FAULT_PLAN`` plane (the ``fleet/replica_step`` fault
+  point).  Fails unless every accepted request still yields exactly one
+  FINISHED output (zero accepted requests lost), the kill demonstrably
+  requeued in-flight work, and the schema-checked ``router_stats.jsonl``
+  agrees record-for-record.
+
+Run by ``tools/tpu_watch.py`` as the ``serving_fleet`` extra job;
+``--tiny`` smoke-tests the harness on CPU (the same rungs, smaller model).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _build_fleet(model, n_replicas, policy, seed, stats_path=None, **engine_kw):
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.serving import FleetRouter, Replica, ServingEngine
+
+    def factory():
+        return ServingEngine(model, registry=MetricRegistry(), **engine_kw)
+
+    return FleetRouter(
+        [Replica(i, factory, backoff_base_s=0.01) for i in range(n_replicas)],
+        policy=policy, seed=seed, stats_path=stats_path)
+
+
+def _warm(model, prompt_ids, **engine_kw):
+    """Compile every serving phase on a throwaway engine (same model =>
+    shared compiled-fn caches) so compile time never pollutes a rung."""
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.serving import Request, ServingEngine
+
+    warm = ServingEngine(model, registry=MetricRegistry(), **engine_kw)
+    warm.submit(Request(request_id=-1, prompt_ids=prompt_ids, max_new_tokens=2))
+    warm.run_until_complete(max_steps=1000)
+    warm.close()
+
+
+def _drive(router, requests):
+    """Burst-replay ``requests`` through a router; returns its outputs."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.serving import replay
+
+    return replay(router, np.zeros(len(requests)), requests)
+
+
+def run_scale(args, model, vocab_size, engine_kw) -> dict:
+    import numpy as np
+
+    from neuronx_distributed_tpu.serving import Request
+
+    rs = np.random.RandomState(args.seed)
+    C = model.config.context_len
+    # fixed-length prompts: the rung measures replica COUNT, so per-request
+    # work is equalized — ragged lengths would fold prompt-mix variance
+    # (the busiest replica drawing the longest prompts) into the speedup
+    prompts = [rs.randint(1, vocab_size, size=C).tolist()
+               for _ in range(args.num_requests)]
+
+    def requests():
+        return [Request(request_id=i, prompt_ids=prompts[i],
+                        max_new_tokens=args.max_new_tokens)
+                for i in range(len(prompts))]
+
+    def measure_once(n_replicas):
+        # round-robin: the even-spread baseline policy — this rung measures
+        # replica COUNT, not placement cleverness
+        router = _build_fleet(model, n_replicas, "round_robin", args.seed,
+                              **engine_kw)
+        outs = _drive(router, requests())
+        busy = [r.busy_s for r in router.replicas.values()]
+        tokens = sum(len(o.token_ids) for o in outs.values()
+                     if o.state == "finished")
+        router.close()
+        return {
+            "replicas": n_replicas,
+            "finished": sum(1 for o in outs.values()
+                            if o.state == "finished"),
+            "tokens": tokens,
+            "busy_s": [round(b, 4) for b in busy],
+            "goodput_model_tok_s": tokens / max(max(busy), 1e-9),
+        }
+
+    def measure(n_replicas):
+        # best of two: busy_s is wall time on a shared host, so one noisy
+        # OS-scheduling stall in the wrong run would swing the ratio
+        runs = [measure_once(n_replicas) for _ in range(2)]
+        return max(runs, key=lambda r: r["goodput_model_tok_s"])
+
+    one = measure(1)
+    n = measure(args.replicas)
+    speedup = (n["goodput_model_tok_s"]
+               / max(one["goodput_model_tok_s"], 1e-9))
+    return {
+        "metric": "serving_fleet", "rung": "scale",
+        "num_requests": args.num_requests,
+        "one": one, "fleet": n,
+        "goodput_speedup": round(speedup, 3),
+        "ok": (speedup >= args.scale_floor
+               and n["finished"] == args.num_requests
+               and one["finished"] == args.num_requests),
+    }
+
+
+def _shared_prefix_trace(args, vocab_size, C, page):
+    """G groups, each opening with its own half-context system preamble
+    (page-aligned by equal fixed lengths), interleaved round-robin so a
+    group's requests arrive spread out — the trace where placement decides
+    whether a preamble's pages are paid for once or once per replica."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.serving import Request
+
+    rs = np.random.RandomState(args.seed + 1)
+    L = max(C // 2, page)
+    sys_len = max((L // 2) // page * page, page)
+    groups = [rs.randint(1, vocab_size, size=sys_len).tolist()
+              for _ in range(args.groups)]
+    prompts = []
+    for i in range(args.num_requests):
+        g = i % args.groups
+        prompts.append(groups[g]
+                       + rs.randint(1, vocab_size, size=L - sys_len).tolist())
+
+    def requests():
+        return [Request(request_id=i, prompt_ids=prompts[i],
+                        max_new_tokens=args.max_new_tokens)
+                for i in range(len(prompts))]
+
+    return requests
+
+
+def run_affinity(args, model, vocab_size, engine_kw) -> dict:
+    C = model.config.context_len
+    requests = _shared_prefix_trace(args, vocab_size, C, args.page_size)
+
+    def measure(policy):
+        router = _build_fleet(model, args.replicas, policy, args.seed,
+                              **engine_kw)
+        outs = _drive(router, requests())
+        stats = router.fleet_prefix_stats()
+        snap = router.registry.snapshot()
+        router.close()
+        return {
+            "policy": policy,
+            "finished": sum(1 for o in outs.values()
+                            if o.state == "finished"),
+            "prefix_hit_rate": stats["prefix_hit_rate"],
+            "prefills_skipped": stats["prefills_skipped"],
+            "affinity_hit_rate": (
+                snap.get("router/affinity_hits_total", 0.0)
+                / max(snap.get("router/affinity_hits_total", 0.0)
+                      + snap.get("router/affinity_misses_total", 0.0), 1.0)),
+        }
+
+    rand = measure("random")
+    aff = measure("prefix_affinity")
+    ok = (rand["prefix_hit_rate"] is not None
+          and aff["prefix_hit_rate"] is not None
+          and aff["prefix_hit_rate"] > rand["prefix_hit_rate"]
+          and aff["finished"] == rand["finished"] == args.num_requests)
+    return {
+        "metric": "serving_fleet", "rung": "affinity",
+        "num_requests": args.num_requests, "groups": args.groups,
+        "random": rand, "prefix_affinity": aff,
+        "ok": ok,
+    }
+
+
+def run_failover(args, model, vocab_size, engine_kw) -> dict:
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+    from neuronx_distributed_tpu.resilience.faults import clear_plan, install_plan
+
+    C = model.config.context_len
+    requests = _shared_prefix_trace(args, vocab_size, C, args.page_size)
+    stats_path = os.path.join(
+        args.stats_dir or tempfile.mkdtemp(prefix="fleet_bench_"),
+        "router_stats.jsonl")
+    if os.path.exists(stats_path):
+        os.remove(stats_path)
+
+    # kill replica 0 mid-run through the standard fault plane (round-robin
+    # dispatch guarantees it holds in-flight work when the kill lands)
+    install_plan({"faults": [{
+        "point": "fleet/replica_step", "action": "exception",
+        "match": {"replica": 0, "step": args.kill_step}, "count": 1,
+        "message": "fleet_bench: injected replica kill"}]})
+    try:
+        router = _build_fleet(model, args.replicas, "round_robin", args.seed,
+                              stats_path=stats_path, **engine_kw)
+        outs = _drive(router, requests())
+        router.assert_invariants()
+        snap = router.registry.snapshot()
+        router.close()
+    finally:
+        clear_plan()
+
+    n = args.num_requests
+    n_stats = validate_jsonl("router_stats", stats_path)
+    records = [json.loads(l) for l in open(stats_path) if l.strip()]
+    finished = sum(1 for o in outs.values() if o.state == "finished")
+    rec = {
+        "metric": "serving_fleet", "rung": "failover",
+        "num_requests": n,
+        "accepted": n,
+        "finished": finished,
+        "lost": n - len(outs),
+        "failovers": snap.get("router/failovers_total", 0.0),
+        "requeued": snap.get("router/requeued_total", 0.0),
+        "restarts": snap.get("router/restarts_total", 0.0),
+        "stats_records": n_stats,
+        "stats_finished": sum(1 for r in records if r["state"] == "finished"),
+        "stats_requeued": sum(1 for r in records if r["requeues"] > 0),
+        "stats_path": os.path.abspath(stats_path),
+    }
+    rec["ok"] = (
+        finished == n                          # every accepted request done
+        and len(outs) == n                     # exactly one output each
+        and rec["failovers"] == 1.0            # the kill actually landed
+        and rec["requeued"] >= 1.0             # ... on in-flight work
+        and n_stats == n                       # the ledger agrees
+        and rec["stats_finished"] == n
+        and rec["stats_requeued"] >= 1)
+    return rec
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--tiny", action="store_true", help="CPU smoke config")
+    p.add_argument("--replicas", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=2,
+                   help="slots per replica engine")
+    p.add_argument("--context-len", type=int, default=128)
+    p.add_argument("--max-total-len", type=int, default=256)
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--num-requests", type=int, default=24)
+    p.add_argument("--groups", type=int, default=4,
+                   help="distinct shared system prompts in the affinity "
+                        "trace (one hot prefix per group)")
+    p.add_argument("--page-size", type=int, default=8)
+    p.add_argument("--scale-floor", type=float, default=3.0,
+                   help="minimum N-replica goodput multiple over one "
+                        "replica (model-accounted)")
+    p.add_argument("--kill-step", type=int, default=3,
+                   help="replica-0 step at which the failover rung injects "
+                        "the kill")
+    p.add_argument("--stats-dir", default=None,
+                   help="directory for the failover rung's "
+                        "router_stats.jsonl (default: a temp dir)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+
+    if args.tiny:
+        jax.config.update("jax_platforms", "cpu")
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                         ".jax_cache")
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001
+        pass
+
+    import jax.numpy as jnp
+
+    import neuronx_distributed_tpu as nxd
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.trace import InferenceConfig, ParallelInferenceModel
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform != "cpu"
+    if not on_tpu and not args.tiny:
+        print("refusing to record a non-TPU fleet number; use --tiny for a "
+              "CPU harness smoke", file=sys.stderr)
+        return 1
+    nxd.initialize_model_parallel(tensor_parallel_size=1, devices=devices[:1])
+
+    if args.context_len % args.page_size or args.max_total_len % args.page_size:
+        raise SystemExit(f"--page-size {args.page_size} must divide "
+                         f"--context-len and --max-total-len")
+    if args.tiny:
+        cfg = LlamaConfig.tiny(max_seq_len=args.max_total_len,
+                               sequence_parallel=False, remat="none")
+        args.max_new_tokens = min(args.max_new_tokens, 8)
+        args.num_requests = min(args.num_requests, 16)
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_layers=12, num_heads=12, num_kv_heads=12, head_dim=128,
+            max_seq_len=args.max_total_len, sequence_parallel=False,
+            remat="none",
+        )
+    from flax import linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neuronx_distributed_tpu.parallel.mesh import get_mesh
+
+    module = LlamaForCausalLM(cfg)
+    ids0 = jnp.zeros((args.batch_size, args.context_len), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), ids0)
+    specs = nn.get_partition_spec(params)
+    mesh = get_mesh()
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        nn.unbox(params), specs,
+        is_leaf=lambda x: isinstance(x, P) or not isinstance(x, dict))
+    icfg = InferenceConfig(
+        batch_size=args.batch_size, context_len=args.context_len,
+        max_total_len=args.max_total_len,
+        kv_cache_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    model = ParallelInferenceModel(module, params, icfg)
+    # the per-replica engine shape: paged KV at the drop-in pool size, so
+    # prefix pages exist to route by
+    engine_kw = dict(
+        page_size=args.page_size,
+        num_pages=args.batch_size * (args.max_total_len // args.page_size) + 1)
+
+    import numpy as np
+
+    rs = np.random.RandomState(args.seed + 2)
+    _warm(model, rs.randint(1, cfg.vocab_size,
+                            size=args.context_len // 2).tolist(), **engine_kw)
+
+    base = {"config": {"replicas": args.replicas, "batch": args.batch_size,
+                       "context": args.context_len,
+                       "max_total": args.max_total_len,
+                       "max_new": args.max_new_tokens,
+                       "page_size": args.page_size}}
+    rc = 0
+    for rung in (run_scale, run_affinity, run_failover):
+        rec = rung(args, model, cfg.vocab_size, engine_kw)
+        print(json.dumps({**rec, **base}))
+        if not rec["ok"]:
+            print(f"fleet_bench: rung {rec['rung']} FAILED", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,12 +41,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 
 def _percentiles(values, ps=(50, 99)):
-    import numpy as np
+    from neuronx_distributed_tpu.serving.driver import percentiles
 
-    if not values:
-        return {f"p{p}": None for p in ps}
-    arr = np.asarray(values, dtype=float)
-    return {f"p{p}": float(np.percentile(arr, p)) for p in ps}
+    return percentiles(values, ps)
 
 
 def run_continuous(args, model, vocab_size: int) -> dict:
@@ -60,7 +57,8 @@ def run_continuous(args, model, vocab_size: int) -> dict:
 
     from neuronx_distributed_tpu.obs import MetricRegistry
     from neuronx_distributed_tpu.obs.schemas import validate_jsonl
-    from neuronx_distributed_tpu.serving import Request, ServingEngine, replay_trace
+    from neuronx_distributed_tpu.serving import (
+        Request, ServingEngine, poisson_arrivals, replay_trace)
 
     B, C = model.config.batch_size, model.config.context_len
     rs = np.random.RandomState(args.seed)
@@ -71,9 +69,7 @@ def run_continuous(args, model, vocab_size: int) -> dict:
         rs.randint(1, vocab_size, size=rs.randint(max(2, C // 4), C + 1)).tolist()
         for _ in range(n)
     ]
-    # Poisson process: exponential inter-arrival gaps at --arrival-rate req/s
-    gaps = rs.exponential(1.0 / args.arrival_rate, size=n)
-    arrivals = np.cumsum(gaps) - gaps[0]  # first request arrives at t=0
+    arrivals = poisson_arrivals(n, args.arrival_rate, rs)
 
     # warm every compiled phase (prefill_one/insert_slot/decode_slots + the
     # static baseline's fused loop) so compile time never pollutes TTFT;
@@ -277,7 +273,8 @@ def run_spec(args, module, params, cfg, icfg) -> int:
     import numpy as np
 
     from neuronx_distributed_tpu.obs import MetricRegistry
-    from neuronx_distributed_tpu.serving import Request, ServingEngine
+    from neuronx_distributed_tpu.serving import (
+        Request, ServingEngine, poisson_arrivals)
     from neuronx_distributed_tpu.trace import ParallelInferenceModel
 
     B, C, T = args.batch_size, args.context_len, args.max_total_len
@@ -305,8 +302,7 @@ def run_spec(args, module, params, cfg, icfg) -> int:
                    size=rs.randint(max(2, C // 4), C + 1)).tolist()
         for _ in range(n)
     ]
-    gaps = rs.exponential(1.0 / args.arrival_rate, size=n)
-    arrivals = np.cumsum(gaps) - gaps[0]
+    arrivals = poisson_arrivals(n, args.arrival_rate, rs)
 
     def requests():
         return [Request(request_id=i, prompt_ids=prompts[i],

@@ -127,6 +127,12 @@ def run_extra_jobs(results_path: str) -> None:
         ("serving_spec", [sys.executable,
                           os.path.join(REPO, "tools", "serve_bench.py"),
                           "--spec"]),
+        # multi-replica fleet rungs (serving/fleet/ subsystem): N-replica
+        # goodput scaling, affinity-vs-random aggregate prefix-hit rate
+        # (rc 1 when affinity does not beat random), zero-loss failover
+        # under an injected replica kill
+        ("serving_fleet", [sys.executable,
+                           os.path.join(REPO, "tools", "fleet_bench.py")]),
         # standalone kernel programs compile fast: block-size evidence fits
         # any window even when the full train step's compile does not
         ("flash_autotune", [sys.executable,
