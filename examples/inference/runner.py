@@ -225,6 +225,45 @@ def cmd_serve(args):
         num_pages = args.num_pages or (
             args.batch_size * (args.max_total_len // args.page_size) + 1)
         paged_kw = dict(page_size=args.page_size, num_pages=num_pages)
+    if args.kv_dtype == "int8":
+        # int8 KV pages: same page count by default, half the HBM — or
+        # shrink --num-pages less aggressively for ~2x the in-flight
+        # requests at the fp pool's byte budget
+        if not args.page_size:
+            raise SystemExit("--kv-dtype int8 quantizes KV pages: pass "
+                             "--page-size")
+        paged_kw["kv_quant"] = "int8"
+    n_adapters = args.adapters or 0
+    if n_adapters:
+        # multi-tenant demo: N random rank-4 LoRA adapters registered on
+        # every engine, requests round-robined across them (JSONL prompt
+        # specs may instead pin one explicitly via "adapter_id")
+        if not args.page_size:
+            raise SystemExit("--adapters needs --page-size: adapter paging "
+                             "rides the paged engine")
+
+        def make_store():
+            import numpy as np
+
+            from neuronx_distributed_tpu.tenancy import (
+                AdapterLayout, AdapterStore)
+
+            H, NQ, NKV, D = (cfg.hidden_size, cfg.num_heads,
+                             cfg.num_kv_heads, cfg.head_dim_)
+            rank = 4
+            layout = AdapterLayout.for_model(model, rank, 2048)
+            # every adapter resident at once, plus the NULL page
+            store = AdapterStore(
+                layout, n_adapters * layout.pages_per_adapter + 1)
+            for aid in range(1, n_adapters + 1):
+                r2 = np.random.RandomState(args.seed + aid)
+                store.register(aid, [{
+                    "a_q": (r2.randn(H, rank) * 0.05).astype(np.float32),
+                    "b_q": (r2.randn(rank, NQ * D) * 0.05).astype(np.float32),
+                    "a_v": (r2.randn(H, rank) * 0.05).astype(np.float32),
+                    "b_v": (r2.randn(rank, NKV * D) * 0.05).astype(np.float32),
+                } for _ in range(cfg.num_layers)], alpha=8.0)
+            return store
     if args.draft:
         # speculative serving: a co-batched draft proposes --spec-k tokens
         # per slot per step, the target verifies them in one batched chunk.
@@ -238,18 +277,25 @@ def cmd_serve(args):
     fleet = args.replicas > 1
     if fleet:
         # in-process fleet: N engines share the one compiled model (one
-        # set of device params) but each owns its KV state; --stats-out
-        # becomes the router's router_stats.jsonl instead of a single
-        # engine's serving_stats.jsonl
+        # set of device params) but each owns its KV state — and, with
+        # --adapters, its own adapter store (every adapter registered on
+        # every replica, so a requeued clone is admissible anywhere);
+        # --stats-out becomes the router's router_stats.jsonl instead of a
+        # single engine's serving_stats.jsonl
         def factory():
+            kw = dict(paged_kw)
+            if n_adapters:
+                kw["adapter_store"] = make_store()
             return ServingEngine(
                 model, rng=jax.random.PRNGKey(args.seed),
-                registry=MetricRegistry(), **paged_kw)
+                registry=MetricRegistry(), **kw)
 
         target = FleetRouter(
             [Replica(i, factory) for i in range(args.replicas)],
             policy=args.routing, seed=args.seed, stats_path=args.stats_out)
     else:
+        if n_adapters:
+            paged_kw["adapter_store"] = make_store()
         target = engine = ServingEngine(
             model, rng=jax.random.PRNGKey(args.seed),
             stats_path=args.stats_out, **paged_kw)
@@ -261,6 +307,8 @@ def cmd_serve(args):
             sampling=SamplingParams(
                 temperature=float(s.get("temperature", args.temperature))),
             stream_cb=stream,
+            adapter_id=int(s.get(
+                "adapter_id", (i % n_adapters) + 1 if n_adapters else 0)),
         )
         for i, s in enumerate(specs)
     ]
@@ -294,6 +342,8 @@ def cmd_serve(args):
         if args.page_size:
             summary["fleet_prefix_hit_rate"] = prefix["prefix_hit_rate"]
             summary["prefills_skipped"] = prefix["prefills_skipped"]
+        if n_adapters:
+            summary["adapters"] = n_adapters
         print(json.dumps(summary))
         return
     engine.close()
@@ -313,6 +363,16 @@ def cmd_serve(args):
         summary["prefix_hits"] = int(snap.get("kvcache/prefix_hits_total", 0))
         summary["prefills_skipped"] = int(
             snap.get("kvcache/prefill_skipped_total", 0))
+    if args.kv_dtype == "int8":
+        summary["quant_page_writes"] = int(
+            snap.get("kvcache/quant_pages_total", 0))
+    if n_adapters:
+        summary["adapters_resident"] = int(
+            snap.get("tenancy/adapters_resident", 0))
+        summary["adapter_loads"] = int(
+            snap.get("tenancy/adapter_loads_total", 0))
+        summary["adapter_hits"] = int(
+            snap.get("tenancy/adapter_hits_total", 0))
     if args.draft:
         proposed = snap.get("serving/spec_proposed_total", 0.0)
         rounds = snap.get("serving/spec_rounds_total", 0.0)
@@ -420,6 +480,15 @@ def main():
                          "contiguous engine's batch*total footprint + the "
                          "reserved NULL page; smaller pools trade HBM for "
                          "admission backpressure)")
+    sp.add_argument("--adapters", type=int, default=0,
+                    help="multi-tenant demo: register this many random "
+                         "rank-4 LoRA adapters and round-robin requests "
+                         "across them (JSONL specs may pin 'adapter_id'); "
+                         "needs --page-size")
+    sp.add_argument("--kv-dtype", default="fp", choices=["fp", "int8"],
+                    help="KV page dtype: int8 stores pages quantized with "
+                         "per-page scale/zero (~2x pages per HBM byte at a "
+                         "bounded logit drift); needs --page-size")
     sp.add_argument("--draft", default=None,
                     help="enable speculative serving with this draft-model "
                          "preset (same family/seed as the target, so a "

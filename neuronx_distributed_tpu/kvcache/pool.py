@@ -22,7 +22,7 @@ pages — a dp-sharded page axis would turn every gather into a collective).
 
 from __future__ import annotations
 
-from typing import Any, List, Tuple
+from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,15 +45,35 @@ def init_page_pool_caches(
     num_kv_heads: int,
     head_dim: int,
     dtype: Any = jnp.bfloat16,
-) -> List[Tuple[jax.Array, jax.Array]]:
+    quant: Optional[str] = None,
+) -> List[Tuple[jax.Array, ...]]:
     """Zero page-pool caches ``[NP, page, NKV, D]`` per layer, kv-heads
     sharded over tp when divisible (the same policy as the contiguous
-    ``init_kv_caches``); the page axis is unsharded — it is a global pool."""
+    ``init_kv_caches``); the page axis is unsharded — it is a global pool.
+
+    ``quant="int8"`` switches each layer's entry from the fp pair
+    ``(k, v)`` to the six-tuple ``(k int8, v int8, k_scale, k_zero,
+    v_scale, v_zero)`` with one fp32 scale/zero per physical page (see
+    :mod:`.quant`) — the structural marker the model's block-table
+    scatter/gather keys its dequantize-in-the-gather path on."""
     shape = (num_pages, page_size, num_kv_heads, head_dim)
-    caches = [
-        (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-        for _ in range(num_layers)
-    ]
+    if quant is None:
+        caches: List[Tuple[jax.Array, ...]] = [
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(num_layers)
+        ]
+    elif quant == "int8":
+        caches = [
+            (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+             jnp.zeros((num_pages,), jnp.float32),
+             jnp.zeros((num_pages,), jnp.float32),
+             jnp.zeros((num_pages,), jnp.float32),
+             jnp.zeros((num_pages,), jnp.float32))
+            for _ in range(num_layers)
+        ]
+    else:
+        raise ValueError(f"unknown KV quantization {quant!r} "
+                         "(supported: 'int8')")
     if model_parallel_is_initialized():
         mesh = get_mesh()
         kv_axes = (TENSOR_AXIS
@@ -63,7 +83,11 @@ def init_page_pool_caches(
                 "page pool kv head dim (%d) not divisible by tp (%d); "
                 "replicating", num_kv_heads, mesh.shape[TENSOR_AXIS])
         spec = named_sharding(None, None, kv_axes, None)
-        caches = jax.tree.map(lambda x: jax.device_put(x, spec), caches)
+        scale_spec = named_sharding(None)  # per-page params: replicated
+        caches = jax.tree.map(
+            lambda x: jax.device_put(
+                x, spec if x.ndim == 4 else scale_spec),
+            caches)
     return caches
 
 
@@ -85,6 +109,7 @@ class PagePool:
         num_kv_heads: int,
         head_dim: int,
         dtype: Any = jnp.bfloat16,
+        quant: Optional[str] = None,
     ):
         if num_pages < 2:
             raise ValueError(
@@ -98,15 +123,21 @@ class PagePool:
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
         self.dtype = dtype
+        self.quant = quant
         self.caches = init_page_pool_caches(
-            num_layers, num_pages, page_size, num_kv_heads, head_dim, dtype)
+            num_layers, num_pages, page_size, num_kv_heads, head_dim, dtype,
+            quant=quant)
 
     @property
     def page_bytes(self) -> int:
-        """HBM bytes one page costs across all layers (k + v)."""
-        itemsize = jnp.dtype(self.dtype).itemsize
-        return (2 * self.num_layers * self.page_size * self.num_kv_heads
-                * self.head_dim * itemsize)
+        """HBM bytes one page costs across all layers (k + v, plus the
+        per-page scale/zero params under int8 quantization — honest
+        accounting: the quantized pool pays for its metadata)."""
+        from neuronx_distributed_tpu.kvcache.quant import page_layer_bytes
+
+        return self.num_layers * page_layer_bytes(
+            self.page_size, self.num_kv_heads, self.head_dim, self.quant,
+            self.dtype)
 
     @property
     def total_bytes(self) -> int:
@@ -115,11 +146,15 @@ class PagePool:
     @staticmethod
     def pages_for_budget(budget_bytes: int, num_layers: int, page_size: int,
                          num_kv_heads: int, head_dim: int,
-                         dtype: Any = jnp.bfloat16) -> int:
+                         dtype: Any = jnp.bfloat16,
+                         quant: Optional[str] = None) -> int:
         """How many pool pages a given HBM budget buys — the sizing half of
         the paged-vs-contiguous comparison (a contiguous ``[B, T]`` cache's
-        budget is ``B * T / page_size`` pages)."""
-        itemsize = jnp.dtype(dtype).itemsize
-        per_page = (2 * num_layers * page_size * num_kv_heads * head_dim
-                    * itemsize)
+        budget is ``B * T / page_size`` pages).  ``quant="int8"`` roughly
+        doubles the answer at a fixed budget versus bf16 (1 byte/element +
+        four fp32 page params instead of 2 bytes/element)."""
+        from neuronx_distributed_tpu.kvcache.quant import page_layer_bytes
+
+        per_page = num_layers * page_layer_bytes(
+            page_size, num_kv_heads, head_dim, quant, dtype)
         return max(int(budget_bytes // per_page), 0)

@@ -46,17 +46,32 @@ from neuronx_distributed_tpu.kvcache.allocator import NULL_PAGE, BlockAllocator
 # page-key code for a left-padding slot (never a valid token id)
 PAD = -1
 
+# leading marker of a salted (per-adapter) page key — distinct from PAD and
+# from any valid token id, so a salted key can never collide with a plain one
+SALT_MARK = -2
+
 EVICTIONS_TOTAL = "kvcache/evictions_total"
 
 PageKey = Tuple[int, ...]
 
 
 def page_keys(ids_row: Sequence[int], valid_row: Sequence[int],
-              page_size: int) -> List[PageKey]:
+              page_size: int, salt: int = 0) -> List[PageKey]:
     """Page keys for one padded prompt row: per page, the tuple of token ids
     with padding slots replaced by :data:`PAD`.  ``ids_row`` / ``valid_row``
     are the row's ``[C]`` padded ids and 0/1 validity; ``C`` must divide by
-    ``page_size``."""
+    ``page_size``.
+
+    ``salt`` namespaces the keys (the tenancy subsystem salts with the
+    request's LoRA ``adapter_id``): a cached KV page's content depends on
+    the adapter that prefilled it (the v projection carries the adapter
+    delta), so two requests may share a prefix page only when their tokens,
+    padding layout AND adapter all agree.  Non-padding keys grow a leading
+    ``(SALT_MARK, salt)`` pair; all-padding pages stay the plain all-PAD
+    key — their content is masked out of every attention, so the NULL page
+    backs them for free regardless of adapter.  ``salt == 0`` (the
+    no-adapter default) keeps the historical key format bit-for-bit, so
+    existing tries and fleet fingerprints are unchanged."""
     n = len(ids_row)
     if n % page_size != 0:
         raise ValueError(
@@ -64,9 +79,12 @@ def page_keys(ids_row: Sequence[int], valid_row: Sequence[int],
     keys = []
     for p in range(n // page_size):
         lo = p * page_size
-        keys.append(tuple(
+        key = tuple(
             int(ids_row[lo + i]) if valid_row[lo + i] else PAD
-            for i in range(page_size)))
+            for i in range(page_size))
+        if salt and not is_padding_key(key):
+            key = (SALT_MARK, int(salt)) + key
+        keys.append(key)
     return keys
 
 

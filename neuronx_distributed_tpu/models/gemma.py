@@ -141,7 +141,7 @@ class GemmaForCausalLM(nn.Module):
                                   param_dtype=cfg.param_dtype)
 
     def _backbone(self, ids, positions, kv_caches, cache_offset, kv_valid,
-                  segment_ids, block_table=None):
+                  segment_ids, block_table=None, adapters=None):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
@@ -157,7 +157,8 @@ class GemmaForCausalLM(nn.Module):
             cache = kv_caches[i] if kv_caches is not None else None
             h, c = block(h, positions, cache,
                          cache_offset if kv_caches is not None else 0,
-                         kv_valid, segment_ids, block_table)
+                         kv_valid, segment_ids, block_table,
+                         adapters[i] if adapters is not None else None)
             new_caches.append(c)
         h = self.final_norm(h)
         if cfg.sequence_parallel and kv_caches is None:
@@ -166,10 +167,11 @@ class GemmaForCausalLM(nn.Module):
         return h, new_caches
 
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
-                 kv_valid=None, segment_ids=None, block_table=None):
+                 kv_valid=None, segment_ids=None, block_table=None,
+                 adapters=None):
         h, new_caches = self._backbone(
             ids, positions, kv_caches, cache_offset, kv_valid, segment_ids,
-            block_table)
+            block_table, adapters)
         logits = self.embed.attend(h)
         return (logits, new_caches) if kv_caches is not None else logits
 
@@ -291,7 +293,8 @@ class Gemma2Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, cache_offset=0,
-                 kv_valid=None, segment_ids=None, block_table=None):
+                 kv_valid=None, segment_ids=None, block_table=None,
+                 adapter=None):
         cfg = self.config
 
         def norm(name):
@@ -300,7 +303,7 @@ class Gemma2Block(nn.Module):
 
         h, new_cache = LlamaAttention(cfg, name="attn")(
             norm("input_norm")(x), positions, kv_cache, cache_offset,
-            kv_valid, segment_ids, block_table)
+            kv_valid, segment_ids, block_table, adapter)
         x = x + norm("post_attn_norm")(h)
         h = LlamaMLP(cfg, name="mlp")(norm("pre_ffw_norm")(x))
         x = x + norm("post_ffw_norm")(h)
@@ -336,7 +339,7 @@ class Gemma2ForCausalLM(nn.Module):
                                   param_dtype=cfg.param_dtype)
 
     def _backbone(self, ids, positions, kv_caches, cache_offset, kv_valid,
-                  segment_ids, block_table=None):
+                  segment_ids, block_table=None, adapters=None):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
@@ -350,7 +353,8 @@ class Gemma2ForCausalLM(nn.Module):
             cache = kv_caches[i] if kv_caches is not None else None
             h, c = block(h, positions, cache,
                          cache_offset if kv_caches is not None else 0,
-                         kv_valid, segment_ids, block_table)
+                         kv_valid, segment_ids, block_table,
+                         adapters[i] if adapters is not None else None)
             new_caches.append(c)
         h = self.final_norm(h)
         if cfg.sequence_parallel and kv_caches is None:
@@ -366,10 +370,11 @@ class Gemma2ForCausalLM(nn.Module):
         return logits
 
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
-                 kv_valid=None, segment_ids=None, block_table=None):
+                 kv_valid=None, segment_ids=None, block_table=None,
+                 adapters=None):
         h, new_caches = self._backbone(
             ids, positions, kv_caches, cache_offset, kv_valid, segment_ids,
-            block_table)
+            block_table, adapters)
         logits = self._logits(h)
         return (logits, new_caches) if kv_caches is not None else logits
 

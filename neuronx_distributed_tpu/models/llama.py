@@ -387,7 +387,7 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None,
-                 segment_ids=None, block_table=None):
+                 segment_ids=None, block_table=None, adapter=None):
         cfg = self.config
         D = cfg.head_dim_
         q, k, v = GQAQKVColumnParallelLinear(
@@ -402,14 +402,50 @@ class LlamaAttention(nn.Module):
             param_dtype=cfg.param_dtype,
             name="qkv",
         )(x)
+        if adapter is not None:
+            # batched multi-adapter serving (tenancy/ subsystem): per-SLOT
+            # LoRA deltas on the standard q/v pair, as one gathered low-rank
+            # einsum pair per projection — adapter holds the already-gathered
+            # per-slot factors (a_q [B, H, r], b_q [B, r, NQ*D], a_v, b_v;
+            # the alpha/r scale is folded into b at registration, and
+            # adapter 0's factors are the NULL page's zeros, so a
+            # no-adapter slot adds an exact zero).  Applied BEFORE RoPE —
+            # the delta is part of the projection, like the trained-in
+            # lora_rank path above.
+            a_q, b_q, a_v, b_v = adapter
+            B_, S_ = x.shape[0], x.shape[1]
+            xq = jnp.einsum("bsh,bhr->bsr", x.astype(cfg.dtype),
+                            a_q.astype(cfg.dtype),
+                            preferred_element_type=cfg.dtype)
+            dq = jnp.einsum("bsr,bro->bso", xq, b_q.astype(cfg.dtype),
+                            preferred_element_type=cfg.dtype)
+            q = q + dq.reshape(B_, S_, cfg.num_heads, D)
+            xv = jnp.einsum("bsh,bhr->bsr", x.astype(cfg.dtype),
+                            a_v.astype(cfg.dtype),
+                            preferred_element_type=cfg.dtype)
+            dv = jnp.einsum("bsr,bro->bso", xv, b_v.astype(cfg.dtype),
+                            preferred_element_type=cfg.dtype)
+            v = v + dv.reshape(B_, S_, cfg.num_kv_heads, D)
         sin, cos = rope_sin_cos(positions, D, cfg.rope_theta, cfg.rope_scaling_)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 
         new_cache = None
         if kv_cache is not None:
-            # decode: write new k/v at cache_offset, attend over the cache
-            ck, cv = kv_cache
+            # decode: write new k/v at cache_offset, attend over the cache.
+            # A six-tuple cache entry is an int8-quantized page pool
+            # (kvcache.quant): per-page fp32 scale/zero ride alongside the
+            # int8 payload, writes re-quantize the touched page, and the
+            # gather dequantizes back to the compute dtype.
+            quantized = len(kv_cache) == 6
+            if quantized:
+                if block_table is None:
+                    raise ValueError(
+                        "quantized KV caches are page pools: the contiguous "
+                        "decode paths take fp caches only")
+                ck, cv, ks, kz, vs, vz = kv_cache
+            else:
+                ck, cv = kv_cache
             if block_table is not None:
                 # paged decode (kvcache/ subsystem): the cache is the global
                 # page pool [NP, page, NKV, D] and block_table [B, PP] maps
@@ -437,10 +473,45 @@ class LlamaAttention(nn.Module):
                 # a parked slot (offset >= T) writes nothing: route it out of
                 # range and let the scatter drop it
                 phys = jnp.where(idx < T, phys, NP)
-                ck = ck.at[phys, in_off].set(
-                    k.astype(ck.dtype), mode="drop")
-                cv = cv.at[phys, in_off].set(
-                    v.astype(cv.dtype), mode="drop")
+                if quantized:
+                    # single-token quantize-on-write: gather each slot's
+                    # touched page, dequantize it, insert the new token,
+                    # re-quantize the whole page and scatter it (and its
+                    # fresh scale/zero) back.  Decode pages are exclusively
+                    # owned per slot (never shared — sharing is prompt-page
+                    # only), so the page-granular read-modify-write cannot
+                    # race another slot; parked rows gather a clipped page
+                    # whose writeback drops at phys == NP.
+                    if Sn != 1:
+                        raise ValueError(
+                            "quantized KV pages support single-token decode "
+                            f"scatter only, got {Sn} new positions "
+                            "(speculative multi-token verification writes "
+                            "are fp-pool only)")
+                    from neuronx_distributed_tpu.kvcache.quant import (
+                        dequantize_page, quantize_page)
+
+                    p1 = phys[:, 0]                      # [B]
+                    pc = jnp.clip(p1, 0, NP - 1)
+                    hot = (jnp.arange(page)[None, :, None, None]
+                           == in_off[:, 0][:, None, None, None])
+
+                    def requant_write(cq, sc, zp, new):
+                        pg = dequantize_page(cq[pc], sc[pc], zp[pc])
+                        pg = jnp.where(hot, new.astype(pg.dtype), pg)
+                        q2, s2, z2 = quantize_page(pg)
+                        cq = cq.at[p1].set(q2, mode="drop")
+                        sc = sc.at[p1].set(s2, mode="drop")
+                        zp = zp.at[p1].set(z2, mode="drop")
+                        return cq, sc, zp
+
+                    ck, ks, kz = requant_write(ck, ks, kz, k)
+                    cv, vs, vz = requant_write(cv, vs, vz, v)
+                else:
+                    ck = ck.at[phys, in_off].set(
+                        k.astype(ck.dtype), mode="drop")
+                    cv = cv.at[phys, in_off].set(
+                        v.astype(cv.dtype), mode="drop")
             elif jnp.ndim(cache_offset) == 1:
                 # per-example write positions [B] (continuous batching: every
                 # slot decodes at its own offset).  Single-token steps only —
@@ -458,12 +529,30 @@ class LlamaAttention(nn.Module):
             else:
                 ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_offset, axis=1)
                 cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_offset, axis=1)
-            new_cache = (ck, cv)
+            new_cache = (ck, cv, ks, kz, vs, vz) if quantized else (ck, cv)
             if block_table is not None:
                 # attend over the gathered per-row view, not the raw pool
                 B_, T = x.shape[0], block_table.shape[1] * ck.shape[1]
-                k = ck[block_table].reshape(B_, T, ck.shape[2], ck.shape[3])
-                v = cv[block_table].reshape(B_, T, cv.shape[2], cv.shape[3])
+                if quantized:
+                    # dequantize-in-the-gather: page params gather alongside
+                    # the int8 pages, and the result is the SAME [B, T] fp
+                    # view the band-mask core attends over — attention math
+                    # untouched, drift bounded by the per-page quant step
+                    from neuronx_distributed_tpu.kvcache.quant import (
+                        dequantize_page,
+                    )
+
+                    k = dequantize_page(
+                        ck[block_table], ks[block_table], kz[block_table],
+                        dtype=q.dtype).reshape(
+                            B_, T, ck.shape[2], ck.shape[3])
+                    v = dequantize_page(
+                        cv[block_table], vs[block_table], vz[block_table],
+                        dtype=q.dtype).reshape(
+                            B_, T, cv.shape[2], cv.shape[3])
+                else:
+                    k = ck[block_table].reshape(B_, T, ck.shape[2], ck.shape[3])
+                    v = cv[block_table].reshape(B_, T, cv.shape[2], cv.shape[3])
             else:
                 k, v = ck, cv
 
@@ -534,13 +623,13 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None,
-                 segment_ids=None, block_table=None):
+                 segment_ids=None, block_table=None, adapter=None):
         cfg = self.config
         h, new_cache = LlamaAttention(cfg, name="attn")(
             RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                     name="input_norm")(x),
             positions, kv_cache, cache_offset, kv_valid, segment_ids,
-            block_table,
+            block_table, adapter,
         )
         x = x + h
         normed = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -579,7 +668,8 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
-                 kv_valid=None, segment_ids=None, block_table=None):
+                 kv_valid=None, segment_ids=None, block_table=None,
+                 adapters=None):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
@@ -623,7 +713,8 @@ class LlamaModel(nn.Module):
                 if kv_caches is not None:
                     h, c = LlamaBlock(cfg, name=f"layer_{i}")(
                         h, positions, cache, cache_offset, kv_valid, segment_ids,
-                        block_table)
+                        block_table,
+                        adapters[i] if adapters is not None else None)
                 else:
                     h, c = block_cls(cfg, name=f"layer_{i}")(
                         h, positions, None, 0, kv_valid, segment_ids)
@@ -665,10 +756,11 @@ class LlamaForCausalLM(nn.Module):
         )
 
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
-                 kv_valid=None, segment_ids=None, block_table=None):
+                 kv_valid=None, segment_ids=None, block_table=None,
+                 adapters=None):
         h, new_caches = self.model(
             ids, positions, kv_caches, cache_offset, kv_valid, segment_ids,
-            block_table)
+            block_table, adapters)
         if self.config.sequence_parallel and kv_caches is None:
             # gather the sequence back before the (batched) head matmul
             h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))

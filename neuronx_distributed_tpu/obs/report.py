@@ -216,6 +216,36 @@ def _summarize_speculative(scalars: Dict[str, dict]) -> Optional[dict]:
     }
 
 
+def _summarize_tenancy(scalars: Dict[str, dict]) -> Optional[dict]:
+    """Multi-tenant serving health from the ``tenancy/*`` registry scalars
+    (plus ``kvcache/quant_pages_total``): adapter-pool residency and churn
+    — how many adapters are device-resident, how much of the pool they
+    hold, and the hit/load/eviction split (a high eviction count means the
+    adapter pool thrashes — grow it or steer with adapter affinity).  None
+    when the run served no multi-adapter or quantized engine."""
+    resident = scalars.get("tenancy/adapters_resident")
+    quant = scalars.get("kvcache/quant_pages_total")
+    if (resident is None or resident.get("last") is None) and quant is None:
+        return None
+
+    def last(tag):
+        s = scalars.get(tag)
+        return s["last"] if s else 0.0
+
+    hits = last("tenancy/adapter_hits_total")
+    loads = last("tenancy/adapter_loads_total")
+    return {
+        "adapters_resident": last("tenancy/adapters_resident"),
+        "adapter_pool_pages_in_use": last("tenancy/adapter_pool_pages_in_use"),
+        "adapter_hits": hits,
+        "adapter_loads": loads,
+        "adapter_hit_rate": (round(hits / (hits + loads), 4)
+                             if hits + loads else None),
+        "adapter_evictions": last("tenancy/adapter_evictions_total"),
+        "quant_pages": last("kvcache/quant_pages_total"),
+    }
+
+
 def _summarize_fleet(scalars: Dict[str, dict]) -> Optional[dict]:
     """Fleet-router health from the ``router/*`` registry scalars: pool
     size still in rotation, dispatch/requeue/failover accounting (requeues
@@ -342,6 +372,7 @@ def build_report(
     kvcache = _summarize_kvcache(scalars)
     speculative = _summarize_speculative(scalars)
     fleet = _summarize_fleet(scalars)
+    tenancy = _summarize_tenancy(scalars)
     report = {
         "schema": OBS_REPORT_SCHEMA,
         "generated_at": time.time(),
@@ -366,6 +397,7 @@ def build_report(
             "kvcache": kvcache,
             "speculative": speculative,
             "fleet": fleet,
+            "tenancy": tenancy,
             "total_collective_count": sum(
                 a.get("total_collective_count", 0) for a in audits),
             "total_collective_bytes": sum(
@@ -418,6 +450,18 @@ def render_markdown(report: dict) -> str:
             f"{fleet['failovers']:.0f} failover(s) "
             f"({fleet['restarts']:.0f} restarts, "
             f"{fleet['retired']:.0f} retired); {aff}{pool}")
+    ten = h.get("tenancy")
+    if ten:
+        hit = (f"{ten['adapter_hit_rate']:.1%} adapter hit rate "
+               f"({ten['adapter_hits']:.0f} hits/"
+               f"{ten['adapter_loads']:.0f} loads)"
+               if ten["adapter_hit_rate"] is not None else "no adapter pins")
+        quant = (f"; {ten['quant_pages']:.0f} int8 page writes"
+                 if ten["quant_pages"] else "")
+        lines.append(
+            f"- tenancy: {ten['adapters_resident']:.0f} adapter(s) resident "
+            f"({ten['adapter_pool_pages_in_use']:.0f} pool pages); {hit}; "
+            f"{ten['adapter_evictions']:.0f} evictions{quant}")
     spec = h.get("speculative")
     if spec:
         rate = (f"{spec['acceptance_rate']:.1%} acceptance"

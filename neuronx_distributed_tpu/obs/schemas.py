@@ -44,7 +44,9 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     # never produced a token (cancelled/timed out while queued).  v2 adds
     # the speculative-decoding accounting: draft tokens proposed/accepted
     # for the request and its acceptance rate (null when the engine never
-    # speculated for it — including every non-spec engine)
+    # speculated for it — including every non-spec engine).  v3 adds the
+    # tenancy accounting: which LoRA adapter served the request (0 = the
+    # base model — every request off multi-adapter mode)
     "serving_stats": {
         "schema": str, "time": _NUM, "request_id": int, "state": str,
         "finish_reason": (str, type(None)), "prompt_len": int,
@@ -52,6 +54,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "ttft_ms": (int, float, type(None)), "total_ms": _NUM,
         "spec_proposed": int, "spec_accepted": int,
         "acceptance_rate": (int, float, type(None)),
+        "adapter_id": int,
     },
     # one line of router_stats.jsonl (serving.fleet.router.FleetRouter) —
     # one record per TERMINAL request across the whole fleet: which replica
@@ -116,6 +119,17 @@ REGISTRY_METRICS: Dict[str, str] = {
     "kvcache/prefill_skipped_total": "counter",
     "kvcache/cow_copies_total": "counter",
     "kvcache/evictions_total": "counter",
+    # int8 KV pages (kvcache.quant): pages written through a
+    # quantize-on-write path (prefill page writes + decode requant writes)
+    "kvcache/quant_pages_total": "counter",
+    # multi-tenant serving (tenancy.AdapterStore) — adapter-pool residency
+    # and churn: hits are pure refcount bumps, loads page a cold adapter
+    # in, evictions reclaim an unpinned one under pressure
+    "tenancy/adapters_resident": "gauge",
+    "tenancy/adapter_pool_pages_in_use": "gauge",
+    "tenancy/adapter_hits_total": "counter",
+    "tenancy/adapter_loads_total": "counter",
+    "tenancy/adapter_evictions_total": "counter",
     # serving speculative decoding (serving.engine draft-k-verify rounds):
     # proposed/accepted measure draft quality, committed/rounds is the
     # tokens-per-step headline

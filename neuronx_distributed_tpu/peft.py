@@ -35,8 +35,13 @@ def lora_trainable(path: str) -> bool:
 
 
 def _is_lora_leaf_path(path_keys) -> bool:
-    last = str(getattr(path_keys[-1], "key", path_keys[-1])) if path_keys else ""
-    return "lora_" in last
+    """True when ANY path component names a LoRA factor — not just the
+    leaf.  Adapter pytrees coming back from wrappers (optimizer state
+    mirrors, orbax restore shims, per-device trees) can nest extra levels
+    UNDER the ``lora_a``/``lora_b`` key (e.g. ``.../lora_a/value``); a
+    last-key-only match silently dropped those leaves from
+    :func:`lora_params`, truncating the adapter checkpoint."""
+    return any("lora_" in str(getattr(k, "key", k)) for k in path_keys)
 
 
 def lora_params(params: Any) -> Any:

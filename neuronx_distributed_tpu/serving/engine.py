@@ -64,8 +64,11 @@ from neuronx_distributed_tpu.serving.request import (
     RequestOutput,
     RequestState,
 )
+from neuronx_distributed_tpu.kvcache.allocator import PoolExhausted
+from neuronx_distributed_tpu.kvcache.quant import QUANT_PAGES_TOTAL
 from neuronx_distributed_tpu.serving.paged import PagedKVManager
 from neuronx_distributed_tpu.serving.scheduler import (
+    AdmissionError,
     BackpressureError,
     SlotScheduler,
 )
@@ -80,7 +83,7 @@ from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
-SERVING_STATS_SCHEMA = "serving_stats/2"
+SERVING_STATS_SCHEMA = "serving_stats/3"
 
 FAIL_NON_FINITE = "non_finite_logits"
 
@@ -292,6 +295,25 @@ class ServingEngine:
     reproduces plain sampling bit-for-bit.  Per-request acceptance rates
     land in ``serving_stats.jsonl`` and the ``serving/spec_*_total``
     counters (committed/rounds is the tokens-per-step headline).
+
+    Multi-tenant serving (tenancy PR; paged mode only):
+
+    - ``adapter_store=`` (a :class:`~..tenancy.AdapterStore`) serves many
+      LoRA adapters from ONE compiled envelope: ``Request.adapter_id``
+      names the adapter, admission pins it resident (paging its weight
+      blocks through the store's refcounted allocator, LRU-evicting cold
+      adapters), every decode step applies the per-slot deltas as one
+      gathered low-rank einsum pair (S-LoRA-style), and every terminal
+      state releases the pin.  Adapter 0 is the base model — an engine
+      whose batch holds only adapter-0 requests is token-identical to the
+      storeless engine.  Prefix-cache keys are salted per adapter, so
+      prompt-page sharing stays exact within an adapter and never crosses
+      adapters;
+    - ``kv_quant="int8"`` stores KV pages int8 with per-page scale/zero
+      (quantize-on-write, dequantize-in-the-gather; see
+      ``kvcache.quant``), roughly doubling ``pages_for_budget`` at a
+      bounded, parity-tested logit drift.  ``kvcache/quant_pages_total``
+      counts quantized page writes.
     """
 
     def __init__(
@@ -313,6 +335,8 @@ class ServingEngine:
         prefix_cache: bool = True,
         draft: Any = None,
         spec_k: int = 0,
+        adapter_store: Any = None,
+        kv_quant: Optional[str] = None,
     ):
         attrs = ("prefill_one", "insert_slot", "decode_slots")
         if page_size is not None:
@@ -320,6 +344,9 @@ class ServingEngine:
                       "make_page_pool")
         if spec_k:
             attrs += ("verify_pages",)
+        if adapter_store is not None:
+            attrs += ("decode_pages_lora", "prefill_one_lora",
+                      "make_adapter_pool", "write_adapter_page")
         for attr in attrs:
             if not hasattr(model, attr):
                 raise TypeError(
@@ -344,6 +371,31 @@ class ServingEngine:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         self._spec_k = int(spec_k)
         self._draft_model = draft
+        # multi-tenant serving (tenancy/): per-request LoRA adapters paged
+        # through the adapter store; int8 KV pages double the pool at a
+        # measured, bounded logit drift.  Both live on the paged machinery,
+        # and neither composes with speculative decoding yet (the verify
+        # chunk would need adapter-aware/requantizing multi-token writes).
+        if adapter_store is not None and page_size is None:
+            raise ValueError(
+                "adapter_store needs the paged engine (page_size=/"
+                "num_pages=): adapter pages ride the same machinery as KV "
+                "pages")
+        if kv_quant is not None:
+            if kv_quant != "int8":
+                raise ValueError(
+                    f"kv_quant must be 'int8' or None, got {kv_quant!r}")
+            if page_size is None:
+                raise ValueError(
+                    "kv_quant quantizes KV pages: pass page_size=/"
+                    "num_pages= alongside it")
+        if spec_k and (adapter_store is not None or kv_quant is not None):
+            raise ValueError(
+                "speculative decoding does not compose with adapter_store/"
+                "kv_quant yet (the multi-token verification chunk would "
+                "need adapter-aware, requantizing page writes)")
+        self._adapters = adapter_store
+        self._kv_quant = kv_quant
         if spec_k:
             if page_size is None:
                 raise ValueError(
@@ -437,12 +489,15 @@ class ServingEngine:
         # [B, T] rows, or the global page pool in paged mode (the paged
         # pool's HBM is num_pages * page_bytes, decoupled from B * T)
         if self._kv is not None:
-            pool = model.make_page_pool(num_pages, page_size)
+            pool = model.make_page_pool(num_pages, page_size,
+                                        quant=self._kv_quant)
             self.caches = pool.caches
             logger.info(
-                "serving: paged KV pool: %d pages x %d tokens "
+                "serving: paged KV pool: %d pages x %d tokens%s "
                 "(%.1f MiB; contiguous [B=%d, T=%d] would be %.1f MiB)",
-                num_pages, page_size, pool.total_bytes / 2**20, self.B,
+                num_pages, page_size,
+                f" ({self._kv_quant} quantized)" if self._kv_quant else "",
+                num_pages * pool.page_bytes / 2**20, self.B,
                 self.T, pool.page_bytes * self.B * self.T / page_size / 2**20)
         else:
             self.caches = model.empty_caches()
@@ -464,6 +519,25 @@ class ServingEngine:
         self._temps = np.zeros((self.B,), np.float32)
         self._topks = np.zeros((self.B,), np.int32)
         self._topps = np.ones((self.B,), np.float32)
+
+        # multi-adapter state (tenancy/): the preallocated device adapter
+        # pool, the per-slot adapter page tables (all-NULL = adapter 0 =
+        # exact identity), and the host-side slot -> adapter pin map the
+        # terminal paths release through.  The table rides the packed
+        # explicit put (async path) only when admission dirtied it.
+        self._adapter_pool = None
+        self._atables_dev = None
+        if self._adapters is not None:
+            if self._adapters.registry is None:
+                self._adapters.attach_registry(self.registry)
+            self._adapter_pool = model.make_adapter_pool(
+                self._adapters.layout, self._adapters.num_pages)
+            ap = self._adapters.layout.pages_per_adapter
+            self._adapter_tables = np.zeros((self.B, ap), np.int32)
+            self._slot_adapter = [0] * self.B
+            self._adapter_dirty = True
+        if self._kv_quant is not None:
+            self.registry.counter(QUANT_PAGES_TOTAL)
 
         # pre-declare so a zero-request engine still exports the full set
         reg = self.registry
@@ -496,6 +570,18 @@ class ServingEngine:
             raise ValueError(
                 f"request {request.request_id} samples (temperature "
                 f"{request.sampling.temperature}) but the engine has no rng")
+        aid = getattr(request, "adapter_id", 0)
+        if aid:
+            # permanent rejections up front, like the envelope checks: an
+            # unknown adapter can never be served, no matter the load
+            if self._adapters is None:
+                raise AdmissionError(
+                    f"request {request.request_id} names adapter {aid} but "
+                    "the engine has no adapter_store")
+            if not self._adapters.registered(aid):
+                raise AdmissionError(
+                    f"request {request.request_id} names unregistered "
+                    f"adapter {aid}")
         try:
             self.scheduler.submit(request, now=self._clock())
         except BackpressureError:
@@ -575,6 +661,8 @@ class ServingEngine:
         self.registry.gauge("serving/slots_active").set(self.scheduler.active_count)
         if self._kv is not None:
             self._kv.export_gauges()
+        if self._adapters is not None:
+            self._adapters.export_gauges()
 
         # step watchdog: a slow engine step is the host-side signature of a
         # recompile, a device stall, or a wedged model call — the gauge/
@@ -650,12 +738,38 @@ class ServingEngine:
         row_valid = jnp.concatenate(
             [valid_ctx, jnp.zeros((1, self.T - self.C), jnp.int32)], axis=1)
         prefilled_fresh = False  # paged: freshly prefilled chain to register
+        aid = getattr(req, "adapter_id", 0)
+        if aid:
+            # pin-at-admission: the adapter's pages are taken (and device-
+            # loaded on a cold start) BEFORE any KV allocation, so the KV
+            # failure path below has exactly one extra thing to undo.  A
+            # transient adapter-pool exhaustion fails THIS request cleanly
+            # (the engine keeps serving); injected faults re-raise after
+            # the same cleanup, like the KV path.
+            try:
+                loads = self._adapters.acquire(aid, engine_step=self._steps)
+            except BaseException as e:
+                now = self._clock()
+                self._fail_slot_state(
+                    slot, req, now, reason=f"adapter:{type(e).__name__}")
+                logger.warning(
+                    "serving: request %d failed acquiring adapter %d (%s) — "
+                    "slot %d freed", req.request_id, aid, e, slot)
+                outputs.append(self._emit(req, now))
+                if isinstance(e, PoolExhausted):
+                    return
+                raise
+            for phys, block in loads:
+                self._adapter_pool = self.model.write_adapter_page(
+                    self._adapter_pool, block, phys)
         if self._kv is not None:
             try:
                 cached = self._kv.admit_slot(slot, req, ids[0], valid_np,
                                              engine_step=self._steps)
             except BaseException as e:
                 now = self._clock()
+                if aid:
+                    self._adapters.release(aid)  # undo the admission pin
                 self._fail_slot_state(slot, req, now,
                                       reason=f"page_alloc:{type(e).__name__}")
                 logger.warning(
@@ -664,20 +778,36 @@ class ServingEngine:
                     e, slot)
                 outputs.append(self._emit(req, now))
                 raise
+            # from here the slot owns the pin: every terminal path releases
+            # it through _release_adapter
+            if self._adapters is not None:
+                self._slot_adapter[slot] = aid
+                self._adapter_tables[slot] = self._adapters.table(aid)
+                self._adapter_dirty = True
             if cached is not None:
                 # exact full-prompt prefix hit: the chain's pages already
                 # hold this prompt's KV and the payload is the prefill's
-                # last-position logits — no prefill compute at all
+                # last-position logits — no prefill compute at all (keys
+                # are adapter-salted, so the cached KV/logits were computed
+                # under this same adapter)
                 logits = jnp.asarray(cached)
             else:
-                logits, row_caches = self.model.prefill_one(
-                    jnp.asarray(ids), valid_ctx)
+                if aid:
+                    logits, row_caches = self.model.prefill_one_lora(
+                        jnp.asarray(ids), valid_ctx, self._adapter_pool,
+                        self._adapter_tables[slot][None, :])
+                else:
+                    logits, row_caches = self.model.prefill_one(
+                        jnp.asarray(ids), valid_ctx)
                 logits = perturb("serving/prefill_logits", logits,
                                  request_id=req.request_id,
                                  engine_step=self._steps)
-                for lp, phys in self._kv.fresh_pages(slot):
+                fresh = self._kv.fresh_pages(slot)
+                for lp, phys in fresh:
                     self.caches = self.model.write_page(
                         self.caches, row_caches, lp, phys)
+                if self._kv_quant is not None and fresh:
+                    self.registry.counter(QUANT_PAGES_TOTAL).inc(len(fresh))
                 # prefix-index registration waits for the finite-logits
                 # gate below: a poisoned prefill must fail ITS request
                 # only, never become a cached payload every future
@@ -756,7 +886,12 @@ class ServingEngine:
         for slot, req in active:
             tok_idx[slot] = len(req.generated)
 
-        if self._kv is not None:
+        if self._adapters is not None:
+            logits, self.caches, self.valid = self.model.decode_pages_lora(
+                jnp.asarray(self._next_tok)[:, None], self._offsets,
+                self._kv.tables, self.caches, self.valid,
+                self._adapter_pool, self._adapter_tables)
+        elif self._kv is not None:
             logits, self.caches, self.valid = self.model.decode_pages(
                 jnp.asarray(self._next_tok)[:, None], self._offsets,
                 self._kv.tables, self.caches, self.valid)
@@ -764,6 +899,9 @@ class ServingEngine:
             logits, self.caches, self.valid = self.model.decode_slots(
                 jnp.asarray(self._next_tok)[:, None], self._offsets,
                 self.caches, self.valid)
+        if self._kv_quant is not None:
+            # every active slot's decode write requantized its page
+            self.registry.counter(QUANT_PAGES_TOTAL).inc(len(active))
         logits = perturb("serving/decode_logits", logits,
                          engine_step=self._steps)
         toks_f = _sample_rows(
@@ -854,20 +992,40 @@ class ServingEngine:
         # host→device crossing per step) and a clean one reuses its mirror
         staged = [self._next_tok[:, None].copy(), self._offsets.copy(),
                   tok_idx]
-        if self._kv is not None and (self._kv.tables_dirty
-                                     or self._tables_dev is None):
+        stage_kv = self._kv is not None and (self._kv.tables_dirty
+                                             or self._tables_dev is None)
+        stage_ad = self._adapters is not None and (
+            self._adapter_dirty or self._atables_dev is None)
+        if stage_kv:
             staged.append(self._kv.tables.copy())
-            put = self._audit.put(tuple(staged))
-            tok, offs, tidx, self._tables_dev = put
+        if stage_ad:
+            # a dirty adapter table rides the SAME packed put as the block
+            # tables — still one explicit host→device crossing per step
+            staged.append(self._adapter_tables.copy())
+        put = list(self._audit.put(tuple(staged)))
+        tok, offs, tidx = put[:3]
+        cursor = 3
+        if stage_kv:
+            self._tables_dev = put[cursor]
+            cursor += 1
             self._kv.tables_dirty = False
-        else:
-            tok, offs, tidx = self._audit.put(tuple(staged))
-        if self._kv is not None:
+        if stage_ad:
+            self._atables_dev = put[cursor]
+            cursor += 1
+            self._adapter_dirty = False
+        if self._adapters is not None:
+            logits, self.caches, self.valid = self.model.decode_pages_lora(
+                tok, offs, self._tables_dev, self.caches, self.valid,
+                self._adapter_pool, self._atables_dev)
+        elif self._kv is not None:
             logits, self.caches, self.valid = self.model.decode_pages(
                 tok, offs, self._tables_dev, self.caches, self.valid)
         else:
             logits, self.caches, self.valid = self.model.decode_slots(
                 tok, offs, self.caches, self.valid)
+        if self._kv_quant is not None:
+            # every active slot's decode write requantized its page
+            self.registry.counter(QUANT_PAGES_TOTAL).inc(len(active))
         logits = perturb("serving/decode_logits", logits,
                          engine_step=self._steps)
         if self._sampling_dirty:
@@ -1079,6 +1237,7 @@ class ServingEngine:
         self._last_tok_time[slot] = None
         if self._kv is not None:
             self._kv.release_slot(slot)
+        self._release_adapter(slot)
         self.registry.counter("serving/finished_total").inc()
 
     def _fail_slot_state(self, slot: int, req: Request, now: float,
@@ -1096,6 +1255,7 @@ class ServingEngine:
         self._last_tok_time[slot] = None
         if self._kv is not None:
             self._kv.release_slot(slot)
+        self._release_adapter(slot)
         self.registry.counter("serving/failed_total").inc()
 
     def _fail_slot(self, slot: int, req: Request, outputs: list,
@@ -1121,6 +1281,20 @@ class ServingEngine:
         if reason is not None:
             self._finish_request(slot, req, reason, now)
 
+    def _release_adapter(self, slot: int) -> None:
+        """Release the slot's adapter pin (release-on-terminal, the other
+        half of pin-at-admission) and null its table row.  Idempotent —
+        terminal paths and the sweep's park both call it."""
+        if self._adapters is None:
+            return
+        aid = self._slot_adapter[slot]
+        if not aid:
+            return
+        self._adapters.release(aid)
+        self._slot_adapter[slot] = 0
+        self._adapter_tables[slot] = 0
+        self._adapter_dirty = True
+
     def _park_free_slots(self) -> None:
         """Reset the device-side state of every slot without a live occupant
         (after a sweep freed cancelled/timed-out requests): offset ``T``
@@ -1132,6 +1306,7 @@ class ServingEngine:
                 self._last_tok_time[slot] = None
                 if self._kv is not None:  # idempotent page reclamation
                     self._kv.release_slot(slot)
+                self._release_adapter(slot)  # idempotent pin release
 
     def _emit(self, req: Request, now: float) -> RequestOutput:
         out = RequestOutput.from_request(req, now)
@@ -1153,6 +1328,8 @@ class ServingEngine:
                 "spec_proposed": out.spec_proposed,
                 "spec_accepted": out.spec_accepted,
                 "acceptance_rate": out.acceptance_rate,
+                # tenancy: which LoRA adapter served it (0 = base model)
+                "adapter_id": out.adapter_id,
             }
             self._stats_f.write(json.dumps(rec) + "\n")
             self._stats_f.flush()

@@ -128,6 +128,11 @@ class Replica:
         kv = getattr(eng, "_kv", None)
         if kv is not None:
             view["pages_free"] = kv.pages_free()
+        store = getattr(eng, "_adapters", None)
+        # the tenancy tiebreak evidence: which adapters this replica's pool
+        # holds device-resident right now (None off multi-adapter mode)
+        view["resident_adapters"] = (store.resident_ids()
+                                     if store is not None else None)
         reg = getattr(eng, "registry", None)
         if reg is not None:
             for m in reg.metrics():
@@ -156,6 +161,7 @@ class Replica:
         permanent AdmissionError."""
         eng = self.engine
         kv = getattr(eng, "_kv", None)
+        store = getattr(eng, "_adapters", None)
         return {
             "context_len": getattr(eng, "C", None),
             "max_total_len": getattr(eng, "T", None),
@@ -164,6 +170,16 @@ class Replica:
             "page_size": (kv.page_size
                           if kv is not None and kv.index is not None
                           else None),
+            # adapter-pool envelope (tenancy PR): a requeued clone carrying
+            # an adapter_id must land on a sibling whose store can actually
+            # serve it — same pool capacity, page width and rank, or the
+            # homogeneity check refuses the fleet up front
+            "kv_quant": getattr(eng, "_kv_quant", None),
+            "adapter_pages": store.capacity if store is not None else None,
+            "adapter_page_elems": (store.layout.page_elems
+                                   if store is not None else None),
+            "adapter_rank": (store.layout.rank
+                             if store is not None else None),
         }
 
     # -- lifecycle ---------------------------------------------------------

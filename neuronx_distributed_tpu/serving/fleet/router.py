@@ -105,7 +105,7 @@ class _Tracked:
 
     __slots__ = ("global_id", "client_id", "template", "fps", "replica_id",
                  "dispatches", "requeues", "affinity_pages", "submit_time",
-                 "done", "cancelled", "clone")
+                 "done", "cancelled", "clone", "adapter_id")
 
     def __init__(self, global_id: int, client_id: int, template: Request,
                  fps: List[int], submit_time: float):
@@ -113,6 +113,7 @@ class _Tracked:
         self.client_id = client_id
         self.template = template
         self.fps = fps
+        self.adapter_id = getattr(template, "adapter_id", 0)
         self.replica_id: Optional[int] = None
         self.dispatches = 0
         self.requeues = 0
@@ -460,7 +461,10 @@ class FleetRouter:
         ids = np.zeros((C,), np.int64)
         ids[C - L:] = request.prompt_ids[:L]
         valid = (np.arange(C) >= C - L).astype(np.int32)
-        keys = page_keys(ids, valid, self._page)
+        # the same adapter salt the engines' tries key with (tenancy PR):
+        # an adapter'd prompt only matches pages prefilled under ITS adapter
+        keys = page_keys(ids, valid, self._page,
+                         salt=getattr(request, "adapter_id", 0))
         pad = 0
         while pad < len(keys) and is_padding_key(keys[pad]):
             pad += 1
@@ -485,7 +489,8 @@ class FleetRouter:
         # policies never read them
         views = (self._views(candidates) if self.policy.needs_views else {})
         decision: Decision = self.policy.choose(
-            candidates, views, self.shadows, rec.fps)
+            candidates, views, self.shadows, rec.fps,
+            adapter_id=rec.adapter_id)
         order = [decision.replica_id] + [
             rid for rid in candidates if rid != decision.replica_id]
         for i, rid in enumerate(order):
@@ -550,7 +555,8 @@ class FleetRouter:
             request_id=rec.global_id, prompt_ids=list(t.prompt_ids),
             max_new_tokens=t.max_new_tokens, sampling=t.sampling,
             stop_token_ids=t.stop_token_ids, deadline_s=t.deadline_s,
-            stream_cb=t.stream_cb)
+            stream_cb=t.stream_cb,
+            adapter_id=getattr(t, "adapter_id", 0))
 
     def _failover(self, replica: Replica, exc: BaseException,
                   now: float) -> None:

@@ -87,7 +87,10 @@ class RoutingPolicy:
     """Base: ``choose`` picks among the LIVE candidates (router guarantees
     the list is non-empty).  ``views`` maps replica_id -> load view dict,
     ``shadows`` maps replica_id -> :class:`ReplicaShadow`, ``fps`` is the
-    request's leading-chain fingerprints (empty off paged/prefix mode)."""
+    request's leading-chain fingerprints (empty off paged/prefix mode),
+    ``adapter_id`` the request's LoRA adapter (0 = base model) — the
+    tenancy tiebreak evidence: views carry ``resident_adapters``, the set
+    of adapters whose pages that replica's store holds device-resident."""
 
     name = "base"
     # load views cost a metrics scan per replica per dispatch, and prompt
@@ -98,7 +101,7 @@ class RoutingPolicy:
 
     def choose(self, candidates: List[int], views: Dict[int, dict],
                shadows: Dict[int, ReplicaShadow],
-               fps: Sequence[int]) -> Decision:
+               fps: Sequence[int], adapter_id: int = 0) -> Decision:
         raise NotImplementedError
 
 
@@ -113,7 +116,8 @@ class RoundRobinPolicy(RoutingPolicy):
     def __init__(self):
         self._next = 0
 
-    def choose(self, candidates, views, shadows, fps) -> Decision:
+    def choose(self, candidates, views, shadows, fps,
+               adapter_id: int = 0) -> Decision:
         rid = candidates[self._next % len(candidates)]
         self._next += 1
         return Decision(rid)
@@ -130,7 +134,8 @@ class RandomPolicy(RoutingPolicy):
     def __init__(self, seed: int = 0):
         self._rs = np.random.RandomState(seed)
 
-    def choose(self, candidates, views, shadows, fps) -> Decision:
+    def choose(self, candidates, views, shadows, fps,
+               adapter_id: int = 0) -> Decision:
         return Decision(candidates[int(self._rs.randint(len(candidates)))])
 
 
@@ -140,31 +145,45 @@ class LeastLoadedPolicy(RoutingPolicy):
 
     name = "least_loaded"
 
-    def choose(self, candidates, views, shadows, fps) -> Decision:
+    def choose(self, candidates, views, shadows, fps,
+               adapter_id: int = 0) -> Decision:
         return Decision(min(candidates, key=lambda r: load_score(views[r])))
 
 
 class PrefixAffinityPolicy(RoutingPolicy):
     """Steer to the replica whose shadow holds the LONGEST leading chain of
     the prompt's page fingerprints; break ties (including the
-    nothing-matches case) by least load.  On engines without a prefix cache
-    ``fps`` is always empty and this degrades to pure least-loaded.
+    nothing-matches case) first by ADAPTER RESIDENCY — among the
+    prefix-tied candidates, one whose adapter store already pins the
+    request's adapter serves it without paying a cold adapter load — then
+    by least load.  On engines without a prefix cache ``fps`` is always
+    empty and this degrades to adapter-residency + least-loaded.
 
     The affinity win is multiplicative with the PR-5 prefix cache: a
     steered request's shared pages are refcounted once on ONE replica
     instead of being re-prefilled on every replica the rotation happens to
-    land it on."""
+    land it on — and (tenancy PR) its adapter stays hot on that replica
+    instead of churning every pool's LRU."""
 
     name = "prefix_affinity"
 
-    def choose(self, candidates, views, shadows, fps) -> Decision:
+    @staticmethod
+    def _adapter_tiebreak(pool, views, adapter_id):
+        if not adapter_id:
+            return pool
+        resident = [r for r in pool
+                    if adapter_id in (views.get(r, {})
+                                      .get("resident_adapters") or ())]
+        return resident or pool
+
+    def choose(self, candidates, views, shadows, fps,
+               adapter_id: int = 0) -> Decision:
         depths = {r: shadows[r].match_depth(fps)
                   for r in candidates} if fps else {}
         best = max(depths.values(), default=0)
-        if best == 0:
-            return Decision(min(candidates,
-                                key=lambda r: load_score(views[r])))
-        tied = [r for r in candidates if depths[r] == best]
+        tied = (candidates if best == 0
+                else [r for r in candidates if depths[r] == best])
+        tied = self._adapter_tiebreak(tied, views, adapter_id)
         return Decision(min(tied, key=lambda r: load_score(views[r])),
                         affinity_pages=best)
 

@@ -140,7 +140,13 @@ class PagedKVManager:
 
         Transactional: on ANY failure every page/reference taken so far is
         released before the exception propagates."""
-        keys = page_keys(ids_row, valid_row, self.page_size)[:self.ctx_pages]
+        # tenancy: prompt KV content depends on the adapter that prefills
+        # it (the v projection carries the adapter delta), so keys are
+        # salted with the request's adapter id — prefix sharing stays
+        # exact WITHIN an adapter and impossible across adapters, and
+        # adapter-0 keys keep the historical format bit-for-bit
+        keys = page_keys(ids_row, valid_row, self.page_size,
+                         salt=getattr(req, "adapter_id", 0))[:self.ctx_pages]
         matched: List[int] = []
         payload = None
         if self.index is not None:

@@ -90,6 +90,12 @@ class Request:
     stop_token_ids: Tuple[int, ...] = ()
     deadline_s: Optional[float] = None
     stream_cb: Optional[Callable[["Request", int], None]] = None
+    # multi-tenant serving (tenancy/ subsystem): the LoRA adapter this
+    # request decodes under.  0 = the base model (no adapter — the NULL
+    # page's zero factors are the identity); ids > 0 must be registered in
+    # the engine's AdapterStore, are pinned resident at admission and
+    # released on every terminal state
+    adapter_id: int = 0
 
     # lifecycle (engine-owned)
     state: RequestState = RequestState.QUEUED
@@ -115,6 +121,10 @@ class Request:
             raise ValueError(
                 f"request {self.request_id}: max_new_tokens must be >= 1, "
                 f"got {self.max_new_tokens}")
+        if self.adapter_id < 0:
+            raise ValueError(
+                f"request {self.request_id}: adapter_id must be >= 0, "
+                f"got {self.adapter_id}")
 
     @property
     def prompt_len(self) -> int:
@@ -159,6 +169,8 @@ class RequestOutput:
     # acceptance_rate is None when the engine never speculated for it
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # the LoRA adapter the request decoded under (0 = base model)
+    adapter_id: int = 0
 
     @property
     def acceptance_rate(self) -> Optional[float]:
@@ -187,4 +199,5 @@ class RequestOutput:
             intertoken_ms=tuple(req.intertoken_ms),
             spec_proposed=req.spec_proposed,
             spec_accepted=req.spec_accepted,
+            adapter_id=req.adapter_id,
         )

@@ -1,0 +1,19 @@
+"""Multi-tenant serving: paged LoRA adapters behind one compiled envelope.
+
+See :mod:`.store` for the subsystem story (S-LoRA-style adapter paging
+through the kvcache ``BlockAllocator``); the serving engine's
+``adapter_store=`` knob and ``Request.adapter_id`` are the consumer
+surface, ``models/llama.py``'s ``adapters=`` kwarg the compiled half.
+"""
+
+from neuronx_distributed_tpu.tenancy.store import (  # noqa: F401
+    ADAPTER_EVICTIONS_TOTAL,
+    ADAPTER_HITS_TOTAL,
+    ADAPTER_LOADS_TOTAL,
+    ADAPTER_POOL_PAGES_IN_USE,
+    ADAPTERS_RESIDENT,
+    AdapterLayout,
+    AdapterStore,
+    factors_from_params,
+    make_adapter_store,
+)

@@ -593,10 +593,12 @@ class ParallelInferenceModel(_ServingBase):
 
     # -- phase functions (pure; also used by the export path) --------------
 
-    def _context_fn(self, params, ids, valid):
+    def _context_fn(self, params, ids, valid, adapters=None):
         """Prefill; ``valid [B, C]`` marks real (non-left-pad) prompt tokens.
         Positions come from the mask (a token's position = count of valid
-        tokens before it), so ragged prompts get correct RoPE phases."""
+        tokens before it), so ragged prompts get correct RoPE phases.
+        ``adapters`` (the tenancy path) rides as an extra apply kwarg —
+        passed only when set, so modules without the kwarg keep working."""
         B, C = ids.shape
         T = self.config.max_total_len
         positions = jnp.clip(jnp.cumsum(valid, axis=1) - 1, 0)
@@ -607,8 +609,9 @@ class ParallelInferenceModel(_ServingBase):
             self.num_layers, B, T, self.num_kv_heads,
             self.head_dim, self.config.kv_cache_dtype,
         )
+        extra = {} if adapters is None else {"adapters": adapters}
         logits, caches = self.module.apply(
-            params, ids, positions, caches, 0, kv_valid=kv_valid
+            params, ids, positions, caches, 0, kv_valid=kv_valid, **extra
         )
         return logits[:, -1, :], caches
 
@@ -767,15 +770,25 @@ class ParallelInferenceModel(_ServingBase):
 
     # -- paged-KV phase fns (kvcache/ subsystem; serving paged mode) --------
 
-    def make_page_pool(self, num_pages: int, page_size: int):
+    def make_page_pool(self, num_pages: int, page_size: int,
+                       quant: Optional[str] = None):
         """A :class:`~..kvcache.pool.PagePool` shaped/sharded for this
         model's layers and cache dtype — the device half of the paged
-        serving engine's KV state."""
+        serving engine's KV state.  ``quant="int8"`` builds the quantized
+        layout (int8 pages + per-page fp32 scale/zero; see
+        :mod:`~..kvcache.quant`) — roughly 2x the pages per HBM byte."""
         from neuronx_distributed_tpu.kvcache.pool import PagePool
 
         return PagePool(self.num_layers, num_pages, page_size,
                         self.num_kv_heads, self.head_dim,
-                        self.config.kv_cache_dtype)
+                        self.config.kv_cache_dtype, quant=quant)
+
+    @staticmethod
+    def _pool_tag(caches) -> str:
+        """Compiled-cache key component distinguishing pool layouts: the
+        quantized six-tuple-per-layer pool and the fp pair compile to
+        different programs with different pinned out-shardings."""
+        return "int8" if len(caches[0]) == 6 else "fp"
 
     def _pool_out_shardings(self, caches):
         from jax.sharding import NamedSharding
@@ -786,37 +799,149 @@ class ParallelInferenceModel(_ServingBase):
             else None,
             caches)
 
-    def _decode_pages_fn(self, params, tok, offsets, block_table, caches, valid):
+    def _decode_pages_fn(self, params, tok, offsets, block_table, caches,
+                         valid, adapters=None):
         """The paged twin of :meth:`_decode_slots_fn`: same per-slot offsets,
         validity update, and mask-derived positions, but the KV state is the
         page pool + block tables (the model scatters the new token into its
         physical page and attends over the gathered per-row view).  An
-        offset of ``T`` parks an idle slot."""
+        offset of ``T`` parks an idle slot.  ``adapters`` (the tenancy path)
+        rides as an extra apply kwarg, so the offset/validity/position math
+        — the token-identity contract — exists exactly once."""
         T = valid.shape[1]
         hot = jnp.arange(T)[None, :] == offsets[:, None]  # [B, T]
         valid = jnp.where(hot, 1, valid)  # the new token becomes a key
         before = jnp.where(jnp.arange(T)[None, :] < offsets[:, None], valid, 0)
         positions = jnp.sum(before, axis=1, keepdims=True).astype(jnp.int32)
+        extra = {} if adapters is None else {"adapters": adapters}
         logits, caches = self.module.apply(
             params, tok, positions, caches, offsets, kv_valid=valid,
-            block_table=block_table,
+            block_table=block_table, **extra,
         )
         return logits[:, -1, :], caches, valid
 
     def decode_pages(self, tok, offsets, block_table, caches, valid):
         """Compiled paged per-slot decode step (page pool donated).
         ``block_table`` is the ``[B, max_total_len // page_size]`` int32
-        logical→physical page map; ``caches`` the pool pytree."""
+        logical→physical page map; ``caches`` the pool pytree (fp pairs or
+        the int8 six-tuples — each layout compiles its own program)."""
         self._serving_lru()
-        fn = self._serving_cache.get("decode_pages")
+        key = ("decode_pages", self._pool_tag(caches))
+        fn = self._serving_cache.get(key)
         if fn is None:
             fn = jax.jit(
                 self._decode_pages_fn, donate_argnums=(4,),
                 out_shardings=(None, self._pool_out_shardings(caches),
                                self._io_shardings["batch"](None)))
-            self._serving_cache.put("decode_pages", fn)
+            self._serving_cache.put(key, fn)
         return fn(self.params, tok, jnp.asarray(offsets, jnp.int32),
                   jnp.asarray(block_table, jnp.int32), caches, valid)
+
+    # -- multi-adapter (tenancy/) phase fns --------------------------------
+
+    def make_adapter_pool(self, layout, num_pages: int):
+        """Preallocated device adapter pool ``[num_pages, page_elems]``
+        fp32, replicated over the mesh (adapters are tiny next to the KV
+        pool; replication keeps the per-slot gather collective-free).
+        ``layout`` is the :class:`~..tenancy.AdapterLayout` whose static
+        factor offsets the gathered decode slices by; page 0 is the NULL
+        page — its zeros ARE adapter 0's identity factors."""
+        self._adapter_layout = layout
+        pool = jnp.zeros((num_pages, layout.page_elems), jnp.float32)
+        if model_parallel_is_initialized():
+            pool = jax.device_put(pool, named_sharding(None, None))
+        return pool
+
+    def _write_adapter_page_fn(self, pool, block, phys):
+        return jax.lax.dynamic_update_slice(
+            pool, block[None, :].astype(pool.dtype), (phys, 0))
+
+    def write_adapter_page(self, pool, block, phys_page):
+        """Compiled adapter-page write (pool donated): one flattened
+        ``[page_elems]`` host block lands in pool page ``phys_page`` (a
+        traced scalar — one compiled program serves every load of every
+        adapter)."""
+        self._serving_lru()
+        fn = self._serving_cache.get("write_adapter_page")
+        if fn is None:
+            fn = jax.jit(self._write_adapter_page_fn, donate_argnums=(0,))
+            self._serving_cache.put("write_adapter_page", fn)
+        return fn(pool, jnp.asarray(block, jnp.float32),
+                  jnp.int32(phys_page))
+
+    def _gather_adapters(self, apool, atables):
+        """Per-slot, per-layer gathered LoRA factors from the paged adapter
+        pool: ONE gather ``apool[atables]`` pulls every slot's pages, then
+        static slices carve the flat view into the layout's factors —
+        ``[(a_q [B, H, r], b_q [B, r, NQ*D], a_v, b_v), ...]`` per layer.
+        Slots on adapter 0 hold all-NULL tables, gather zeros, and add an
+        exact zero delta."""
+        layout = self._adapter_layout
+        B = atables.shape[0]
+        flat = apool[atables].reshape(B, -1)  # [B, AP * page_elems]
+        out = []
+        for layer_entries in layout.layer_entries():
+            factors = []
+            for _, off, shape in layer_entries:
+                size = 1
+                for d in shape:
+                    size *= d
+                factors.append(flat[:, off:off + size].reshape(B, *shape))
+            out.append(tuple(factors))
+        return out
+
+    def _decode_pages_lora_fn(self, params, tok, offsets, block_table,
+                              caches, valid, apool, atables):
+        """The multi-adapter twin of :meth:`_decode_pages_fn` — the SAME
+        phase fn (one copy of the offsets/validity/position math), plus
+        per-slot LoRA deltas gathered from the adapter pool as one
+        ``[B, r, d]`` einsum pair per targeted projection (S-LoRA's batched
+        heterogeneous-adapter decode)."""
+        return self._decode_pages_fn(
+            params, tok, offsets, block_table, caches, valid,
+            adapters=self._gather_adapters(apool, atables))
+
+    def decode_pages_lora(self, tok, offsets, block_table, caches, valid,
+                          apool, atables):
+        """Compiled multi-adapter paged decode step (page pool donated).
+        ``apool`` is the device adapter pool, ``atables`` the per-slot
+        ``[B, adapter_pages]`` int32 page map (all-NULL rows = adapter 0 =
+        exact no-op)."""
+        self._serving_lru()
+        key = ("decode_pages_lora", self._pool_tag(caches))
+        fn = self._serving_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                self._decode_pages_lora_fn, donate_argnums=(4,),
+                out_shardings=(None, self._pool_out_shardings(caches),
+                               self._io_shardings["batch"](None)))
+            self._serving_cache.put(key, fn)
+        return fn(self.params, tok, jnp.asarray(offsets, jnp.int32),
+                  jnp.asarray(block_table, jnp.int32), caches, valid,
+                  apool, jnp.asarray(atables, jnp.int32))
+
+    def _context_lora_fn(self, params, ids, valid, apool, atable):
+        """Single-request prefill with the request's LoRA adapter applied
+        (``atable`` is the one-row ``[1, adapter_pages]`` page map) — the
+        SAME :meth:`_context_fn` (one copy of the mask/position math); the
+        adapter's deltas shape the prompt KV exactly as a merged dense
+        model would, so per-adapter prefix pages are internally
+        consistent."""
+        return self._context_fn(
+            params, ids, valid,
+            adapters=self._gather_adapters(apool, atable))
+
+    def prefill_one_lora(self, ids, valid, apool, atable):
+        """Compiled adapter-aware single-request prefill — the tenancy
+        counterpart of :meth:`prefill_one` (returns the same
+        ``(logits [1, V], B=1 row caches)``)."""
+        self._serving_lru()
+        fn = self._serving_cache.get("prefill_one_lora")
+        if fn is None:
+            fn = jax.jit(self._context_lora_fn)
+            self._serving_cache.put("prefill_one_lora", fn)
+        return fn(self.params, ids.astype(jnp.int32), valid, apool,
+                  jnp.asarray(atable, jnp.int32))
 
     def _verify_pages_fn(self, params, toks, offsets, block_table, caches, valid):
         """Score a ``[B, S]`` chunk at PER-SLOT offsets against the page
@@ -871,24 +996,57 @@ class ParallelInferenceModel(_ServingBase):
 
         return jax.tree.map(wr, caches, row_caches)
 
+    def _write_page_quant_fn(self, caches, row_caches, lp, phys):
+        """Quantize-on-write prefill page write: the fp row-cache chunk is
+        quantized per page (scale/zero computed from the page content) and
+        the int8 payload + page params land at ``phys``."""
+        from neuronx_distributed_tpu.kvcache.quant import quantize_page
+
+        out = []
+        for (ck, cv, ks, kz, vs, vz), (rk, rv) in zip(caches, row_caches):
+            page = ck.shape[1]
+
+            def one(cq, sc, zp, r):
+                chunk = jax.lax.dynamic_slice_in_dim(
+                    r, lp * page, page, axis=1)[0]  # [page, NKV, D]
+                q2, s2, z2 = quantize_page(chunk)
+                cq = jax.lax.dynamic_update_slice(
+                    cq, q2[None], (phys, 0, 0, 0))
+                sc = jax.lax.dynamic_update_slice(sc, s2[None], (phys,))
+                zp = jax.lax.dynamic_update_slice(zp, z2[None], (phys,))
+                return cq, sc, zp
+
+            ck, ks, kz = one(ck, ks, kz, rk)
+            cv, vs, vz = one(cv, vs, vz, rv)
+            out.append((ck, cv, ks, kz, vs, vz))
+        return out
+
     def write_page(self, caches, row_caches, logical_page, phys_page):
         """Compiled page-aligned prefill write (pool donated): page
         ``logical_page`` of the ``prefill_one`` row caches lands in pool
         page ``phys_page``.  Cached-prefix pages are simply never written —
-        the caller skips them entirely."""
+        the caller skips them entirely.  A quantized pool quantizes on
+        write (per-page scale/zero from the page content)."""
         self._serving_lru()
-        fn = self._serving_cache.get("write_page")
+        key = ("write_page", self._pool_tag(caches))
+        fn = self._serving_cache.get(key)
         if fn is None:
-            fn = jax.jit(self._write_page_fn, donate_argnums=(0,),
+            impl = (self._write_page_quant_fn
+                    if self._pool_tag(caches) == "int8"
+                    else self._write_page_fn)
+            fn = jax.jit(impl, donate_argnums=(0,),
                          out_shardings=self._pool_out_shardings(caches))
-            self._serving_cache.put("write_page", fn)
+            self._serving_cache.put(key, fn)
         return fn(caches, row_caches, jnp.int32(logical_page),
                   jnp.int32(phys_page))
 
     def _copy_page_fn(self, caches, src, dst):
         def cp(c):
+            # 4-D page payloads and 1-D per-page quant params alike: copy
+            # row `src` of the leading page axis to row `dst`
             row = jax.lax.dynamic_slice_in_dim(c, src, 1, axis=0)
-            return jax.lax.dynamic_update_slice(c, row, (dst, 0, 0, 0))
+            return jax.lax.dynamic_update_slice(
+                c, row, (dst,) + (0,) * (c.ndim - 1))
 
         return jax.tree.map(cp, caches)
 
@@ -897,11 +1055,12 @@ class ParallelInferenceModel(_ServingBase):
         of the allocator's copy-on-write: duplicate a shared page before
         writing the copy."""
         self._serving_lru()
-        fn = self._serving_cache.get("copy_page")
+        key = ("copy_page", self._pool_tag(caches))
+        fn = self._serving_cache.get(key)
         if fn is None:
             fn = jax.jit(self._copy_page_fn, donate_argnums=(0,),
                          out_shardings=self._pool_out_shardings(caches))
-            self._serving_cache.put("copy_page", fn)
+            self._serving_cache.put(key, fn)
         return fn(caches, jnp.int32(src_page), jnp.int32(dst_page))
 
     def _insert_valid_fn(self, valid, row_valid, slot):

@@ -118,13 +118,15 @@ def test_serving_stats_schema(tmp_path):
         {"schema": SERVING_STATS_SCHEMA, "time": 1.0, "request_id": 0,
          "state": "finished", "finish_reason": "length", "prompt_len": 5,
          "new_tokens": 8, "queue_ms": 0.5, "ttft_ms": 12.0, "total_ms": 40.0,
-         "spec_proposed": 12, "spec_accepted": 9, "acceptance_rate": 0.75},
-        # a non-speculative record: zeros + null rate
+         "spec_proposed": 12, "spec_accepted": 9, "acceptance_rate": 0.75,
+         "adapter_id": 0},
+        # a non-speculative, multi-tenant record: zeros + null rate, served
+        # under LoRA adapter 3
         {"schema": SERVING_STATS_SCHEMA, "time": 2.0, "request_id": 1,
          "state": "timed_out", "finish_reason": "timed_out", "prompt_len": 3,
          "new_tokens": 0, "queue_ms": 100.0, "ttft_ms": None,
          "total_ms": 100.0, "spec_proposed": 0, "spec_accepted": 0,
-         "acceptance_rate": None},
+         "acceptance_rate": None, "adapter_id": 3},
     ]
     path = tmp_path / "serving_stats.jsonl"
     with open(path, "w") as f:

@@ -267,6 +267,252 @@ def run_paged(args, module, params, cfg, icfg) -> int:
     return 0
 
 
+def run_lora(args, module, params, cfg, icfg) -> int:
+    """Batched multi-adapter serving (tenancy/): >= --lora-adapters LoRA
+    adapters co-batched through one compiled envelope vs the no-adapter
+    paged baseline; prints one JSON line per rung.  rc 1 when fewer than
+    min(adapters, slots) distinct adapters ever decode in the same batch,
+    when any request fails, or when the multi-adapter inter-token p99
+    blows past the (generous, CI-noise-tolerant) near-baseline bound."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.serving import Request, ServingEngine
+    from neuronx_distributed_tpu.tenancy import make_adapter_store
+    from neuronx_distributed_tpu.trace import ParallelInferenceModel
+
+    B, C, T = args.batch_size, args.context_len, args.max_total_len
+    page = args.page_size
+    if C % page or T % page:
+        raise SystemExit(f"--page-size {page} must divide --context-len {C} "
+                         f"and --max-total-len {T}")
+    A = args.lora_adapters
+    model = ParallelInferenceModel(module, params, icfg)
+    num_pages = B * (T // page) + 1
+
+    rs = np.random.RandomState(args.seed)
+    n = max(args.num_requests, 2 * A)
+    prompts = [
+        rs.randint(1, cfg.vocab_size,
+                   size=rs.randint(max(2, C // 4), C + 1)).tolist()
+        for _ in range(n)
+    ]
+    arrivals = np.zeros(n)  # burst: the batch must actually fill
+
+    rank = 4
+    adapter_layers = []
+    H, NQ, NKV, D = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                     cfg.head_dim_)
+
+    def random_adapter(seed):
+        r2 = np.random.RandomState(seed)
+        return [{
+            "a_q": (r2.randn(H, rank) * 0.05).astype(np.float32),
+            "b_q": (r2.randn(rank, NQ * D) * 0.05).astype(np.float32),
+            "a_v": (r2.randn(H, rank) * 0.05).astype(np.float32),
+            "b_v": (r2.randn(rank, NKV * D) * 0.05).astype(np.float32),
+        } for _ in range(cfg.num_layers)]
+
+    def make_store():
+        store = make_adapter_store(
+            model, rank=rank,
+            num_pages=A * _store_pages(model, rank) + 1,
+            page_elems=2048)
+        for aid in range(1, A + 1):
+            store.register(aid, random_adapter(args.seed + aid), alpha=8.0)
+        return store
+
+    def _store_pages(model, rank):
+        from neuronx_distributed_tpu.tenancy import AdapterLayout
+
+        return AdapterLayout.for_model(model, rank, 2048).pages_per_adapter
+
+    def requests(with_adapters):
+        return [Request(request_id=i, prompt_ids=prompts[i],
+                        max_new_tokens=args.max_new_tokens,
+                        adapter_id=(i % A) + 1 if with_adapters else 0)
+                for i in range(n)]
+
+    def measure(with_adapters):
+        kw = dict(page_size=page, num_pages=num_pages)
+        if with_adapters:
+            kw["adapter_store"] = make_store()
+        warm = ServingEngine(model, registry=MetricRegistry(), **kw)
+        warm.submit(Request(request_id=-1, prompt_ids=prompts[0],
+                            max_new_tokens=min(2, args.max_new_tokens),
+                            adapter_id=1 if with_adapters else 0))
+        warm.run_until_complete(max_steps=1000)
+        warm.close()
+        del warm
+        if with_adapters:
+            kw["adapter_store"] = make_store()  # fresh pins for the run
+        engine = ServingEngine(model, registry=MetricRegistry(), **kw)
+        peak_adapters = [0]
+        orig_step = engine.step
+
+        def step():
+            out = orig_step()
+            if with_adapters:
+                live = {engine._slot_adapter[s]
+                        for s, _ in engine.scheduler.active()
+                        if engine._slot_adapter[s]}
+                peak_adapters[0] = max(peak_adapters[0], len(live))
+            return out
+
+        engine.step = step
+        outputs, wall, peak = _drive_workload(engine, arrivals,
+                                              requests(with_adapters))
+        engine.close()
+        snap = engine.registry.snapshot()
+        total_tokens = sum(len(o.token_ids) for o in outputs.values())
+        inter = [ms for o in outputs.values() for ms in o.intertoken_ms]
+        rec = {
+            "metric": "serving_lora",
+            "mode": "lora" if with_adapters else "baseline",
+            "adapters": A if with_adapters else 0,
+            "slots": B,
+            "num_requests": n,
+            "finished": sum(1 for o in outputs.values()
+                            if o.state == "finished"),
+            "max_concurrent": peak,
+            "max_adapters_cobatched": peak_adapters[0],
+            "intertoken_ms": _percentiles(inter),
+            "goodput_tok_s": total_tokens / max(wall, 1e-9),
+            "wall_s": round(wall, 4),
+        }
+        if with_adapters:
+            rec["adapter_loads"] = snap.get("tenancy/adapter_loads_total", 0.0)
+            rec["adapter_hits"] = snap.get("tenancy/adapter_hits_total", 0.0)
+            rec["adapter_evictions"] = snap.get(
+                "tenancy/adapter_evictions_total", 0.0)
+        return rec
+
+    base = {"config": {"batch": B, "context": C, "max_total": T,
+                       "max_new": args.max_new_tokens, "page_size": page,
+                       "rank": rank}}
+    rec_b = measure(False)
+    print(json.dumps({**rec_b, **base}))
+    rec_l = measure(True)
+    print(json.dumps({**rec_l, **base}))
+    rc = 0
+    want_cobatch = min(A, B)
+    if rec_l["max_adapters_cobatched"] < want_cobatch:
+        print(f"serve_bench: only {rec_l['max_adapters_cobatched']} distinct "
+              f"adapters ever co-batched (< {want_cobatch})", file=sys.stderr)
+        rc = 1
+    if rec_l["finished"] != n:
+        print(f"serve_bench: {n - rec_l['finished']} multi-adapter requests "
+              "did not finish", file=sys.stderr)
+        rc = 1
+    p99_b = rec_b["intertoken_ms"].get("p99") or 0.0
+    p99_l = rec_l["intertoken_ms"].get("p99") or 0.0
+    # near-baseline bound: the low-rank gather+einsum must not dominate a
+    # decode step.  3x absorbs CI timing noise at tiny-model scale; on
+    # silicon the observed ratio is what to read, not the gate.
+    if p99_b > 0 and p99_l > 3.0 * p99_b:
+        print(f"serve_bench: multi-adapter inter-token p99 {p99_l:.2f}ms "
+              f"> 3x baseline {p99_b:.2f}ms", file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def run_kv_quant(args, module, params, cfg, icfg) -> int:
+    """Int8 vs fp KV pages at a FIXED HBM budget: the fp pool's bytes buy
+    ~2x the int8 pages, so the int8 engine must sustain >= 2x the max
+    concurrency on a page-bound burst workload; prints one JSON line per
+    mode, rc 1 otherwise."""
+    import dataclasses
+
+    import numpy as np
+
+    from neuronx_distributed_tpu.kvcache.pool import PagePool
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.serving import Request, ServingEngine
+    from neuronx_distributed_tpu.trace import ParallelInferenceModel
+
+    B, C, T = args.batch_size, args.context_len, args.max_total_len
+    page = args.page_size
+    if C % page or T % page:
+        raise SystemExit(f"--page-size {page} must divide --context-len {C} "
+                         f"and --max-total-len {T}")
+    # the fixed budget: a fp pool exactly covering the contiguous [B, T]
+    # reservation; the int8 pool gets the SAME bytes (pure arithmetic —
+    # constructing a PagePool here would eagerly allocate throwaway HBM)
+    from neuronx_distributed_tpu.kvcache.quant import page_layer_bytes
+
+    fp_pages = B * (T // page)
+    mcfg = module.config
+    budget_bytes = fp_pages * mcfg.num_layers * page_layer_bytes(
+        page, mcfg.num_kv_heads, mcfg.head_dim_, None, icfg.kv_cache_dtype)
+    int8_pages = PagePool.pages_for_budget(
+        budget_bytes, mcfg.num_layers, page, mcfg.num_kv_heads,
+        mcfg.head_dim_, icfg.kv_cache_dtype, quant="int8")
+    slots = args.paged_slots or 4 * B
+    model = ParallelInferenceModel(
+        module, params, dataclasses.replace(icfg, batch_size=slots))
+
+    # page-bound workload: unique full-width prompts (no padding pages, no
+    # shared prefix) arriving in one burst — concurrency is then exactly
+    # what the pool can hold in flight
+    rs = np.random.RandomState(args.seed)
+    n = args.num_requests
+    prompts = [rs.randint(1, cfg.vocab_size, size=C).tolist()
+               for _ in range(n)]
+    arrivals = np.zeros(n)
+
+    def requests():
+        return [Request(request_id=i, prompt_ids=prompts[i],
+                        max_new_tokens=args.max_new_tokens)
+                for i in range(n)]
+
+    def measure(quant, num_pages):
+        kw = dict(page_size=page, num_pages=num_pages + 1,  # + NULL page
+                  kv_quant=quant)
+        warm = ServingEngine(model, registry=MetricRegistry(), **kw)
+        warm.submit(Request(request_id=-1, prompt_ids=prompts[0],
+                            max_new_tokens=min(2, args.max_new_tokens)))
+        warm.run_until_complete(max_steps=1000)
+        warm.close()
+        del warm
+        engine = ServingEngine(model, registry=MetricRegistry(), **kw)
+        outputs, wall, peak = _drive_workload(engine, arrivals, requests())
+        engine.close()
+        snap = engine.registry.snapshot()
+        total_tokens = sum(len(o.token_ids) for o in outputs.values())
+        ttfts = [o.ttft_ms for o in outputs.values() if o.ttft_ms is not None]
+        inter = [ms for o in outputs.values() for ms in o.intertoken_ms]
+        return {
+            "metric": "serving_kv_quant",
+            "mode": quant or "fp",
+            "hbm_budget_bytes": budget_bytes,
+            "pool_pages": num_pages,
+            "page_size": page,
+            "slots": slots,
+            "num_requests": n,
+            "max_concurrent": peak,
+            "finished": sum(1 for o in outputs.values()
+                            if o.state == "finished"),
+            "ttft_ms": _percentiles(ttfts),
+            "intertoken_ms": _percentiles(inter),
+            "goodput_tok_s": total_tokens / max(wall, 1e-9),
+            "quant_page_writes": snap.get("kvcache/quant_pages_total", 0.0),
+            "wall_s": round(wall, 4),
+        }
+
+    base = {"config": {"batch": B, "context": C, "max_total": T,
+                       "max_new": args.max_new_tokens}}
+    rec_fp = measure(None, fp_pages)
+    print(json.dumps({**rec_fp, **base}))
+    rec_q = measure("int8", int8_pages)
+    print(json.dumps({**rec_q, **base}))
+    if rec_q["max_concurrent"] < 2 * rec_fp["max_concurrent"]:
+        print(f"serve_bench: int8 pages sustained {rec_q['max_concurrent']} "
+              f"concurrent < 2x fp {rec_fp['max_concurrent']} at the same "
+              "HBM budget", file=sys.stderr)
+        return 1
+    return 0
+
+
 def run_spec(args, module, params, cfg, icfg) -> int:
     """Speculative draft-k-verify vs the plain paged engine over one Poisson
     workload, draft == target; prints one JSON line per rung."""
@@ -402,6 +648,19 @@ def main() -> int:
                         "tokens/step <= 1 at k >= 2 or outputs diverge)")
     p.add_argument("--spec-ks", default="2,4,8",
                    help="comma-separated draft depths for the --spec sweep")
+    p.add_argument("--lora", action="store_true",
+                   help="multi-adapter mode (tenancy/): >= --lora-adapters "
+                        "LoRA adapters co-batched through one paged engine "
+                        "vs the no-adapter baseline (one JSON line each; "
+                        "rc 1 if co-batching or the near-baseline "
+                        "inter-token bound fails)")
+    p.add_argument("--lora-adapters", type=int, default=8,
+                   help="distinct adapters the --lora rung registers and "
+                        "round-robins requests across")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="int8-KV mode: int8 vs fp pages at a fixed HBM "
+                        "budget (one JSON line each; rc 1 unless int8 "
+                        "sustains >= 2x max concurrency)")
     p.add_argument("--num-requests", type=int, default=16)
     p.add_argument("--arrival-rate", type=float, default=20.0,
                    help="Poisson arrival rate, requests/s")
@@ -455,6 +714,16 @@ def main() -> int:
         args.batch_size = 2
         print("serve_bench: --spec with --batch-size 1 is a serial run; "
               "using batch size 2", file=sys.stderr)
+    if args.lora and args.batch_size < args.lora_adapters:
+        # co-batching A distinct adapters needs at least A slots
+        args.batch_size = args.lora_adapters
+        print(f"serve_bench: --lora needs >= {args.lora_adapters} slots to "
+              f"co-batch {args.lora_adapters} adapters; using batch size "
+              f"{args.batch_size}", file=sys.stderr)
+    if args.kv_quant and args.batch_size == 1:
+        args.batch_size = 2
+        print("serve_bench: --kv-quant with --batch-size 1 is a degenerate "
+              "concurrency comparison; using batch size 2", file=sys.stderr)
 
     if args.tiny:
         cfg = LlamaConfig.tiny(max_seq_len=args.max_total_len,
@@ -492,6 +761,10 @@ def main() -> int:
         return run_paged(args, module, params, cfg, icfg)
     if args.spec:
         return run_spec(args, module, params, cfg, icfg)
+    if args.lora:
+        return run_lora(args, module, params, cfg, icfg)
+    if args.kv_quant:
+        return run_kv_quant(args, module, params, cfg, icfg)
     model = ParallelInferenceModel(module, params, icfg)
     n_params = sum(int(x.size) for x in jax.tree.leaves(params))
     base = {

@@ -133,6 +133,16 @@ def run_extra_jobs(results_path: str) -> None:
         # under an injected replica kill
         ("serving_fleet", [sys.executable,
                            os.path.join(REPO, "tools", "fleet_bench.py")]),
+        # multi-tenant serving (tenancy/ subsystem): >= 8 LoRA adapters
+        # co-batched at near-baseline inter-token p99 (rc-gated)
+        ("serving_lora", [sys.executable,
+                          os.path.join(REPO, "tools", "serve_bench.py"),
+                          "--lora"]),
+        # int8 KV pages vs fp at a fixed HBM budget: rc 1 unless int8
+        # sustains >= 2x the max concurrency
+        ("serving_kv_quant", [sys.executable,
+                              os.path.join(REPO, "tools", "serve_bench.py"),
+                              "--kv-quant"]),
         # standalone kernel programs compile fast: block-size evidence fits
         # any window even when the full train step's compile does not
         ("flash_autotune", [sys.executable,
