@@ -281,6 +281,67 @@ def _summarize_fleet(scalars: Dict[str, dict]) -> Optional[dict]:
     }
 
 
+def _hist_p99(hist: Optional[dict]) -> Optional[float]:
+    """Approximate p99 from a cumulative-bucket histogram summary: the
+    upper edge of the first bucket whose cumulative count covers 99% —
+    coarse (bucket-resolution) but monotone, which is all the SLO line
+    needs."""
+    if not hist or not hist.get("count"):
+        return None
+    import math
+
+    target = 0.99 * hist["count"]
+    for le, cum in hist["buckets"].items():
+        if cum >= target:
+            try:
+                v = float(le)
+            except ValueError:
+                return None
+            # the overflow bucket's edge renders as "inf" — float() parses
+            # it happily, but "p99 ~infms" is not a number worth printing
+            return None if math.isinf(v) else v
+    return None
+
+
+def _summarize_slo(scalars: Dict[str, dict],
+                   histograms: Dict[str, dict]) -> Optional[dict]:
+    """SLO-serving health from the priority scheduler's counters and the
+    per-class latency histograms: preemptions (batch victims parked for
+    interactive heads), load shed at admission (infeasible deadlines),
+    expiries caught immediately before prefill dispatch, chunked-prefill
+    dispatches, and the per-class TTFT / inter-token p99s the whole
+    subsystem exists to keep flat.  None when the run used none of the SLO
+    machinery."""
+    names = ("serving/preemptions_total", "serving/shed_total",
+             "serving/expired_before_prefill_total",
+             "serving/prefill_chunks_total")
+
+    def last(tag):
+        s = scalars.get(tag)
+        return s["last"] if s else 0.0
+
+    per_class = {}
+    for cls in ("interactive", "batch"):
+        ttft = histograms.get(f"serving/ttft_ms_{cls}")
+        inter = histograms.get(f"serving/intertoken_ms_{cls}")
+        if (ttft and ttft.get("count")) or (inter and inter.get("count")):
+            per_class[cls] = {
+                "requests": ttft["count"] if ttft else 0,
+                "ttft_p99_ms": _hist_p99(ttft),
+                "intertoken_p99_ms": _hist_p99(inter),
+            }
+    if not per_class and not any(last(n) for n in names):
+        return None
+    return {
+        "preemptions": last("serving/preemptions_total"),
+        "shed": last("serving/shed_total"),
+        "expired_before_prefill": last(
+            "serving/expired_before_prefill_total"),
+        "prefill_chunks": last("serving/prefill_chunks_total"),
+        "classes": per_class,
+    }
+
+
 def _summarize_timeline(paths: Sequence[str]) -> dict:
     events = instants = 0
     dur_by_name: Dict[str, float] = {}
@@ -373,6 +434,7 @@ def build_report(
     speculative = _summarize_speculative(scalars)
     fleet = _summarize_fleet(scalars)
     tenancy = _summarize_tenancy(scalars)
+    slo = _summarize_slo(scalars, histograms)
     report = {
         "schema": OBS_REPORT_SCHEMA,
         "generated_at": time.time(),
@@ -398,6 +460,7 @@ def build_report(
             "speculative": speculative,
             "fleet": fleet,
             "tenancy": tenancy,
+            "slo": slo,
             "total_collective_count": sum(
                 a.get("total_collective_count", 0) for a in audits),
             "total_collective_bytes": sum(
@@ -462,6 +525,22 @@ def render_markdown(report: dict) -> str:
             f"- tenancy: {ten['adapters_resident']:.0f} adapter(s) resident "
             f"({ten['adapter_pool_pages_in_use']:.0f} pool pages); {hit}; "
             f"{ten['adapter_evictions']:.0f} evictions{quant}")
+    slo = h.get("slo")
+    if slo:
+        parts = []
+        for cls, c in sorted(slo.get("classes", {}).items()):
+            tt = (f"ttft p99 ~{c['ttft_p99_ms']:.0f}ms"
+                  if c["ttft_p99_ms"] is not None else "ttft p99 n/a")
+            it = (f"inter-token p99 ~{c['intertoken_p99_ms']:.0f}ms"
+                  if c["intertoken_p99_ms"] is not None
+                  else "inter-token p99 n/a")
+            parts.append(f"{cls}: {tt}, {it}")
+        tail = ("; ".join(parts)) if parts else "no per-class latencies"
+        lines.append(
+            f"- slo: {slo['preemptions']:.0f} preemption(s), "
+            f"{slo['shed']:.0f} shed at admission, "
+            f"{slo['expired_before_prefill']:.0f} expired pre-prefill, "
+            f"{slo['prefill_chunks']:.0f} prefill chunk(s); {tail}")
     spec = h.get("speculative")
     if spec:
         rate = (f"{spec['acceptance_rate']:.1%} acceptance"

@@ -46,7 +46,11 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     # for the request and its acceptance rate (null when the engine never
     # speculated for it — including every non-spec engine).  v3 adds the
     # tenancy accounting: which LoRA adapter served the request (0 = the
-    # base model — every request off multi-adapter mode)
+    # base model — every request off multi-adapter mode).  v4 adds the SLO
+    # scheduling accounting: the priority class, the deadline budget (null
+    # = none), the queue wait, how many times a higher tier preempted the
+    # request's slot, and — for requests the engine shed before prefill —
+    # the shed reason (null otherwise)
     "serving_stats": {
         "schema": str, "time": _NUM, "request_id": int, "state": str,
         "finish_reason": (str, type(None)), "prompt_len": int,
@@ -55,6 +59,11 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "spec_proposed": int, "spec_accepted": int,
         "acceptance_rate": (int, float, type(None)),
         "adapter_id": int,
+        "priority": str,
+        "deadline_s": (int, float, type(None)),
+        "queue_wait_ms": _NUM,
+        "preemptions": int,
+        "shed_reason": (str, type(None)),
     },
     # one line of router_stats.jsonl (serving.fleet.router.FleetRouter) —
     # one record per TERMINAL request across the whole fleet: which replica
@@ -130,6 +139,21 @@ REGISTRY_METRICS: Dict[str, str] = {
     "tenancy/adapter_hits_total": "counter",
     "tenancy/adapter_loads_total": "counter",
     "tenancy/adapter_evictions_total": "counter",
+    # SLO serving (stall-free serving PR): preemptions counts batch-tier
+    # victims parked for the interactive queue head, shed counts
+    # deadline-infeasible requests rejected at submit (SLOInfeasible),
+    # expired_before_prefill counts granted requests whose deadline died
+    # between the sweep and their prefill/chunk dispatch, prefill_chunks
+    # counts chunked-prefill dispatches; the per-class TTFT/inter-token
+    # histograms carry the per-tier latency story
+    "serving/preemptions_total": "counter",
+    "serving/shed_total": "counter",
+    "serving/expired_before_prefill_total": "counter",
+    "serving/prefill_chunks_total": "counter",
+    "serving/ttft_ms_interactive": "histogram",
+    "serving/ttft_ms_batch": "histogram",
+    "serving/intertoken_ms_interactive": "histogram",
+    "serving/intertoken_ms_batch": "histogram",
     # serving speculative decoding (serving.engine draft-k-verify rounds):
     # proposed/accepted measure draft quality, committed/rounds is the
     # tokens-per-step headline

@@ -40,6 +40,14 @@ routing (round-robin / random / load-aware / prefix-affinity over a
 host-side shadow of each replica's prefix chains) and zero-loss failover
 (crash -> drain -> requeue on siblings -> warm restart).  :mod:`.driver`
 is the shared Poisson drive loop — it takes an engine or a router.
+
+Stall-free SLO serving (this PR): ``ServingEngine(prefill_chunk_tokens=)``
+interleaves page-aligned prefill chunks with decode steps (Sarathi-style —
+long prompts stop stalling co-batched decodes, token-identical to
+whole-prefill), ``Request.priority`` + deadlines turn the scheduler into a
+two-tier EDF with slot preemption and bounded-wait anti-starvation, and
+``shed_infeasible=True`` sheds dead-on-arrival deadlines at admission with
+the distinct :class:`SLOInfeasible` signal.
 """
 
 from neuronx_distributed_tpu.kvcache.allocator import PoolExhausted
@@ -62,14 +70,19 @@ from neuronx_distributed_tpu.serving.fleet import (
 )
 from neuronx_distributed_tpu.serving.paged import PagedKVManager
 from neuronx_distributed_tpu.serving.request import (
+    PRIORITIES,
+    PRIORITY_BATCH,
+    PRIORITY_INTERACTIVE,
     Request,
     RequestOutput,
     RequestState,
     SamplingParams,
 )
 from neuronx_distributed_tpu.serving.scheduler import (
+    DEFAULT_MAX_BATCH_WAIT_S,
     AdmissionError,
     BackpressureError,
+    SLOInfeasible,
     SlotScheduler,
 )
 
@@ -79,12 +92,17 @@ __all__ = [
     "FAIL_NON_FINITE",
     "PagedKVManager",
     "PoolExhausted",
+    "PRIORITIES",
+    "PRIORITY_BATCH",
+    "PRIORITY_INTERACTIVE",
     "Request",
     "RequestOutput",
     "RequestState",
     "SamplingParams",
     "AdmissionError",
     "BackpressureError",
+    "SLOInfeasible",
+    "DEFAULT_MAX_BATCH_WAIT_S",
     "SlotScheduler",
     "replay_trace",
 ]

@@ -57,9 +57,11 @@ import numpy as np
 
 from neuronx_distributed_tpu.obs import MS_BUCKETS, MetricRegistry
 from neuronx_distributed_tpu.obs.transfer_audit import TransferAudit
-from neuronx_distributed_tpu.resilience.faults import perturb
+from neuronx_distributed_tpu.resilience.faults import fault_point, perturb
 from neuronx_distributed_tpu.serving.driver import replay as driver_replay
 from neuronx_distributed_tpu.serving.request import (
+    PRIORITIES,
+    PRIORITY_INTERACTIVE,
     Request,
     RequestOutput,
     RequestState,
@@ -68,8 +70,10 @@ from neuronx_distributed_tpu.kvcache.allocator import PoolExhausted
 from neuronx_distributed_tpu.kvcache.quant import QUANT_PAGES_TOTAL
 from neuronx_distributed_tpu.serving.paged import PagedKVManager
 from neuronx_distributed_tpu.serving.scheduler import (
+    DEFAULT_MAX_BATCH_WAIT_S,
     AdmissionError,
     BackpressureError,
+    SLOInfeasible,
     SlotScheduler,
 )
 from neuronx_distributed_tpu.trace.engine import (
@@ -83,9 +87,32 @@ from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
-SERVING_STATS_SCHEMA = "serving_stats/3"
+SERVING_STATS_SCHEMA = "serving_stats/4"
 
 FAIL_NON_FINITE = "non_finite_logits"
+
+SHED_EXPIRED_BEFORE_PREFILL = "expired_before_prefill"
+
+
+class _ChunkPrefill:
+    """Per-slot progress of a paged chunked prefill: the admission-time
+    prompt row and validity, the contiguous run of fresh ``(logical,
+    physical)`` pages still to compute, and the last chunk's logits (the
+    final chunk's are the prefill logits the first token samples from)."""
+
+    __slots__ = ("req", "ids_row", "valid_row", "fresh", "next_i", "logits")
+
+    def __init__(self, req, ids_row, valid_row, fresh):
+        self.req = req
+        self.ids_row = ids_row      # np [C] left-padded prompt ids
+        self.valid_row = valid_row  # np [T] full-prompt key validity
+        self.fresh = fresh          # [(lp, phys), ...] ascending, contiguous
+        self.next_i = 0             # index into fresh of the next chunk page
+        self.logits = None
+
+    @property
+    def pages_remaining(self) -> int:
+        return len(self.fresh) - self.next_i
 
 
 @jax.jit
@@ -314,6 +341,34 @@ class ServingEngine:
       ``kvcache.quant``), roughly doubling ``pages_for_budget`` at a
       bounded, parity-tested logit drift.  ``kvcache/quant_pages_total``
       counts quantized page writes.
+
+    Stall-free SLO serving (this PR; paged mode):
+
+    - ``prefill_chunk_tokens=N`` (a multiple of ``page_size``) turns long
+      prompts into Sarathi-style chunked prefills: at most ``N`` prompt
+      tokens are prefilled per engine step (page-aligned
+      ``prefill_chunk_pages`` scatters at the slot's offset), a PREFILLING
+      slot co-exists with decoding slots inside one ``step()``, and the
+      outputs stay token-identical to whole-prefill (prefix-cache hits
+      still skip resident chunks).  Co-batched decodes tick every step, so
+      inter-token latency no longer spikes with a neighbor's prompt
+      length.  Does not compose with ``spec_k``/``kv_quant``/
+      ``adapter_store`` yet;
+    - ``Request.priority`` ("interactive" | "batch") + EDF replace FCFS:
+      interactive requests are granted first and may PREEMPT a decoding
+      batch-tier victim when blocked on slots/pages (victim pages released
+      transactionally, request requeued and re-prefilled later,
+      token-identical); ``max_batch_wait_s`` bounds batch-tier wait — an
+      over-bound head is promoted and becomes preemption-immune, so the
+      batch tier always drains;
+    - ``shed_infeasible=True`` sheds a request whose deadline the EWMA
+      queue-wait + TTFT estimate already exceeds with the distinct
+      ``SLOInfeasible`` signal at submit (counted in
+      ``serving/shed_total``), and every prefill/chunk dispatch re-checks
+      the deadline first (``serving/expired_before_prefill_total``) so a
+      dead queue head never burns prefill compute.  Per-class TTFT and
+      inter-token histograms (``serving/{ttft,intertoken}_ms_<class>``)
+      carry the per-tier SLO story.
     """
 
     def __init__(
@@ -337,11 +392,16 @@ class ServingEngine:
         spec_k: int = 0,
         adapter_store: Any = None,
         kv_quant: Optional[str] = None,
+        prefill_chunk_tokens: Optional[int] = None,
+        max_batch_wait_s: Optional[float] = DEFAULT_MAX_BATCH_WAIT_S,
+        shed_infeasible: bool = False,
     ):
         attrs = ("prefill_one", "insert_slot", "decode_slots")
         if page_size is not None:
             attrs += ("decode_pages", "write_page", "insert_valid",
                       "make_page_pool")
+        if prefill_chunk_tokens is not None:
+            attrs += ("prefill_chunk_pages",)
         if spec_k:
             attrs += ("verify_pages",)
         if adapter_store is not None:
@@ -394,6 +454,31 @@ class ServingEngine:
                 "speculative decoding does not compose with adapter_store/"
                 "kv_quant yet (the multi-token verification chunk would "
                 "need adapter-aware, requantizing page writes)")
+        # paged chunked prefill (Sarathi-style stall-free batching): long
+        # prompts trickle into the page pool across steps — a PREFILLING
+        # slot co-exists with decoding slots, and the per-step token budget
+        # bounds how much prefill work any one step may do
+        if prefill_chunk_tokens is not None:
+            if page_size is None:
+                raise ValueError(
+                    "prefill_chunk_tokens needs the paged engine "
+                    "(page_size=/num_pages=): chunks write page-aligned "
+                    "block-table scatters")
+            if prefill_chunk_tokens < page_size \
+                    or prefill_chunk_tokens % page_size != 0:
+                raise ValueError(
+                    f"prefill_chunk_tokens ({prefill_chunk_tokens}) must be "
+                    f"a positive multiple of page_size ({page_size}) — "
+                    "chunks are page-aligned so cached prefix pages can be "
+                    "skipped whole")
+            if spec_k or kv_quant is not None or adapter_store is not None:
+                raise ValueError(
+                    "prefill_chunk_tokens does not compose with draft=/"
+                    "spec_k=, kv_quant= or adapter_store= yet (the chunk "
+                    "scatter is fp-pool, base-model only)")
+        self._chunk_tokens = prefill_chunk_tokens
+        self._chunking: dict = {}   # slot -> _ChunkPrefill in progress
+        self._chunk_rr = 0          # budget-rotation cursor (fairness)
         self._adapters = adapter_store
         self._kv_quant = kv_quant
         if spec_k:
@@ -449,7 +534,9 @@ class ServingEngine:
                 spec_overshoot=self._spec_k)
         self.scheduler = SlotScheduler(
             self.B, self.C, self.T, max_queue=max_queue,
-            page_gate=self._kv, reserve_extra=self._spec_k)
+            page_gate=self._kv, reserve_extra=self._spec_k,
+            max_batch_wait_s=max_batch_wait_s,
+            shed_infeasible=shed_infeasible)
         self.step_timeout_s = step_timeout_s
         self._steps = 0
         if transfer_guard not in ("off", "forbid"):
@@ -511,6 +598,12 @@ class ServingEngine:
             self._draft_valid = jnp.zeros((self.B, self.T), jnp.int32)
         self._offsets = np.full((self.B,), self.T, np.int32)  # T = parked
         self._next_tok = np.zeros((self.B,), np.int32)
+        # per-slot occupancy generation, bumped at every admission: the
+        # async collect uses it (with the slot-identity check) to discard
+        # an in-flight token whose slot was released AND re-granted — even
+        # back to the SAME request (preempt → requeue → re-admit inside one
+        # step starts a fresh generation the stale token must never join)
+        self._slot_gen = np.zeros((self.B,), np.int64)
         self._last_tok_time: List[Optional[float]] = [None] * self.B
         # per-slot sampling state, written once at admission so the decode
         # loop builds no per-slot keys host-side: base_keys[b] is the
@@ -549,8 +642,15 @@ class ServingEngine:
         reg.histogram("serving/host_blocked_ms", MS_BUCKETS)
         reg.gauge("serving/last_step_ms")
         for c in ("admitted", "finished", "cancelled", "timed_out", "tokens",
-                  "rejected", "failed", "slow_steps"):
+                  "rejected", "failed", "slow_steps", "preemptions", "shed",
+                  "expired_before_prefill", "prefill_chunks"):
             reg.counter(f"serving/{c}_total")
+        # per-priority-class latency histograms: the SLO story is per tier
+        # (the whole point of priority scheduling is that the interactive
+        # percentiles stay flat while batch absorbs the queueing)
+        for cls in PRIORITIES:
+            reg.histogram(f"serving/ttft_ms_{cls}", MS_BUCKETS)
+            reg.histogram(f"serving/intertoken_ms_{cls}", MS_BUCKETS)
         if self._spec_k:
             # speculative throughput accounting: committed/rounds is the
             # tokens-per-step headline, accepted/proposed the draft quality
@@ -584,6 +684,11 @@ class ServingEngine:
                     f"adapter {aid}")
         try:
             self.scheduler.submit(request, now=self._clock())
+        except SLOInfeasible:
+            # distinct from queue-full backpressure: the deadline is already
+            # dead under current load — shed at the edge, never admitted
+            self.registry.counter("serving/shed_total").inc()
+            raise
         except BackpressureError:
             self.registry.counter("serving/rejected_total").inc()
             raise
@@ -621,13 +726,25 @@ class ServingEngine:
                     else "serving/timed_out_total").inc()
                 outputs.append(self._emit(req, now))
 
-        # 2) admission: slot-insert prefill per granted request (its device
+        # 2) priority preemption: when the interactive head is blocked on a
+        # full slot table (or exhausted pages), park batch-tier victims —
+        # pages released transactionally, the request requeued for a later
+        # token-identical re-prefill
+        self._preempt_for_priority(now)
+
+        # 3) admission: slot-insert prefill per granted request (its device
         # work queues behind the in-flight decode, keeping the device busy
         # while the host prepares the batch)
         for slot, req in self.scheduler.admit(now):
             self._prefill_into_slot(slot, req, outputs)
 
-        # 3) decode: one single-token batched step, or — speculative mode —
+        # 3b) chunked prefill: advance every PREFILLING slot by up to the
+        # per-step token budget (Sarathi-style — decodes below keep ticking
+        # every step while long prompts trickle in)
+        if self._chunking:
+            self._run_prefill_chunks(outputs)
+
+        # 4) decode: one single-token batched step, or — speculative mode —
         # one draft-k-verify round committing up to k+1 tokens per slot
         if self.async_decode:
             # pipelined: collect the in-flight step's packed results (one
@@ -729,7 +846,20 @@ class ServingEngine:
         cached prefill logits and skips ``prefill_one`` entirely), atomic
         page allocation, page-aligned writes of only the UNCACHED prompt
         pages, and prefix-index registration.  A failure mid-admission
-        reclaims every page, fails the one request, and re-raises."""
+        reclaims every page, fails the one request, and re-raises.
+
+        Chunked mode (``prefill_chunk_tokens``) stops after the block-table
+        assembly: the fresh prompt pages are computed by the per-step
+        budgeted chunk loop instead, and the request stays PREFILLING
+        across steps while decodes keep ticking."""
+        now = self._clock()
+        # pre-dispatch expiry: the sweep ran at step start, but a request
+        # can expire between sweep and prefill — never burn a prefill (or
+        # its first chunk) on a deadline that is already dead
+        if req.expired(now):
+            self._expire_before_prefill(slot, req, outputs, now)
+            return
+        self._slot_gen[slot] += 1  # a fresh occupancy generation begins
         L = req.prompt_len
         ids = np.zeros((1, self.C), np.int32)
         ids[0, self.C - L:] = req.prompt_ids  # LEFT-padded to the traced width
@@ -784,6 +914,34 @@ class ServingEngine:
                 self._slot_adapter[slot] = aid
                 self._adapter_tables[slot] = self._adapters.table(aid)
                 self._adapter_dirty = True
+            fresh = (self._kv.fresh_pages(slot)
+                     if self._chunk_tokens is not None and cached is None
+                     else [])
+            if fresh:
+                # chunked prefill — EVERY fresh prefill rides the chunk
+                # path in chunked mode, not just long prompts: the whole
+                # ``prefill_one`` program is compiled at the full context
+                # width, so even a short prompt's admission stalls
+                # co-batched decodes for a full-width forward, while a
+                # chunk costs only its own span.  The block table is
+                # assembled and the fresh prompt pages reserved here; the
+                # compute is deferred to the per-step budgeted chunk loop
+                # (a span that fits the budget completes in this same
+                # step — same TTFT step count as the whole path).  Fresh
+                # pages are always one contiguous logical run (padding
+                # pages lead and ride the NULL page; the matched prefix is
+                # a leading chain), so chunks walk it left to right.
+                lps = [lp for lp, _ in fresh]
+                assert lps == list(range(lps[0], lps[0] + len(lps))), (
+                    f"fresh prompt pages not contiguous: {lps}")
+                self.valid = self.model.insert_valid(self.valid, row_valid,
+                                                     slot)
+                valid_full_np = np.concatenate(
+                    [valid_np, np.zeros((self.T - self.C,), np.int32)])
+                self._chunking[slot] = _ChunkPrefill(
+                    req, ids[0].copy(), valid_full_np, fresh)
+                self._set_sampling_state(slot, req)
+                return
             if cached is not None:
                 # exact full-prompt prefix hit: the chain's pages already
                 # hold this prompt's KV and the payload is the prefill's
@@ -834,6 +992,13 @@ class ServingEngine:
                     self._draft_caches, drow_caches, self._draft_valid,
                     row_valid, slot)
 
+        self._set_sampling_state(slot, req)
+        self._finish_prefill(slot, req, logits, outputs, prefilled_fresh)
+
+    def _set_sampling_state(self, slot: int, req: Request) -> None:
+        """Write the slot's per-request sampler state (base key, temp,
+        top-k/p) once at admission, so the decode loop builds no per-slot
+        keys host-side."""
         s = req.sampling
         if s.temperature > 0.0 and self._rng is not None:
             self._base_keys[slot] = np.asarray(
@@ -844,6 +1009,13 @@ class ServingEngine:
         self._topks[slot] = s.top_k
         self._topps[slot] = s.top_p
         self._sampling_dirty = True  # device mirrors refresh at next dispatch
+
+    def _finish_prefill(self, slot: int, req: Request, logits,
+                        outputs: list, prefilled_fresh: bool) -> None:
+        """The prefill's first-token tail, shared by the whole-prefill path
+        and the chunk loop's final chunk: sample, finite-gate, register the
+        prefix chain, transition to DECODE, stream/emit."""
+        s = req.sampling
         toks, finite = _sample_rows(
             logits, jnp.asarray(self._base_keys[slot])[None, :],
             jnp.zeros((1,), jnp.int32),
@@ -863,19 +1035,158 @@ class ServingEngine:
             self._fail_slot(slot, req, outputs, now)
             return
         if prefilled_fresh:
-            self._kv.finish_insert(slot, np.asarray(logits))
+            # the payload is the DEVICE logits array (not a host copy): a
+            # future full-prefix hit then feeds the sampler an input with
+            # the same committed sharding as a fresh prefill's, instead of
+            # recompiling it for an uncommitted host upload — a hit must
+            # never cost a sampler compile mid-serve
+            self._kv.finish_insert(slot, logits)
         tok = int(first[0][0])
         req.transition(RequestState.DECODE)
         req.first_token_time = now
         if req.submit_time is not None:
+            ttft_s = now - req.submit_time
             self.registry.histogram("serving/ttft_ms", MS_BUCKETS).observe(
-                (now - req.submit_time) * 1e3)
+                ttft_s * 1e3)
+            self.registry.histogram(
+                f"serving/ttft_ms_{req.priority}", MS_BUCKETS).observe(
+                    ttft_s * 1e3)
+            # feed the deadline-feasibility estimator real service times
+            self.scheduler.note_first_token(ttft_s)
         self._append_token(slot, req, tok, now)
         if not req.done:
             self._offsets[slot] = self.C
             self._next_tok[slot] = tok
         else:
             outputs.append(self._emit(req, now))
+
+    def _run_prefill_chunks(self, outputs: list) -> None:
+        """Advance every PREFILLING slot by up to the per-step chunk budget
+        (``prefill_chunk_tokens``, in pages): each chunk scatters
+        page-aligned prompt KV into the slot's reserved pages through
+        ``prefill_chunk_pages``, and the FINAL chunk's last-position logits
+        are the prefill logits the shared first-token tail samples from —
+        token-identical to a whole ``prefill_one``.  The start slot rotates
+        step to step so one long prompt cannot hog the budget, and each
+        slot's deadline is re-checked immediately before its dispatch (a
+        dead request never burns a chunk)."""
+        page = self._kv.page_size
+        budget = self._chunk_tokens // page  # pages this step may prefill
+        slots = sorted(self._chunking)
+        start = self._chunk_rr % len(slots)
+        self._chunk_rr += 1
+        rotated = slots[start:] + slots[:start]
+        # interactive prefills drink the budget first — a batch tier's long
+        # prompt must not delay an interactive first token
+        rotated.sort(
+            key=lambda s: self._chunking[s].req.priority
+            != PRIORITY_INTERACTIVE)
+        for slot in rotated:
+            if budget <= 0:
+                break
+            st = self._chunking.get(slot)
+            if st is None:
+                continue
+            req = st.req
+            now = self._clock()
+            if req.expired(now):
+                # pre-dispatch expiry: the head died mid-chunking — reclaim
+                # its pages now instead of finishing a prefill nobody reads
+                self._chunking.pop(slot, None)
+                self._expire_before_prefill(slot, req, outputs, now)
+                continue
+            n = min(budget, st.pages_remaining)
+            budget -= n
+            try:
+                self._dispatch_chunk(slot, st, n)
+            except BaseException as e:
+                # transactional like the admission path: the one request
+                # fails, every page is reclaimed, then the fault propagates
+                # (a fleet replica treats it as a crash and requeues)
+                now = self._clock()
+                self._chunking.pop(slot, None)
+                self._fail_slot_state(
+                    slot, req, now,
+                    reason=f"prefill_chunk:{type(e).__name__}")
+                logger.warning(
+                    "serving: request %d failed mid-chunked-prefill (%s) — "
+                    "every page reclaimed, slot %d freed", req.request_id,
+                    e, slot)
+                outputs.append(self._emit(req, now))
+                raise
+            if st.pages_remaining == 0:
+                self._chunking.pop(slot, None)
+                self._finish_prefill(slot, req, st.logits, outputs,
+                                     prefilled_fresh=True)
+
+    def _dispatch_chunk(self, slot: int, st: _ChunkPrefill,
+                        n_pages: int) -> None:
+        """One ``prefill_chunk_pages`` call covering the slot's next
+        ``n_pages`` fresh prompt pages (page-aligned, contiguous)."""
+        page = self._kv.page_size
+        off = st.fresh[st.next_i][0] * page
+        width = n_pages * page
+        ids_chunk = st.ids_row[off:off + width][None, :]
+        # chaos hook: a kill mid-chunked-prefill must reclaim every page
+        # and leave the request cleanly requeue-able (tests/test_slo_*)
+        fault_point("serving/prefill_chunk", request_id=st.req.request_id,
+                    engine_step=self._steps, chunk_offset=off)
+        logits, self.caches = self.model.prefill_chunk_pages(
+            jnp.asarray(ids_chunk), off,
+            self._kv.tables[slot][None, :].copy(), self.caches,
+            st.valid_row[None, :].copy())
+        st.next_i += n_pages
+        if st.pages_remaining == 0:
+            # same fault point the whole-prefill path perturbs, applied to
+            # the prefill logits the first token will sample from
+            logits = perturb("serving/prefill_logits", logits,
+                             request_id=st.req.request_id,
+                             engine_step=self._steps)
+        st.logits = logits
+        self.registry.counter("serving/prefill_chunks_total").inc()
+
+    def _preempt_for_priority(self, now: float) -> None:
+        """Park batch-tier victims while the scheduler says the interactive
+        head is blocked on slots/pages: pages released transactionally, the
+        victim requeued at its original EDF position for a later
+        token-identical re-prefill (the clone discipline the fleet's
+        failover already proved)."""
+        for _ in range(self.B):
+            picked = self.scheduler.pick_preemption(now)
+            if picked is None:
+                return
+            slot, req = picked
+            self.scheduler.requeue(req)  # frees the slot, resets the request
+            self._chunking.pop(slot, None)
+            self._offsets[slot] = self.T  # park
+            self._last_tok_time[slot] = None
+            if self._kv is not None:
+                self._kv.release_slot(slot)
+            self._release_adapter(slot)
+            self.registry.counter("serving/preemptions_total").inc()
+            logger.info(
+                "serving: preempted batch request %d from slot %d for the "
+                "interactive queue head (%d preemption(s) so far)",
+                req.request_id, slot, req.preemptions)
+
+    def _expire_before_prefill(self, slot: int, req: Request, outputs: list,
+                               now: float) -> None:
+        """A granted request whose deadline expired between the step-start
+        sweep and its prefill (or next chunk) dispatch: terminal TIMED_OUT
+        without burning any prefill compute, slot and pages reclaimed."""
+        req.transition(RequestState.TIMED_OUT)
+        req.finish_reason = RequestState.TIMED_OUT.value
+        req.finish_time = now
+        req.shed_reason = SHED_EXPIRED_BEFORE_PREFILL
+        self.scheduler.release(req)
+        self._offsets[slot] = self.T  # park
+        self._last_tok_time[slot] = None
+        if self._kv is not None:
+            self._kv.release_slot(slot)
+        self._release_adapter(slot)
+        self.registry.counter("serving/expired_before_prefill_total").inc()
+        self.registry.counter("serving/timed_out_total").inc()
+        outputs.append(self._emit(req, now))
 
     def _decode_step(self, active: list, outputs: list) -> None:
         """One per-slot-offset decode over the whole batch; inactive slots
@@ -921,10 +1232,7 @@ class ServingEngine:
             tok = int(toks[slot])
             last = self._last_tok_time[slot]
             if last is not None:
-                ms = (now - last) * 1e3
-                req.intertoken_ms.append(ms)
-                self.registry.histogram(
-                    "serving/intertoken_ms", MS_BUCKETS).observe(ms)
+                self._observe_intertoken(req, (now - last) * 1e3)
             self._append_token(slot, req, tok, now)
             if not req.done:
                 self._next_tok[slot] = tok
@@ -947,11 +1255,18 @@ class ServingEngine:
         toks, finite = packed[0], packed[1]
         now = self._clock()
         post: list = []
-        for slot, req in active:
-            if req.state is not RequestState.DECODE:
-                # swept (cancelled / timed out) while the step was in
-                # flight: the sweep already released and parked the slot —
-                # the speculative token is discarded, never streamed
+        for slot, req, gen in active:
+            if req.state is not RequestState.DECODE \
+                    or self.scheduler.slot_of(req.request_id) != slot \
+                    or self._slot_gen[slot] != gen:
+                # swept (cancelled / timed out) — or preempted AND
+                # re-admitted — while the step was in flight: the slot was
+                # released (and possibly re-granted), so the stale token is
+                # discarded and the offset untouched.  The state check
+                # alone is not enough (a preemption round-trip can put the
+                # request back in DECODE within one step), and neither is
+                # slot identity (it can be re-granted the SAME slot) — the
+                # occupancy generation is what tells the generations apart.
                 continue
             self._offsets[slot] += 1  # the step wrote req's previous token
             if not finite[slot]:
@@ -1037,7 +1352,9 @@ class ServingEngine:
         toks, finite = _sample_rows(
             logits, self._keys_dev, tidx,
             self._temps_dev, self._topks_dev, self._topps_dev)
-        self._pending = (_pack_tokens(toks, finite), list(active))
+        self._pending = (_pack_tokens(toks, finite),
+                         [(slot, req, int(self._slot_gen[slot]))
+                          for slot, req in active])
 
     def _spec_dispatch(self, active: list) -> None:
         """Dispatch one speculative draft-k-verify round for the current
@@ -1101,7 +1418,9 @@ class ServingEngine:
             vlogits, jnp.stack(q_filts, axis=1), jnp.stack(props, axis=1),
             self._keys_dev, tidx, self._temps_dev, self._topks_dev,
             self._topps_dev, dfin)
-        self._pending = (packed, list(active), props[-1])
+        self._pending = (packed,
+                         [(slot, req, int(self._slot_gen[slot]))
+                          for slot, req in active], props[-1])
 
     def _spec_collect(self) -> list:
         """Collect the in-flight speculative round: ONE explicit packed
@@ -1130,9 +1449,13 @@ class ServingEngine:
         ingest = np.full((self.B,), self.T, np.int32)
         need_ingest = False
         reg = self.registry
-        for slot, req in active:
-            if req.state is not RequestState.DECODE:
-                continue  # swept while the round was in flight
+        for slot, req, gen in active:
+            if req.state is not RequestState.DECODE \
+                    or self.scheduler.slot_of(req.request_id) != slot \
+                    or self._slot_gen[slot] != gen:
+                # swept — or preempted and re-admitted — while the round
+                # was in flight (see _collect_decode)
+                continue
             if not finite[slot]:
                 self._fail_slot_state(slot, req, now)
                 post.append(("fail", slot, req, 0, None, now))
@@ -1192,9 +1515,7 @@ class ServingEngine:
                 # one speculative round's committed run (tok is a list)
                 for t in tok:
                     if ms is not None:
-                        req.intertoken_ms.append(ms)
-                        self.registry.histogram(
-                            "serving/intertoken_ms", MS_BUCKETS).observe(ms)
+                        self._observe_intertoken(req, ms)
                     if req.stream_cb is not None:
                         req.stream_cb(req, t)
                 if req.done:
@@ -1208,13 +1529,21 @@ class ServingEngine:
                 outputs.append(self._emit(req, now))
                 continue
             if ms is not None:
-                req.intertoken_ms.append(ms)
-                self.registry.histogram(
-                    "serving/intertoken_ms", MS_BUCKETS).observe(ms)
+                self._observe_intertoken(req, ms)
             if req.stream_cb is not None:
                 req.stream_cb(req, tok)
             if req.done:
                 outputs.append(self._emit(req, now))
+
+    def _observe_intertoken(self, req: Request, ms: float) -> None:
+        """Record one inter-token gap on the request, the global histogram,
+        and the request's priority-class histogram (the per-tier p99 is the
+        SLO headline)."""
+        req.intertoken_ms.append(ms)
+        self.registry.histogram(
+            "serving/intertoken_ms", MS_BUCKETS).observe(ms)
+        self.registry.histogram(
+            f"serving/intertoken_ms_{req.priority}", MS_BUCKETS).observe(ms)
 
     def _stop_reason(self, req: Request, tok: int) -> Optional[str]:
         """Finish reason for ``tok`` (already appended), engine-level EOS
@@ -1251,6 +1580,7 @@ class ServingEngine:
         req.finish_reason = reason
         req.finish_time = now
         self.scheduler.release(req)
+        self._chunking.pop(slot, None)
         self._offsets[slot] = self.T  # park
         self._last_tok_time[slot] = None
         if self._kv is not None:
@@ -1304,6 +1634,7 @@ class ServingEngine:
             if slot not in live:
                 self._offsets[slot] = self.T
                 self._last_tok_time[slot] = None
+                self._chunking.pop(slot, None)  # abandon a mid-chunk prefill
                 if self._kv is not None:  # idempotent page reclamation
                     self._kv.release_slot(slot)
                 self._release_adapter(slot)  # idempotent pin release
@@ -1330,6 +1661,14 @@ class ServingEngine:
                 "acceptance_rate": out.acceptance_rate,
                 # tenancy: which LoRA adapter served it (0 = base model)
                 "adapter_id": out.adapter_id,
+                # SLO scheduling (v4): priority class, deadline budget,
+                # queue wait, preemption round-trips, and — for requests
+                # the engine shed pre-prefill — why
+                "priority": out.priority,
+                "deadline_s": out.deadline_s,
+                "queue_wait_ms": out.queue_ms,
+                "preemptions": out.preemptions,
+                "shed_reason": req.shed_reason,
             }
             self._stats_f.write(json.dumps(rec) + "\n")
             self._stats_f.flush()

@@ -29,9 +29,11 @@ concerns a single engine cannot see:
   shadow is cleared then resynced from the live index truth.  The
   invariant — every accepted request yields EXACTLY ONE terminal output —
   is what the churn property tests and the ``fleet_bench`` kill rung
-  assert.  (Failover caveats: a requeued request restarts generation, so
-  its ``stream_cb`` re-streams from token 0 — at-least-once streaming —
-  and its deadline re-arms at requeue.)
+  assert.  (Failover caveat: a requeued request restarts generation, so
+  its ``stream_cb`` re-streams from token 0 — at-least-once streaming.
+  Deadlines stay ABSOLUTE through a crash: the clone carries the original
+  submission instant, and a clone whose deadline already expired fails
+  terminally as TIMED_OUT instead of burning a re-prefill.)
 
 Telemetry: ``router/*`` counters and gauges through the standard
 ``MetricRegistry`` (declared in ``obs.schemas.REGISTRY_METRICS``) plus one
@@ -528,6 +530,16 @@ class FleetRouter:
             if not any(r.alive for r in self.replicas.values()):
                 return
             rec = self._pending.popleft()
+            now = self._clock()
+            if self._deadline_expired(rec, now):
+                # the head's absolute deadline died while it was parked:
+                # fail it terminally instead of burning a re-prefill on a
+                # request nobody is waiting for anymore
+                out = self._synthetic_output(rec, "timed_out", "timed_out",
+                                             now)
+                self._finish(rec, out)
+                self._emit_next.append(out)
+                continue
             before = len(self._pending)
             # build the requeue clone once per parked spell and reuse it
             # across bounced drain attempts (scheduler submit mutates
@@ -549,14 +561,28 @@ class FleetRouter:
         the requeue unit.  The clone shares the template's stream_cb (which
         therefore re-streams from token 0) and sampling params; the global
         id is preserved, so the rng stream — and a greedy or sampled
-        request's tokens — are identical wherever it lands."""
+        request's tokens — are identical wherever it lands.  The clone also
+        carries the ORIGINAL submission instant, so ``deadline_s`` stays an
+        absolute SLO through a crash (the scheduler preserves a pre-set
+        ``submit_time``) instead of silently re-arming at requeue."""
         t = rec.template
-        return Request(
+        clone = Request(
             request_id=rec.global_id, prompt_ids=list(t.prompt_ids),
             max_new_tokens=t.max_new_tokens, sampling=t.sampling,
             stop_token_ids=t.stop_token_ids, deadline_s=t.deadline_s,
             stream_cb=t.stream_cb,
-            adapter_id=getattr(t, "adapter_id", 0))
+            adapter_id=getattr(t, "adapter_id", 0),
+            priority=getattr(t, "priority", "interactive"))
+        clone.submit_time = rec.submit_time
+        return clone
+
+    def _deadline_expired(self, rec: _Tracked, now: float) -> bool:
+        """Whether the request's absolute deadline (from the router-accept
+        instant) has already passed — an expired clone must fail terminally
+        as TIMED_OUT, never burn a sibling's re-prefill."""
+        t = rec.template
+        return (t is not None and t.deadline_s is not None
+                and now - rec.submit_time > t.deadline_s)
 
     def _failover(self, replica: Replica, exc: BaseException,
                   now: float) -> None:
@@ -581,6 +607,15 @@ class FleetRouter:
                 # the cancel was granted before the crash; emit the terminal
                 # output the dead engine never got to sweep
                 out = self._synthetic_output(rec, "cancelled", "cancelled",
+                                             now)
+                self._finish(rec, out)
+                self._emit_next.append(out)
+                continue
+            if self._deadline_expired(rec, now):
+                # an already-expired orphan fails terminally as TIMED_OUT —
+                # requeueing it would both extend its SLO through the crash
+                # and burn a sibling's prefill on a dead request
+                out = self._synthetic_output(rec, "timed_out", "timed_out",
                                              now)
                 self._finish(rec, out)
                 self._emit_next.append(out)

@@ -198,7 +198,14 @@ class PagedKVManager:
     def fresh_pages(self, slot: int) -> List[tuple]:
         """``[(logical_page, phys_page), ...]`` the engine must fill from
         the prefill row caches — cached-prefix (and padding) pages are
-        absent, so their writes are skipped entirely."""
+        absent, so their writes are skipped entirely.
+
+        The logical pages are always ONE CONTIGUOUS ascending run: padding
+        pages lead (left-padded prompts) and ride the NULL page, and the
+        matched prefix is a leading chain, so everything between the first
+        fresh page and ``ctx_pages`` is fresh.  The chunked-prefill loop
+        (``ServingEngine(prefill_chunk_tokens=)``) walks this run left to
+        right, one budgeted chunk per step."""
         return list(self._slot_fresh[slot])
 
     def finish_insert(self, slot: int, payload: Any) -> None:

@@ -35,15 +35,21 @@ class RequestState(enum.Enum):
 
 # legal lifecycle edges; terminal states have no successors.  FAILED is
 # reachable only from the compute states (PREFILL/DECODE): a queued request
-# has run nothing that could fail.
+# has run nothing that could fail.  The compute states can also go BACK to
+# QUEUED — slot preemption (an interactive request evicting a batch-tier
+# victim) parks the victim for a later re-prefill from its prompt; the
+# :meth:`Request.reset_for_requeue` helper is the one sanctioned way to
+# take that edge (it also rewinds the generation state the re-prefill will
+# reproduce).
 _TRANSITIONS = {
     RequestState.QUEUED: {RequestState.PREFILL, RequestState.CANCELLED,
                           RequestState.TIMED_OUT},
     RequestState.PREFILL: {RequestState.DECODE, RequestState.FINISHED,
                            RequestState.CANCELLED, RequestState.TIMED_OUT,
-                           RequestState.FAILED},
+                           RequestState.FAILED, RequestState.QUEUED},
     RequestState.DECODE: {RequestState.FINISHED, RequestState.CANCELLED,
-                          RequestState.TIMED_OUT, RequestState.FAILED},
+                          RequestState.TIMED_OUT, RequestState.FAILED,
+                          RequestState.QUEUED},
     RequestState.FINISHED: set(),
     RequestState.CANCELLED: set(),
     RequestState.TIMED_OUT: set(),
@@ -53,6 +59,14 @@ _TRANSITIONS = {
 TERMINAL_STATES = frozenset(
     s for s, nxt in _TRANSITIONS.items() if not nxt
 )
+
+# priority classes, most-urgent first: the interactive tier preempts the
+# batch tier for slots and pages; within a class ordering is
+# earliest-deadline-first (deadline-less requests order FCFS behind every
+# deadline, by submission)
+PRIORITY_INTERACTIVE = "interactive"
+PRIORITY_BATCH = "batch"
+PRIORITIES = (PRIORITY_INTERACTIVE, PRIORITY_BATCH)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,6 +110,10 @@ class Request:
     # the engine's AdapterStore, are pinned resident at admission and
     # released on every terminal state
     adapter_id: int = 0
+    # SLO scheduling: the priority class ("interactive" preempts "batch"
+    # for slots and pages; within a class, earliest-deadline-first replaces
+    # FCFS — deadline-less requests order FCFS behind every deadline)
+    priority: str = PRIORITY_INTERACTIVE
 
     # lifecycle (engine-owned)
     state: RequestState = RequestState.QUEUED
@@ -112,6 +130,13 @@ class Request:
     # budget still counts (the rate measures draft quality, not the clip)
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # SLO scheduling accounting (engine-owned): how many times a slot this
+    # request held was preempted by a higher tier (each one discards its
+    # partial generation — the re-prefill reproduces it token-identically
+    # from the same rng stream), and — when the engine shed the request
+    # before its prefill ran — why (e.g. "expired_before_prefill")
+    preemptions: int = 0
+    shed_reason: Optional[str] = None
 
     def __post_init__(self):
         self.prompt_ids = [int(t) for t in self.prompt_ids]
@@ -125,6 +150,10 @@ class Request:
             raise ValueError(
                 f"request {self.request_id}: adapter_id must be >= 0, "
                 f"got {self.adapter_id}")
+        if self.priority not in PRIORITIES:
+            raise ValueError(
+                f"request {self.request_id}: priority must be one of "
+                f"{PRIORITIES}, got {self.priority!r}")
 
     @property
     def prompt_len(self) -> int:
@@ -140,6 +169,28 @@ class Request:
                 f"request {self.request_id}: illegal transition "
                 f"{self.state.value} -> {new_state.value}")
         self.state = new_state
+
+    def expired(self, now: float) -> bool:
+        """Whether the absolute deadline (``submit_time + deadline_s``) has
+        passed — the ONE deadline predicate the sweep, the pre-dispatch
+        prefill/chunk checks, and the shedding paths all share (so they can
+        never disagree on when a request is dead)."""
+        return (self.deadline_s is not None and self.submit_time is not None
+                and now - self.submit_time > self.deadline_s)
+
+    def reset_for_requeue(self) -> None:
+        """Slot preemption: park this (PREFILL/DECODE) request back to
+        QUEUED, discarding the partial generation — a later admission
+        re-prefills it from the prompt and, because the rng stream is keyed
+        only on ``(rng, request_id, token_index)``, regenerates the same
+        tokens.  ``submit_time`` (and so the absolute deadline) is
+        preserved; ``preemptions`` counts the round-trip."""
+        self.transition(RequestState.QUEUED)
+        self.generated.clear()
+        self.intertoken_ms.clear()
+        self.prefill_time = None
+        self.first_token_time = None
+        self.preemptions += 1
 
     def check_stop(self, token: int) -> Optional[str]:
         """Finish reason after appending ``token``, or None to keep going."""
@@ -171,6 +222,11 @@ class RequestOutput:
     spec_accepted: int = 0
     # the LoRA adapter the request decoded under (0 = base model)
     adapter_id: int = 0
+    # SLO scheduling: priority class, deadline budget, and how many times a
+    # higher tier preempted this request's slot
+    priority: str = PRIORITY_INTERACTIVE
+    deadline_s: Optional[float] = None
+    preemptions: int = 0
 
     @property
     def acceptance_rate(self) -> Optional[float]:
@@ -200,4 +256,7 @@ class RequestOutput:
             spec_proposed=req.spec_proposed,
             spec_accepted=req.spec_accepted,
             adapter_id=req.adapter_id,
+            priority=req.priority,
+            deadline_s=req.deadline_s,
+            preemptions=req.preemptions,
         )

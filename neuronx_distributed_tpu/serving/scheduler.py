@@ -1,27 +1,63 @@
-"""Slot scheduler: FCFS admission over a fixed-size slot table.
+"""Slot scheduler: priority/deadline admission over a fixed-size slot table.
 
 The compiled decode program has a fixed batch axis ``B``; this scheduler
 treats that axis as a RESOURCE POOL of ``B`` slots (iteration-level
-scheduling, Orca OSDI '22) rather than a tensor shape.  Requests queue FCFS;
-a request is admitted the moment a slot is free and its shape fits the
-compiled envelope; cancellation and deadline sweeps free slots immediately
-so the next queued request can enter on the same engine step.
+scheduling, Orca OSDI '22) rather than a tensor shape.  Requests queue per
+PRIORITY CLASS (``interactive`` ahead of ``batch``), ordered within a class
+earliest-deadline-first (EDF; deadline-less requests order FCFS behind
+every deadline by submission sequence — a one-class, no-deadline workload
+reproduces the historical FCFS scheduler exactly).  Cancellation and
+deadline sweeps free slots immediately so the next queued request can enter
+on the same engine step.
+
+SLO machinery (stall-free serving PR):
+
+- **tiering** — the interactive class is always served first, and when its
+  head is blocked on a full slot table (or an exhausted page pool) the
+  engine may PREEMPT a batch-tier victim (:meth:`pick_preemption`): the
+  victim's slot and pages are released, the request re-queues with its
+  ORIGINAL submit time (absolute deadline preserved) and is re-prefilled
+  from its prompt later — token-identical, because the rng stream is keyed
+  only on ``(rng, request_id, token_index)``;
+- **bounded wait** — a batch-tier head that has waited longer than
+  ``max_batch_wait_s`` is promoted ahead of the interactive queue for the
+  next grant and becomes immune to preemption, so the batch tier provably
+  drains under sustained interactive load (anti-starvation);
+- **deadline-feasibility shedding** — with ``shed_infeasible=True`` a
+  request whose deadline cannot cover even the estimated queue wait + time
+  to first token (EWMA estimates fed by real grants / first tokens) is
+  rejected at submit with the distinct :class:`SLOInfeasible` signal
+  instead of being admitted and abandoned mid-prefill.
 
 Pure host-side bookkeeping — no jax imports — so every policy property
-(no slot leak, FIFO order, capacity bound, cancellation frees the slot) is
-testable without compiling anything.
+(no slot leak, EDF order, capacity bound, bounded wait, preemption
+reclamation) is testable without compiling anything.
 """
 
 from __future__ import annotations
 
+import bisect
+import math
 import time
-from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from neuronx_distributed_tpu.serving.request import Request, RequestState
+from neuronx_distributed_tpu.serving.request import (
+    PRIORITIES,
+    PRIORITY_BATCH,
+    PRIORITY_INTERACTIVE,
+    Request,
+    RequestState,
+)
 from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+# default bounded-wait promotion threshold for the batch tier (seconds) —
+# long enough that interactive bursts win every contended grant, short
+# enough that the batch tier always drains
+DEFAULT_MAX_BATCH_WAIT_S = 30.0
+
+_EWMA_ALPHA = 0.25
 
 
 class AdmissionError(ValueError):
@@ -36,8 +72,17 @@ class BackpressureError(RuntimeError):
     without limit."""
 
 
+class SLOInfeasible(BackpressureError):
+    """The request's deadline cannot be met under the CURRENT load (the
+    estimated queue wait + time-to-first-token already exceeds it), so it
+    is shed at the edge instead of admitted and abandoned mid-prefill.
+    Transient like its parent — the same request is feasible once the
+    backlog drains — but distinct, so clients can tell "queue full" from
+    "your deadline is already dead here"."""
+
+
 class SlotScheduler:
-    """Fixed-``B`` slot table + FCFS queue.
+    """Fixed-``B`` slot table + per-priority-class EDF queues.
 
     Admission gates (checked at ``submit`` — a request that can NEVER fit
     is rejected up front rather than parked forever):
@@ -56,22 +101,36 @@ class SlotScheduler:
       ``pages_needed(request)``, ``pages_free()``, ``pages_capacity()``)
       admission gates on *pages free* instead of slots alone: a request
       whose worst-case page need exceeds the pool capacity is a permanent
-      :class:`AdmissionError`, the FCFS head waits (blocking the queue —
+      :class:`AdmissionError`, the chosen head waits (blocking the queue —
       no size-based bypass, so small requests cannot starve big ones) until
       both a slot and its pages are free, and the backpressure bound counts
       page-limited grants, so a pool-exhausted engine rejects overload with
-      the same retryable :class:`BackpressureError`.
+      the same retryable :class:`BackpressureError`;
+    - with ``shed_infeasible=True``, a deadline the EWMA queue-wait + TTFT
+      estimate already exceeds raises :class:`SLOInfeasible` at submit.
+
+    Grant order: the OLDEST queued batch request when its wait exceeds
+    ``max_batch_wait_s`` (bounded-wait anti-starvation — age-keyed, so a
+    deadline-less batch request cannot starve behind tighter-deadline
+    batch arrivals holding the EDF head), else the interactive EDF head,
+    else the batch EDF head.
     """
 
     def __init__(self, num_slots: int, context_len: int, max_total_len: int,
                  max_queue: Optional[int] = None, page_gate=None,
-                 reserve_extra: int = 0):
+                 reserve_extra: int = 0,
+                 max_batch_wait_s: Optional[float] = DEFAULT_MAX_BATCH_WAIT_S,
+                 shed_infeasible: bool = False):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if reserve_extra < 0:
             raise ValueError(f"reserve_extra must be >= 0, got {reserve_extra}")
+        if max_batch_wait_s is not None and max_batch_wait_s <= 0:
+            raise ValueError(
+                f"max_batch_wait_s must be > 0 (or None to disable the "
+                f"bounded-wait promotion), got {max_batch_wait_s}")
         self.num_slots = num_slots
         self.context_len = context_len
         self.max_total_len = max_total_len
@@ -82,17 +141,36 @@ class SlotScheduler:
         self.reserve_extra = reserve_extra
         self.max_queue = max_queue
         self.page_gate = page_gate
-        self._queue: deque = deque()
+        self.max_batch_wait_s = max_batch_wait_s
+        self.shed_infeasible = shed_infeasible
+        # per-class EDF queues: sorted lists of (deadline_abs, seq, request)
+        # — the unique seq both breaks deadline ties FCFS and keeps tuple
+        # comparison from ever reaching the (unorderable) Request
+        self._queues: Dict[str, List[Tuple[float, int, Request]]] = {
+            cls: [] for cls in PRIORITIES}
+        self._seq = 0
+        # rid -> (deadline_abs, seq): the EDF key survives preemption
+        # round-trips so a requeued victim keeps its place in time
+        self._keys: Dict[int, Tuple[float, int]] = {}
         self._slots: List[Optional[Request]] = [None] * num_slots
         self._slot_of: Dict[int, int] = {}
         self._by_id: Dict[int, Request] = {}
         self._cancel_requested: set = set()
+        # load estimators feeding deadline-feasibility shedding: EWMA queue
+        # wait per class (observed at every grant) and EWMA time-to-first-
+        # token (fed by the engine via note_first_token)
+        self._wait_ewma: Dict[str, Optional[float]] = {
+            cls: None for cls in PRIORITIES}
+        self._ttft_ewma: Optional[float] = None
 
     # -- introspection -----------------------------------------------------
 
     @property
     def queue_depth(self) -> int:
-        return len(self._queue)
+        return sum(len(q) for q in self._queues.values())
+
+    def queue_depth_of(self, priority: str) -> int:
+        return len(self._queues[priority])
 
     @property
     def active_count(self) -> int:
@@ -108,12 +186,85 @@ class SlotScheduler:
             (slot, self._slots[slot]) for slot in self._slot_of.values()
         )
 
-    def _grantable_now(self, extra: Optional[Request] = None) -> int:
-        """How many queued requests (FCFS order, plus ``extra`` at the tail)
+    def slot_of(self, request_id: int) -> Optional[int]:
+        """The slot currently holding ``request_id`` (None when queued or
+        terminal) — the engine's async collect uses it to detect a request
+        that was preempted AND re-admitted (possibly into a different
+        slot) while a decode was in flight."""
+        return self._slot_of.get(request_id)
+
+    def queue_wait_estimate(self, priority: str) -> Optional[float]:
+        """EWMA queue wait (seconds) recent grants of ``priority`` saw, or
+        None before the first grant — the feasibility estimate's first
+        half (the second is the TTFT EWMA)."""
+        return self._wait_ewma[priority]
+
+    def _grant_order(self, now: float, extra: Optional[Request] = None,
+                     limit: Optional[int] = None) -> List[Request]:
+        """The first ``limit`` queued requests in the order the next
+        ``admit`` calls would grant them (bounded-wait promotion included),
+        with ``extra`` — a request about to be submitted at ``now`` —
+        merged into its class position.  Pure simulation over shallow
+        queue copies: per-submit cost is O(queue + limit·scan) — the same
+        order as the historical deque copy ``_grantable_now`` always paid;
+        ``limit`` (free slots) bounds the simulated grants so a deep
+        backlog cannot make submission quadratic."""
+        sim: Dict[str, List[Tuple[float, int, Request]]] = {
+            cls: list(q) for cls, q in self._queues.items()}
+        if extra is not None:
+            bisect.insort(sim[extra.priority],
+                          self._edf_key(extra, now) + (extra,))
+        order: List[Request] = []
+        while limit is None or len(order) < limit:
+            nxt = self._next_grant(now, sim)
+            if nxt is None:
+                break
+            cls, idx = nxt
+            order.append(sim[cls].pop(idx)[2])
+        return order
+
+    def _next_grant(self, now: float,
+                    queues: Optional[dict] = None
+                    ) -> Optional[Tuple[str, int]]:
+        """``(class, queue index)`` of the next grant: the OLDEST queued
+        batch request when its wait exceeds the bound (anti-starvation
+        promotion — keyed on age, not EDF position, or a deadline-less
+        batch request could starve forever behind a steady stream of
+        tighter-deadline batch arrivals that keep claiming the head), else
+        the interactive EDF head, else the batch EDF head; None when
+        nothing is queued."""
+        queues = self._queues if queues is None else queues
+        batch_q = queues[PRIORITY_BATCH]
+        if batch_q and self.max_batch_wait_s is not None:
+            idx = min(range(len(batch_q)),
+                      key=lambda i: (batch_q[i][2].submit_time
+                                     if batch_q[i][2].submit_time is not None
+                                     else math.inf))
+            oldest = batch_q[idx][2]
+            if (oldest.submit_time is not None
+                    and now - oldest.submit_time > self.max_batch_wait_s):
+                return (PRIORITY_BATCH, idx)
+        if queues[PRIORITY_INTERACTIVE]:
+            return (PRIORITY_INTERACTIVE, 0)
+        if batch_q:
+            return (PRIORITY_BATCH, 0)
+        return None
+
+    def _pick_class(self, now: float,
+                    queues: Optional[dict] = None) -> Optional[str]:
+        """Which class the next grant serves (see :meth:`_next_grant`)."""
+        nxt = self._next_grant(now, queues)
+        return nxt[0] if nxt is not None else None
+
+    def _grantable_now(self, now: float,
+                       extra: Optional[Request] = None) -> int:
+        """How many queued requests (grant order, with ``extra`` merged in)
         the next ``admit`` could grant right now, bounded by free slots and
         — under a ``page_gate`` — by free KV pages (worst-case per-request
         need; prefix hits only make the real allocation smaller)."""
-        reqs = list(self._queue) + ([extra] if extra is not None else [])
+        # at most free_count requests can be granted, so the simulation
+        # never needs to walk deeper than that
+        reqs = self._grant_order(now, extra, limit=self.free_count)
         slots = self.free_count
         if self.page_gate is None:
             return min(len(reqs), slots)
@@ -124,17 +275,27 @@ class SlotScheduler:
                 break
             need = self.page_gate.pages_needed(req)
             if need > pages:
-                break  # FCFS: nobody jumps the blocked head
+                break  # the chosen head blocks; nobody jumps it
             pages -= need
             n += 1
         return n
 
+    def _edf_key(self, request: Request, now: float) -> Tuple[float, int]:
+        submit = request.submit_time if request.submit_time is not None else now
+        deadline = (submit + request.deadline_s
+                    if request.deadline_s is not None else math.inf)
+        return (deadline, self._seq)
+
     # -- lifecycle ---------------------------------------------------------
 
     def submit(self, request: Request, now: Optional[float] = None) -> None:
-        """Queue a request FCFS; raises :class:`AdmissionError` when it can
-        never fit the compiled envelope, :class:`BackpressureError` when the
-        bounded queue is full (retryable)."""
+        """Queue a request in its priority class (EDF within the class);
+        raises :class:`AdmissionError` when it can never fit the compiled
+        envelope, :class:`SLOInfeasible` when its deadline is already
+        infeasible under the current load estimate (``shed_infeasible``
+        mode), :class:`BackpressureError` when the bounded queue is full
+        (retryable)."""
+        now = time.monotonic() if now is None else now
         if request.request_id in self._by_id:
             raise ValueError(f"duplicate request id {request.request_id}")
         # envelope checks BEFORE the backlog check: a never-fits request must
@@ -163,19 +324,99 @@ class SlotScheduler:
                 raise AdmissionError(
                     f"request {request.request_id}: needs {need} KV pages "
                     f"> pool capacity {cap}; it can never be admitted")
+        if self.shed_infeasible and request.deadline_s is not None:
+            # a requeued clone may arrive with its ORIGINAL submit_time (the
+            # fleet's absolute-deadline discipline): feasibility judges the
+            # REMAINING budget, not the nominal one
+            submit = (request.submit_time
+                      if request.submit_time is not None else now)
+            remaining = request.deadline_s - max(now - submit, 0.0)
+            est = ((self._wait_ewma[request.priority] or 0.0)
+                   + (self._ttft_ewma or 0.0))
+            if remaining <= 0 or (est > 0 and remaining < est):
+                raise SLOInfeasible(
+                    f"request {request.request_id}: deadline budget "
+                    f"{remaining:.3f}s cannot cover the estimated "
+                    f"{est:.3f}s queue wait + first token at current "
+                    f"{request.priority} load; shed at admission")
         if self.max_queue is not None \
-                and len(self._queue) + 1 - self._grantable_now(request) \
+                and self.queue_depth + 1 - self._grantable_now(now, request) \
                 > self.max_queue:
             raise BackpressureError(
                 f"request {request.request_id}: admission backlog full "
-                f"({len(self._queue)} queued, {self.free_count} free slots"
+                f"({self.queue_depth} queued, {self.free_count} free slots"
                 + (f", {self.page_gate.pages_free()} free KV pages"
                    if self.page_gate is not None else "")
                 + f", max_queue {self.max_queue}); retry after the backlog "
                 "drains")
-        request.submit_time = time.monotonic() if now is None else now
+        if request.submit_time is None:
+            # an already-set submit_time is preserved: a fleet requeue clone
+            # carries the ORIGINAL submission instant so its deadline stays
+            # absolute through a crash instead of silently re-arming
+            request.submit_time = now
+        key = self._edf_key(request, now)
+        self._seq += 1
         self._by_id[request.request_id] = request
-        self._queue.append(request)
+        self._keys[request.request_id] = key
+        bisect.insort(self._queues[request.priority], key + (request,))
+
+    def requeue(self, request: Request) -> int:
+        """Slot preemption (the engine's half releases the device/page
+        state): pull an active PREFILL/DECODE request out of its slot, park
+        it back to QUEUED (partial generation discarded — see
+        :meth:`~.request.Request.reset_for_requeue`), and re-insert it at
+        its ORIGINAL EDF position (same deadline key and submission
+        sequence).  Returns the freed slot index."""
+        slot = self._slot_of.pop(request.request_id, None)
+        if slot is None:
+            raise ValueError(
+                f"request {request.request_id} holds no slot to preempt")
+        self._slots[slot] = None
+        request.reset_for_requeue()
+        key = self._keys[request.request_id]
+        bisect.insort(self._queues[request.priority], key + (request,))
+        return slot
+
+    def pick_preemption(self, now: Optional[float] = None
+                        ) -> Optional[Tuple[int, Request]]:
+        """The next preemption the engine should perform, or None: the
+        interactive EDF head is blocked (no free slot, or — under a page
+        gate — not enough free pages), no bounded-wait batch promotion is
+        pending, and an eligible batch-tier victim is active.  The victim
+        is the active batch request with the LATEST deadline (least urgent;
+        ties lose the fewest generated tokens); batch requests older than
+        ``max_batch_wait_s`` are immune — that immunity plus the promotion
+        is what makes batch-tier progress provable."""
+        now = time.monotonic() if now is None else now
+        int_q = self._queues[PRIORITY_INTERACTIVE]
+        if not int_q:
+            return None
+        if self._pick_class(now) is not PRIORITY_INTERACTIVE:
+            return None  # a promoted batch head owns the next grant
+        head = int_q[0][2]
+        blocked = self.free_count == 0
+        if not blocked and self.page_gate is not None:
+            blocked = (self.page_gate.pages_needed(head)
+                       > self.page_gate.pages_free())
+        if not blocked:
+            return None
+        victim: Optional[Tuple[int, Request]] = None
+        victim_key = None
+        for slot, req in self.active():
+            if req.priority != PRIORITY_BATCH:
+                continue
+            if (self.max_batch_wait_s is not None
+                    and req.submit_time is not None
+                    and now - req.submit_time > self.max_batch_wait_s):
+                continue  # over the wait bound: immune (anti-starvation)
+            deadline = (req.submit_time + req.deadline_s
+                        if req.deadline_s is not None
+                        and req.submit_time is not None else math.inf)
+            key = (-deadline, len(req.generated))
+            if victim_key is None or key < victim_key:
+                victim_key = key
+                victim = (slot, req)
+        return victim
 
     def cancel(self, request_id: int) -> bool:
         """Flag a request for cancellation (applied by the next ``sweep``);
@@ -188,33 +429,31 @@ class SlotScheduler:
 
     def sweep(self, now: Optional[float] = None) -> List[Request]:
         """Apply cancellations and deadline expiries — queued requests are
-        dropped from the queue, running ones have their slot freed.  Returns
-        the newly-terminal requests (caller emits their outputs)."""
+        dropped from their class queue, running ones have their slot freed.
+        Returns the newly-terminal requests (caller emits their outputs)."""
         now = time.monotonic() if now is None else now
         swept: List[Request] = []
-
-        def expired(req: Request) -> bool:
-            return (req.deadline_s is not None and req.submit_time is not None
-                    and now - req.submit_time > req.deadline_s)
-
-        for req in list(self._queue):
-            reason = None
-            if req.request_id in self._cancel_requested:
-                reason = RequestState.CANCELLED
-            elif expired(req):
-                reason = RequestState.TIMED_OUT
-            if reason is not None:
-                self._queue.remove(req)
-                self._by_id.pop(req.request_id, None)
-                req.transition(reason)
-                req.finish_reason = reason.value
-                req.finish_time = now
-                swept.append(req)
+        for queue in self._queues.values():
+            for entry in list(queue):
+                req = entry[2]
+                reason = None
+                if req.request_id in self._cancel_requested:
+                    reason = RequestState.CANCELLED
+                elif req.expired(now):
+                    reason = RequestState.TIMED_OUT
+                if reason is not None:
+                    queue.remove(entry)
+                    self._by_id.pop(req.request_id, None)
+                    self._keys.pop(req.request_id, None)
+                    req.transition(reason)
+                    req.finish_reason = reason.value
+                    req.finish_time = now
+                    swept.append(req)
         for slot, req in self.active():
             reason = None
             if req.request_id in self._cancel_requested:
                 reason = RequestState.CANCELLED
-            elif expired(req):
+            elif req.expired(now):
                 reason = RequestState.TIMED_OUT
             if reason is not None:
                 req.transition(reason)
@@ -226,10 +465,11 @@ class SlotScheduler:
         return swept
 
     def admit(self, now: Optional[float] = None) -> List[Tuple[int, Request]]:
-        """FCFS admission: grant free slots to queue heads (order
-        preserved — the head blocks nobody behind it only when a slot is
-        free for it too, which is always true under FCFS).  Transitions each
-        granted request to PREFILL; returns ``[(slot, request), ...]``."""
+        """Grant free slots in priority order — promoted batch head first
+        (bounded wait), then the interactive EDF queue, then batch EDF.
+        The chosen head blocks admission when its pages are short (no
+        size-based bypass).  Transitions each granted request to PREFILL;
+        returns ``[(slot, request), ...]``."""
         now = time.monotonic() if now is None else now
         grants: List[Tuple[int, Request]] = []
         # page budget tracked across the loop: the engine only ALLOCATES
@@ -237,18 +477,26 @@ class SlotScheduler:
         # need against this call's free-page snapshot
         budget = (self.page_gate.pages_free()
                   if self.page_gate is not None else None)
-        while self._queue and self.free_count > 0:
+        while self.free_count > 0:
+            nxt = self._next_grant(now)
+            if nxt is None:
+                break
+            cls, idx = nxt
+            req = self._queues[cls][idx][2]
             if budget is not None:
-                need = self.page_gate.pages_needed(self._queue[0])
+                need = self.page_gate.pages_needed(req)
                 if need > budget:
-                    break  # FCFS head waits for pages; nobody jumps it
+                    break  # the chosen head waits for pages; nobody jumps it
                 budget -= need
-            req = self._queue.popleft()
+            self._queues[cls].pop(idx)
             slot = next(i for i, r in enumerate(self._slots) if r is None)
             self._slots[slot] = req
             self._slot_of[req.request_id] = slot
             req.transition(RequestState.PREFILL)
             req.prefill_time = now
+            if req.submit_time is not None:
+                self._note_wait(req.priority,
+                                max(now - req.submit_time, 0.0))
             grants.append((slot, req))
         return grants
 
@@ -266,15 +514,31 @@ class SlotScheduler:
             raise ValueError(f"request {request.request_id} holds no slot")
         self._slots[slot] = None
         self._by_id.pop(request.request_id, None)
+        self._keys.pop(request.request_id, None)
         self._cancel_requested.discard(request.request_id)
         return slot
+
+    # -- load estimators ---------------------------------------------------
+
+    def _note_wait(self, priority: str, wait_s: float) -> None:
+        prev = self._wait_ewma[priority]
+        self._wait_ewma[priority] = (
+            wait_s if prev is None
+            else prev + _EWMA_ALPHA * (wait_s - prev))
+
+    def note_first_token(self, ttft_s: float) -> None:
+        """Engine hook: observed submit→first-token latency, feeding the
+        TTFT half of the deadline-feasibility estimate."""
+        prev = self._ttft_ewma
+        self._ttft_ewma = (ttft_s if prev is None
+                           else prev + _EWMA_ALPHA * (ttft_s - prev))
 
     # -- invariants --------------------------------------------------------
 
     def assert_invariants(self) -> None:
-        """No slot leak, no double occupancy, capacity respected, queue
-        holds only QUEUED requests.  O(B + queue) — cheap enough to run
-        every engine step in tests."""
+        """No slot leak, no double occupancy, capacity respected, class
+        queues hold only QUEUED requests in EDF order.  O(B + queue) —
+        cheap enough to run every engine step in tests."""
         occupied = [i for i, r in enumerate(self._slots) if r is not None]
         assert len(occupied) == len(self._slot_of), (
             f"slot leak: {len(occupied)} occupied slots vs "
@@ -288,10 +552,19 @@ class SlotScheduler:
                 f"slot {slot} holds terminal/queued request {rid} "
                 f"({req.state.value})")
         seen = set()
-        for req in self._queue:
-            assert req.state is RequestState.QUEUED, (
-                f"queued request {req.request_id} in state {req.state.value}")
-            assert req.request_id not in self._slot_of, (
-                f"request {req.request_id} both queued and slotted")
-            assert req.request_id not in seen
-            seen.add(req.request_id)
+        for cls, queue in self._queues.items():
+            assert queue == sorted(queue, key=lambda e: e[:2]), (
+                f"{cls} queue out of EDF order")
+            for deadline, seq, req in queue:
+                assert req.priority == cls, (
+                    f"request {req.request_id} ({req.priority}) queued "
+                    f"under class {cls}")
+                assert req.state is RequestState.QUEUED, (
+                    f"queued request {req.request_id} in state "
+                    f"{req.state.value}")
+                assert req.request_id not in self._slot_of, (
+                    f"request {req.request_id} both queued and slotted")
+                assert req.request_id not in seen
+                seen.add(req.request_id)
+        assert set(self._keys) == seen | set(self._slot_of), (
+            "EDF-key table out of sync with live requests")

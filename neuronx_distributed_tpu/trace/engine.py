@@ -943,6 +943,56 @@ class ParallelInferenceModel(_ServingBase):
         return fn(self.params, ids.astype(jnp.int32), valid, apool,
                   jnp.asarray(atable, jnp.int32))
 
+    def _prefill_chunk_pages_fn(self, params, ids, offsets, block_table,
+                                caches, valid):
+        """Prefill one ``[1, Cc]`` prompt chunk of a single slot straight
+        into the page pool — the paged, per-slot generalization of
+        :meth:`_prefill_chunk_fn` (Sarathi-style chunked prefill for the
+        serving engine): token ``s`` scatters into the slot's physical
+        page at logical index ``offsets[0] + s`` through the block table,
+        and attends over the gathered per-row view exactly like
+        :meth:`_decode_pages_fn`.
+
+        ``valid [1, T]`` is the slot's whole-cache key-validity row with
+        the FULL prompt's (left-padded) validity pre-written and zeros
+        beyond it; chunk token positions are global prefix counts of that
+        mask, so RoPE phases match the one-shot ``prefill_one`` exactly,
+        and keys beyond the chunk are causally masked (q offset = cache
+        offset) so the not-yet-written tail contributes nothing.  Returns
+        the chunk's last-position logits (the final chunk's are the
+        prefill logits the first token samples from) and the updated
+        pool."""
+        Cc = ids.shape[1]
+        T = valid.shape[1]
+        counts = jnp.cumsum(valid, axis=1) - valid  # valid keys strictly before
+        idx = offsets[:, None] + jnp.arange(Cc)[None, :]  # [1, Cc]
+        positions = jnp.take_along_axis(counts, jnp.clip(idx, 0, T - 1), axis=1)
+        logits, caches = self.module.apply(
+            params, ids, positions.astype(jnp.int32), caches, offsets,
+            kv_valid=valid, block_table=block_table,
+        )
+        return logits[:, -1, :], caches
+
+    def prefill_chunk_pages(self, ids, offset, block_table, caches, valid):
+        """Compiled paged chunk prefill (pool donated), lazily jitted per
+        chunk width ``Cc`` — one program serves every chunk of that width
+        at any offset of any slot.  ``ids [1, Cc]`` is the chunk's (padded)
+        prompt slice, ``offset`` the scalar cache index its first token
+        writes at, ``block_table [1, PP]`` the slot's logical→physical page
+        map, ``valid [1, T]`` the slot's full-prompt validity row."""
+        self._serving_lru()
+        key = ("prefill_chunk_pages", self._pool_tag(caches),
+               int(ids.shape[1]))
+        fn = self._serving_cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._prefill_chunk_pages_fn, donate_argnums=(4,),
+                         out_shardings=(None, self._pool_out_shardings(caches)))
+            self._serving_cache.put(key, fn)
+        return fn(self.params, ids.astype(jnp.int32),
+                  jnp.asarray([offset], jnp.int32),
+                  jnp.asarray(block_table, jnp.int32), caches,
+                  jnp.asarray(valid, jnp.int32))
+
     def _verify_pages_fn(self, params, toks, offsets, block_table, caches, valid):
         """Score a ``[B, S]`` chunk at PER-SLOT offsets against the page
         pool — the batched target-verification step of speculative decoding

@@ -119,14 +119,18 @@ def test_serving_stats_schema(tmp_path):
          "state": "finished", "finish_reason": "length", "prompt_len": 5,
          "new_tokens": 8, "queue_ms": 0.5, "ttft_ms": 12.0, "total_ms": 40.0,
          "spec_proposed": 12, "spec_accepted": 9, "acceptance_rate": 0.75,
-         "adapter_id": 0},
-        # a non-speculative, multi-tenant record: zeros + null rate, served
-        # under LoRA adapter 3
+         "adapter_id": 0, "priority": "interactive", "deadline_s": None,
+         "queue_wait_ms": 0.5, "preemptions": 0, "shed_reason": None},
+        # a non-speculative, multi-tenant, batch-tier record (v4 SLO
+        # fields): served under LoRA adapter 3, preempted once, shed at the
+        # pre-prefill expiry check
         {"schema": SERVING_STATS_SCHEMA, "time": 2.0, "request_id": 1,
          "state": "timed_out", "finish_reason": "timed_out", "prompt_len": 3,
          "new_tokens": 0, "queue_ms": 100.0, "ttft_ms": None,
          "total_ms": 100.0, "spec_proposed": 0, "spec_accepted": 0,
-         "acceptance_rate": None, "adapter_id": 3},
+         "acceptance_rate": None, "adapter_id": 3, "priority": "batch",
+         "deadline_s": 0.25, "queue_wait_ms": 100.0, "preemptions": 1,
+         "shed_reason": "expired_before_prefill"},
     ]
     path = tmp_path / "serving_stats.jsonl"
     with open(path, "w") as f:
@@ -138,6 +142,64 @@ def test_serving_stats_schema(tmp_path):
     with pytest.raises(ValueError, match="expected"):
         bad = dict(recs[0], new_tokens="8")
         validate_record("serving_stats", bad)
+    with pytest.raises(ValueError, match="missing required field"):
+        # a v3-shaped record (no SLO fields) no longer validates
+        v3 = dict(recs[0])
+        for f in ("priority", "deadline_s", "queue_wait_ms", "preemptions",
+                  "shed_reason"):
+            v3.pop(f)
+        validate_record("serving_stats", v3)
+
+    # the SLO counters/per-class histograms are declared with their kinds,
+    # and a live SLO-serving registry validates + grows the report line
+    from neuronx_distributed_tpu.obs.schemas import (
+        REGISTRY_METRICS,
+        validate_registry_metrics,
+    )
+
+    assert {"serving/preemptions_total", "serving/shed_total",
+            "serving/expired_before_prefill_total",
+            "serving/prefill_chunks_total",
+            "serving/ttft_ms_interactive",
+            "serving/intertoken_ms_batch"} <= set(REGISTRY_METRICS)
+    reg = MetricRegistry()
+    reg.counter("serving/preemptions_total").inc(2)
+    reg.counter("serving/shed_total").inc()
+    reg.counter("serving/expired_before_prefill_total").inc()
+    reg.counter("serving/prefill_chunks_total").inc(5)
+    from neuronx_distributed_tpu.obs import MS_BUCKETS
+    reg.histogram("serving/ttft_ms_interactive", MS_BUCKETS).observe(12.0)
+    reg.histogram("serving/intertoken_ms_interactive",
+                  MS_BUCKETS).observe(3.0)
+    validate_registry_metrics(reg)
+
+    from neuronx_distributed_tpu.obs.registry import read_histograms
+    from neuronx_distributed_tpu.obs.report import (
+        _summarize_scalars,
+        _summarize_slo,
+        render_markdown,
+    )
+
+    scalar_recs = reg.to_scalar_records(step=1)
+    hists = read_histograms(scalar_recs)
+    slo = _summarize_slo(_summarize_scalars(scalar_recs, frozenset(hists)),
+                         hists)
+    assert slo is not None
+    assert slo["preemptions"] == 2.0 and slo["shed"] == 1.0
+    assert slo["expired_before_prefill"] == 1.0
+    assert slo["prefill_chunks"] == 5.0
+    assert "interactive" in slo["classes"]
+    report_md = render_markdown({
+        "schema": "obs_report_v1", "health": {
+            "anomaly_count": 0, "host_blocked": {}, "slo": slo,
+            "total_collective_count": 0, "total_collective_bytes": 0,
+            "restarts": 0},
+        "scalars": {}, "histograms": {}, "flight": None, "anomalies": [],
+        "hlo_audits": [], "timeline": {"events": 0, "instants": 0,
+                                       "files": 0, "total_ms_by_name": {}},
+        "supervisor": None,
+    })
+    assert "slo:" in report_md and "preemption" in report_md
 
 
 def test_router_stats_schema_and_fleet_report_line(tmp_path):

@@ -305,10 +305,13 @@ def test_engine_cancellation_and_timeout(served_pool):
     for rid in range(3):
         engine.submit(Request(request_id=rid, prompt_ids=[1, 2, 3],
                               max_new_tokens=8))
-    engine.submit(Request(request_id=3, prompt_ids=[1, 2], max_new_tokens=8,
-                          deadline_s=0.5))  # queued behind the full pool
     outs = {o.request_id: o for o in engine.step()}
     assert engine.scheduler.active_count == 3
+    # submitted only once the pool is full: EDF would otherwise admit the
+    # deadline-carrying request AHEAD of the deadline-less ones (the SLO
+    # scheduler's intended reordering) instead of leaving it queued
+    engine.submit(Request(request_id=3, prompt_ids=[1, 2], max_new_tokens=8,
+                          deadline_s=0.5))  # queued behind the full pool
     engine.cancel(1)
     t[0] = 1.0  # past request 3's deadline
     for o in engine.step():

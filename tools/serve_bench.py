@@ -27,6 +27,15 @@ Three modes:
   (committed/rounds), acceptance rate, TTFT / inter-token p50/p99, goodput.
   rc 1 when a k >= 2 rung commits <= 1 token/step or its greedy outputs
   diverge from the baseline's.
+- `--slo`: stall-free SLO serving.  A bimodal trace — a Poisson stream of
+  short interactive prompts with full-context-width batch prompts landing
+  inside it — served three ways: interactive-only baseline, unchunked
+  FCFS control (the long prefills stall co-batched decodes), and the
+  chunked + priority engine (`prefill_chunk_tokens` + batch-tier long
+  prompts).  One JSON line per rung with per-tier inter-token/TTFT
+  percentiles, chunk and preemption counts.  rc 1 unless the SLO engine
+  holds interactive inter-token p99 within 2x the baseline WHILE the
+  control spikes past that bound.
 """
 
 from __future__ import annotations
@@ -513,6 +522,173 @@ def run_kv_quant(args, module, params, cfg, icfg) -> int:
     return 0
 
 
+def run_slo(args, module, params, cfg, icfg) -> int:
+    """Stall-free SLO rung: a bimodal short/long-prompt Poisson trace
+    (interactive short prompts decoding while full-width batch prompts
+    arrive) served three ways — the chunked + priority engine WITHOUT the
+    long prompts (baseline: latency absent adversarial load), unchunked
+    FCFS on the mixed trace (control: every whole prefill is a full-width
+    forward that stalls co-batched decodes), and the chunked + priority
+    engine on the mixed trace (slo).  One JSON line per rung.  rc 1 unless
+    the SLO engine holds the interactive inter-token p99 within 2x the
+    no-long-prompt baseline WHILE the unchunked control spikes past that
+    bound (the stall the subsystem exists to remove)."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.serving import (
+        Request, ServingEngine, poisson_arrivals)
+    from neuronx_distributed_tpu.trace import ParallelInferenceModel
+
+    B, C, T = args.batch_size, args.context_len, args.max_total_len
+    page = args.page_size
+    if C % page or T % page:
+        raise SystemExit(f"--page-size {page} must divide --context-len {C} "
+                         f"and --max-total-len {T}")
+    chunk = args.slo_chunk or max(page, (C // 8) // page * page)
+    if chunk % page:
+        raise SystemExit(f"--slo-chunk {chunk} must be a multiple of "
+                         f"--page-size {page}")
+    num_pages = B * (T // page) + 1
+    model = ParallelInferenceModel(module, params, icfg)
+
+    LONG_BASE = 1 << 16  # long-prompt ids live in their own range
+    rs = np.random.RandomState(args.seed)
+    n_i = args.num_requests
+    n_l = args.slo_long
+    # interactive prompts are genuinely SHORT (their own prefills must not
+    # stall each other, or the baseline inherits the very spike the rung
+    # measures); the batch tier is full compiled width
+    short_prompts = [
+        rs.randint(1, cfg.vocab_size,
+                   size=rs.randint(max(2, C // 32), max(3, C // 16))).tolist()
+        for _ in range(n_i)
+    ]
+    long_prompts = [rs.randint(1, cfg.vocab_size, size=C).tolist()
+                    for _ in range(n_l)]
+    arr_i = poisson_arrivals(n_i, args.arrival_rate, rs)
+    span = float(arr_i[-1]) if n_i > 1 else 1.0
+    # long prompts land inside the interactive window, so their prefills
+    # contend with live decodes — the stall under test
+    arr_l = np.linspace(0.0, max(span * 0.7, 1e-3), n_l)
+
+    def trace(with_long, batch_tier):
+        items = [(float(arr_i[i]),
+                  Request(request_id=i, prompt_ids=short_prompts[i],
+                          max_new_tokens=args.max_new_tokens,
+                          priority="interactive"))
+                 for i in range(n_i)]
+        if with_long:
+            items += [(float(arr_l[j]),
+                       Request(request_id=LONG_BASE + j,
+                               prompt_ids=long_prompts[j],
+                               max_new_tokens=args.max_new_tokens,
+                               priority="batch" if batch_tier
+                               else "interactive"))
+                      for j in range(n_l)]
+        items.sort(key=lambda it: it[0])
+        return [t for t, _ in items], [r for _, r in items]
+
+    def measure(mode):
+        """``baseline`` = chunked + priority engine, interactive-only trace
+        (what latency looks like without adversarial load); ``control`` =
+        unchunked FCFS on the mixed trace (whole full-width prefills stall
+        co-batched decodes); ``slo`` = chunked + priority on the mixed
+        trace."""
+        with_long = mode != "baseline"
+        kw = dict(page_size=page, num_pages=num_pages)
+        if mode != "control":
+            kw["prefill_chunk_tokens"] = chunk
+        # warm EVERY prefill shape the trace will hit: the long prompt,
+        # the whole path (full prefix hits ride it), and — in chunked
+        # modes — one prompt per possible chunk width (1..budget pages),
+        # so compile time never pollutes the measured percentiles
+        warm = ServingEngine(model, registry=MetricRegistry(), **kw)
+        warm_prompts = [long_prompts[0], short_prompts[0], [1, 2]]
+        if mode != "control":
+            warm_prompts += [
+                list(range(1, k * page + 1))
+                for k in range(1, chunk // page + 1)]
+        for i, p in enumerate(warm_prompts):
+            warm.submit(Request(request_id=-1 - i, prompt_ids=p,
+                                max_new_tokens=min(2, args.max_new_tokens)))
+        warm.run_until_complete(max_steps=2000)
+        warm.close()
+        del warm
+        engine = ServingEngine(model, registry=MetricRegistry(), **kw)
+        arrivals, requests = trace(with_long, batch_tier=mode == "slo")
+        outputs, wall, peak = _drive_workload(engine, arrivals, requests)
+        engine.close()
+        snap = engine.registry.snapshot()
+        inter_i = [ms for o in outputs.values() if o.request_id < LONG_BASE
+                   for ms in o.intertoken_ms]
+        inter_l = [ms for o in outputs.values() if o.request_id >= LONG_BASE
+                   for ms in o.intertoken_ms]
+        total_tokens = sum(len(o.token_ids) for o in outputs.values())
+        ttfts = [o.ttft_ms for o in outputs.values()
+                 if o.ttft_ms is not None and o.request_id < LONG_BASE]
+        return {
+            "metric": "serving_slo",
+            "mode": mode,
+            # baseline AND slo run chunked; only the control is whole-prefill
+            "chunk_tokens": chunk if mode != "control" else None,
+            "interactive": n_i,
+            "long_prompts": n_l if with_long else 0,
+            "finished": sum(1 for o in outputs.values()
+                            if o.state == "finished"),
+            "interactive_ttft_ms": _percentiles(ttfts),
+            "interactive_intertoken_ms": _percentiles(inter_i),
+            "batch_intertoken_ms": _percentiles(inter_l),
+            "prefill_chunks": snap.get("serving/prefill_chunks_total", 0.0),
+            "preemptions": snap.get("serving/preemptions_total", 0.0),
+            "goodput_tok_s": total_tokens / max(wall, 1e-9),
+            "wall_s": round(wall, 4),
+            "max_concurrent": peak,
+        }
+
+    base_cfg = {"config": {"batch": B, "context": C, "max_total": T,
+                           "max_new": args.max_new_tokens,
+                           "page_size": page}}
+    rec_base = measure("baseline")
+    print(json.dumps({**rec_base, **base_cfg}))
+    rec_ctrl = measure("control")
+    print(json.dumps({**rec_ctrl, **base_cfg}))
+    rec_slo = measure("slo")
+    print(json.dumps({**rec_slo, **base_cfg}))
+
+    rc = 0
+    p99_base = rec_base["interactive_intertoken_ms"].get("p99") or 0.0
+    p99_ctrl = rec_ctrl["interactive_intertoken_ms"].get("p99") or 0.0
+    p99_slo = rec_slo["interactive_intertoken_ms"].get("p99") or 0.0
+    bound = 2.0 * p99_base
+    if p99_base <= 0:
+        print("serve_bench: no baseline interactive inter-token samples",
+              file=sys.stderr)
+        rc = 1
+    else:
+        if p99_slo > bound:
+            print(f"serve_bench: SLO engine interactive inter-token p99 "
+                  f"{p99_slo:.2f}ms > 2x no-long-prompt baseline "
+                  f"{p99_base:.2f}ms", file=sys.stderr)
+            rc = 1
+        if p99_ctrl <= bound:
+            print(f"serve_bench: unchunked control p99 {p99_ctrl:.2f}ms did "
+                  f"not spike past 2x baseline {p99_base:.2f}ms — the "
+                  "workload exhibits no stall to remove", file=sys.stderr)
+            rc = 1
+    n_total = n_i + n_l
+    for rec in (rec_ctrl, rec_slo):
+        if rec["finished"] != n_total:
+            print(f"serve_bench: {rec['mode']} finished {rec['finished']} "
+                  f"of {n_total} requests", file=sys.stderr)
+            rc = 1
+    if rec_slo["prefill_chunks"] <= 0:
+        print("serve_bench: SLO engine dispatched no prefill chunks",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def run_spec(args, module, params, cfg, icfg) -> int:
     """Speculative draft-k-verify vs the plain paged engine over one Poisson
     workload, draft == target; prints one JSON line per rung."""
@@ -641,6 +817,20 @@ def main() -> int:
                         "context/total lengths)")
     p.add_argument("--paged-slots", type=int, default=None,
                    help="paged engine slot count (default: 2x --batch-size)")
+    p.add_argument("--slo", action="store_true",
+                   help="stall-free SLO mode: bimodal short/long-prompt "
+                        "Poisson trace through the chunked + priority "
+                        "engine vs an unchunked FCFS control and an "
+                        "interactive-only baseline (one JSON line each; "
+                        "rc 1 unless the SLO engine holds interactive "
+                        "inter-token p99 within 2x baseline while the "
+                        "control spikes)")
+    p.add_argument("--slo-long", type=int, default=4,
+                   help="full-context-width batch-tier prompts the --slo "
+                        "trace mixes into the interactive stream")
+    p.add_argument("--slo-chunk", type=int, default=None,
+                   help="prefill chunk budget in tokens for the --slo rung "
+                        "(default: ~context/8, page-aligned)")
     p.add_argument("--spec", action="store_true",
                    help="speculative-decoding mode: draft-k-verify over the "
                         "paged engine vs the plain paged baseline, "
@@ -724,12 +914,20 @@ def main() -> int:
         args.batch_size = 2
         print("serve_bench: --kv-quant with --batch-size 1 is a degenerate "
               "concurrency comparison; using batch size 2", file=sys.stderr)
+    if args.slo and args.batch_size < 3:
+        # the stall under test needs interactive decodes CO-BATCHED with a
+        # long prompt's prefill
+        args.batch_size = 3
+        print("serve_bench: --slo needs co-batched interactive + long "
+              "requests; using batch size 3", file=sys.stderr)
 
     if args.tiny:
         cfg = LlamaConfig.tiny(max_seq_len=args.max_total_len,
                                sequence_parallel=False, remat="none")
         args.max_new_tokens = min(args.max_new_tokens, 8)
-        args.num_requests = min(args.num_requests, 8)
+        # the --slo rung gates on an interactive p99 — it needs more
+        # samples than the other tiny modes to keep the percentile stable
+        args.num_requests = min(args.num_requests, 16 if args.slo else 8)
     else:
         # the bench.py 438M model (7B hidden layout / 4)
         cfg = LlamaConfig(
@@ -759,6 +957,8 @@ def main() -> int:
     )
     if args.paged:
         return run_paged(args, module, params, cfg, icfg)
+    if args.slo:
+        return run_slo(args, module, params, cfg, icfg)
     if args.spec:
         return run_spec(args, module, params, cfg, icfg)
     if args.lora:

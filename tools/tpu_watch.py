@@ -127,6 +127,13 @@ def run_extra_jobs(results_path: str) -> None:
         ("serving_spec", [sys.executable,
                           os.path.join(REPO, "tools", "serve_bench.py"),
                           "--spec"]),
+        # stall-free SLO serving: bimodal short/long-prompt trace — rc 1
+        # unless chunked + priority holds interactive inter-token p99
+        # within 2x the no-long-prompt baseline while the unchunked
+        # control spikes
+        ("serving_slo", [sys.executable,
+                         os.path.join(REPO, "tools", "serve_bench.py"),
+                         "--slo"]),
         # multi-replica fleet rungs (serving/fleet/ subsystem): N-replica
         # goodput scaling, affinity-vs-random aggregate prefix-hit rate
         # (rc 1 when affinity does not beat random), zero-loss failover
