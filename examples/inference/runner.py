@@ -215,6 +215,9 @@ def cmd_serve(args):
                               "token": int(tok)}), flush=True)
 
     paged_kw = {}
+    if args.paged_kernel != "auto" and not args.page_size:
+        raise SystemExit("--paged-kernel on|off needs --page-size: the "
+                         "kernel walks block tables")
     if args.page_size:
         # paged KV: pool HBM is num_pages * page_bytes instead of B * T.
         # Default pool = the contiguous engine's footprint in pages PLUS the
@@ -224,7 +227,9 @@ def cmd_serve(args):
         # admission backpressure.
         num_pages = args.num_pages or (
             args.batch_size * (args.max_total_len // args.page_size) + 1)
-        paged_kw = dict(page_size=args.page_size, num_pages=num_pages)
+        paged_kw = dict(page_size=args.page_size, num_pages=num_pages,
+                        paged_kernel={"auto": "auto", "on": True,
+                                      "off": False}[args.paged_kernel])
     if args.kv_dtype == "int8":
         # int8 KV pages: same page count by default, half the HBM — or
         # shrink --num-pages less aggressively for ~2x the in-flight
@@ -489,6 +494,11 @@ def main():
                     help="KV page dtype: int8 stores pages quantized with "
                          "per-page scale/zero (~2x pages per HBM byte at a "
                          "bounded logit drift); needs --page-size")
+    sp.add_argument("--paged-kernel", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="block-table-native decode kernel "
+                         "(ops.paged_attention): auto = kernel on TPU at "
+                         "tp 1, gather path elsewhere; needs --page-size")
     sp.add_argument("--draft", default=None,
                     help="enable speculative serving with this draft-model "
                          "preset (same family/seed as the target, so a "

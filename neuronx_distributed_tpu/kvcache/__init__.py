@@ -28,7 +28,11 @@ from neuronx_distributed_tpu.kvcache.allocator import (
     BlockAllocator,
     PoolExhausted,
 )
-from neuronx_distributed_tpu.kvcache.pool import PagePool, init_page_pool_caches
+from neuronx_distributed_tpu.kvcache.pool import (
+    GATHER_BYTES_TOTAL,
+    PagePool,
+    init_page_pool_caches,
+)
 from neuronx_distributed_tpu.kvcache.prefix import (
     PAD,
     PrefixIndex,
@@ -38,6 +42,7 @@ from neuronx_distributed_tpu.kvcache.prefix import (
 
 __all__ = [
     "BlockAllocator",
+    "GATHER_BYTES_TOTAL",
     "NULL_PAGE",
     "PAD",
     "PagePool",

@@ -37,6 +37,13 @@ from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
+# registry counter: bytes the paged GATHER decode path spends
+# rematerializing per-slot [B, T] contiguous K/V clones from the pool —
+# what the block-table-native kernel (ops.paged_attention) saves.  Stays
+# ZERO on the kernel path (the int8 acceptance gate: quantized serving
+# with the kernel never materializes a dequantized history).
+GATHER_BYTES_TOTAL = "kvcache/gather_bytes_total"
+
 
 def init_page_pool_caches(
     num_layers: int,

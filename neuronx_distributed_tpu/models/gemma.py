@@ -134,14 +134,17 @@ class GemmaForCausalLM(nn.Module):
             param_dtype=cfg.param_dtype,
         )
         # nn.remat forward cost is zero without a grad, so one wrapped class
-        # serves both the train and cached-decode paths
-        block_cls = maybe_remat(LlamaBlock, cfg.remat)
+        # serves both the train and cached-decode paths; paged_kernel (arg 9,
+        # module = arg 0) is a python-static branch flag — remat must not
+        # abstract it into a tracer
+        block_cls = maybe_remat(LlamaBlock, cfg.remat, static_argnums=(9,))
         self.layer = [block_cls(bcfg) for _ in range(cfg.num_layers)]
         self.final_norm = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                                   param_dtype=cfg.param_dtype)
 
     def _backbone(self, ids, positions, kv_caches, cache_offset, kv_valid,
-                  segment_ids, block_table=None, adapters=None):
+                  segment_ids, block_table=None, adapters=None,
+                  paged_kernel=False):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
@@ -158,7 +161,8 @@ class GemmaForCausalLM(nn.Module):
             h, c = block(h, positions, cache,
                          cache_offset if kv_caches is not None else 0,
                          kv_valid, segment_ids, block_table,
-                         adapters[i] if adapters is not None else None)
+                         adapters[i] if adapters is not None else None,
+                         paged_kernel)
             new_caches.append(c)
         h = self.final_norm(h)
         if cfg.sequence_parallel and kv_caches is None:
@@ -168,10 +172,10 @@ class GemmaForCausalLM(nn.Module):
 
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
                  kv_valid=None, segment_ids=None, block_table=None,
-                 adapters=None):
+                 adapters=None, paged_kernel=False):
         h, new_caches = self._backbone(
             ids, positions, kv_caches, cache_offset, kv_valid, segment_ids,
-            block_table, adapters)
+            block_table, adapters, paged_kernel)
         logits = self.embed.attend(h)
         return (logits, new_caches) if kv_caches is not None else logits
 
@@ -294,7 +298,7 @@ class Gemma2Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, cache_offset=0,
                  kv_valid=None, segment_ids=None, block_table=None,
-                 adapter=None):
+                 adapter=None, paged_kernel=False):
         cfg = self.config
 
         def norm(name):
@@ -303,7 +307,7 @@ class Gemma2Block(nn.Module):
 
         h, new_cache = LlamaAttention(cfg, name="attn")(
             norm("input_norm")(x), positions, kv_cache, cache_offset,
-            kv_valid, segment_ids, block_table, adapter)
+            kv_valid, segment_ids, block_table, adapter, paged_kernel)
         x = x + norm("post_attn_norm")(h)
         h = LlamaMLP(cfg, name="mlp")(norm("pre_ffw_norm")(x))
         x = x + norm("post_ffw_norm")(h)
@@ -330,16 +334,19 @@ class Gemma2ForCausalLM(nn.Module):
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
         )
-        # HF layer_types alternation: even layers sliding, odd global
+        # HF layer_types alternation: even layers sliding, odd global;
+        # paged_kernel (arg 9) stays python-static through remat
         self.layer = [
-            maybe_remat(Gemma2Block, cfg.remat)(cfg.block_config(i % 2 == 0))
+            maybe_remat(Gemma2Block, cfg.remat,
+                        static_argnums=(9,))(cfg.block_config(i % 2 == 0))
             for i in range(cfg.num_layers)
         ]
         self.final_norm = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                                   param_dtype=cfg.param_dtype)
 
     def _backbone(self, ids, positions, kv_caches, cache_offset, kv_valid,
-                  segment_ids, block_table=None, adapters=None):
+                  segment_ids, block_table=None, adapters=None,
+                  paged_kernel=False):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
@@ -354,7 +361,8 @@ class Gemma2ForCausalLM(nn.Module):
             h, c = block(h, positions, cache,
                          cache_offset if kv_caches is not None else 0,
                          kv_valid, segment_ids, block_table,
-                         adapters[i] if adapters is not None else None)
+                         adapters[i] if adapters is not None else None,
+                         paged_kernel)
             new_caches.append(c)
         h = self.final_norm(h)
         if cfg.sequence_parallel and kv_caches is None:
@@ -371,10 +379,10 @@ class Gemma2ForCausalLM(nn.Module):
 
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
                  kv_valid=None, segment_ids=None, block_table=None,
-                 adapters=None):
+                 adapters=None, paged_kernel=False):
         h, new_caches = self._backbone(
             ids, positions, kv_caches, cache_offset, kv_valid, segment_ids,
-            block_table, adapters)
+            block_table, adapters, paged_kernel)
         logits = self._logits(h)
         return (logits, new_caches) if kv_caches is not None else logits
 

@@ -382,12 +382,40 @@ class CoreAttention(nn.Module):
         return out.reshape(B, S, NQ, D)
 
 
+def _paged_gather_views(kv_cache, block_table, compute_dtype):
+    """The gather decode path's ``[B, T, NKV, D]`` K/V views from the
+    COMMITTED (post-scatter) page pool — kept in a helper so the O(T)
+    contiguous clones are built only where they are consumed (the attention
+    core call) and never pinned live alongside the returned pool tuple.
+    A quantized pool dequantizes in the gather (page params gather
+    alongside the int8 pages), which is exactly the full-history dequant
+    the block-table-native kernel path exists to avoid."""
+    quantized = len(kv_cache) == 6
+    B, T = block_table.shape[0], block_table.shape[1] * kv_cache[0].shape[1]
+    if quantized:
+        from neuronx_distributed_tpu.kvcache.quant import dequantize_page
+
+        ck, cv, ks, kz, vs, vz = kv_cache
+        k = dequantize_page(
+            ck[block_table], ks[block_table], kz[block_table],
+            dtype=compute_dtype).reshape(B, T, ck.shape[2], ck.shape[3])
+        v = dequantize_page(
+            cv[block_table], vs[block_table], vz[block_table],
+            dtype=compute_dtype).reshape(B, T, cv.shape[2], cv.shape[3])
+    else:
+        ck, cv = kv_cache
+        k = ck[block_table].reshape(B, T, ck.shape[2], ck.shape[3])
+        v = cv[block_table].reshape(B, T, cv.shape[2], cv.shape[3])
+    return k, v
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None,
-                 segment_ids=None, block_table=None, adapter=None):
+                 segment_ids=None, block_table=None, adapter=None,
+                 paged_kernel=False):
         cfg = self.config
         D = cfg.head_dim_
         q, k, v = GQAQKVColumnParallelLinear(
@@ -530,41 +558,46 @@ class LlamaAttention(nn.Module):
                 ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_offset, axis=1)
                 cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_offset, axis=1)
             new_cache = (ck, cv, ks, kz, vs, vz) if quantized else (ck, cv)
-            if block_table is not None:
-                # attend over the gathered per-row view, not the raw pool
-                B_, T = x.shape[0], block_table.shape[1] * ck.shape[1]
-                if quantized:
-                    # dequantize-in-the-gather: page params gather alongside
-                    # the int8 pages, and the result is the SAME [B, T] fp
-                    # view the band-mask core attends over — attention math
-                    # untouched, drift bounded by the per-page quant step
-                    from neuronx_distributed_tpu.kvcache.quant import (
-                        dequantize_page,
-                    )
-
-                    k = dequantize_page(
-                        ck[block_table], ks[block_table], kz[block_table],
-                        dtype=q.dtype).reshape(
-                            B_, T, ck.shape[2], ck.shape[3])
-                    v = dequantize_page(
-                        cv[block_table], vs[block_table], vz[block_table],
-                        dtype=q.dtype).reshape(
-                            B_, T, cv.shape[2], cv.shape[3])
-                else:
-                    k = ck[block_table].reshape(B_, T, ck.shape[2], ck.shape[3])
-                    v = cv[block_table].reshape(B_, T, cv.shape[2], cv.shape[3])
-            else:
+            if block_table is not None and not paged_kernel:
+                # gather path: attend over the per-row contiguous view of
+                # the COMMITTED pool (the clones are built inside the
+                # helper, layer-local, so XLA frees them with the core)
+                k, v = _paged_gather_views(new_cache, block_table, q.dtype)
+            elif block_table is None:
                 k, v = ck, cv
 
-        # rematerialization is applied at block granularity in LlamaModel;
-        # cached decode keeps the dense core (it needs the cache-offset mask)
-        out = CoreAttention(cfg, name="core")(
-            q, k, v,
-            cache_offset if kv_cache is not None else 0,
-            allow_flash=kv_cache is None and kv_valid is None,
-            kv_valid=kv_valid,
-            segment_ids=segment_ids,
-        )
+        if kv_cache is not None and block_table is not None and paged_kernel:
+            # block-table-native decode (ops.paged_attention): attend
+            # straight over the page pool in device memory — no [B, T]
+            # rematerialized clone, int8 pages dequantized in-kernel.
+            # Serving key validity is a contiguous band (left pads, then
+            # the written prefix), so the kernel takes its first valid
+            # index; the causal bound comes from the per-slot offsets, and
+            # parked slots (offset >= T) emit zeros whose logits the
+            # engine ignores.
+            from neuronx_distributed_tpu.ops.paged_attention import (
+                paged_attention,
+            )
+
+            kv_start = (None if kv_valid is None
+                        else jnp.argmax(jnp.asarray(kv_valid) > 0,
+                                        axis=1).astype(jnp.int32))
+            out = paged_attention(
+                q, new_cache, block_table, cache_offset, kv_start,
+                sm_scale=cfg.attn_scale, window=cfg.sliding_window,
+                softcap=cfg.attn_softcap,
+            )
+        else:
+            # rematerialization is applied at block granularity in
+            # LlamaModel; cached decode keeps the dense core (it needs the
+            # cache-offset mask)
+            out = CoreAttention(cfg, name="core")(
+                q, k, v,
+                cache_offset if kv_cache is not None else 0,
+                allow_flash=kv_cache is None and kv_valid is None,
+                kv_valid=kv_valid,
+                segment_ids=segment_ids,
+            )
 
         B, S = x.shape[0], q.shape[1]
         out = out.reshape(B, S, cfg.num_heads * D)
@@ -623,13 +656,14 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, cache_offset=0, kv_valid=None,
-                 segment_ids=None, block_table=None, adapter=None):
+                 segment_ids=None, block_table=None, adapter=None,
+                 paged_kernel=False):
         cfg = self.config
         h, new_cache = LlamaAttention(cfg, name="attn")(
             RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                     name="input_norm")(x),
             positions, kv_cache, cache_offset, kv_valid, segment_ids,
-            block_table, adapter,
+            block_table, adapter, paged_kernel,
         )
         x = x + h
         normed = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -669,7 +703,7 @@ class LlamaModel(nn.Module):
     @nn.compact
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
                  kv_valid=None, segment_ids=None, block_table=None,
-                 adapters=None):
+                 adapters=None, paged_kernel=False):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
@@ -714,7 +748,8 @@ class LlamaModel(nn.Module):
                     h, c = LlamaBlock(cfg, name=f"layer_{i}")(
                         h, positions, cache, cache_offset, kv_valid, segment_ids,
                         block_table,
-                        adapters[i] if adapters is not None else None)
+                        adapters[i] if adapters is not None else None,
+                        paged_kernel)
                 else:
                     h, c = block_cls(cfg, name=f"layer_{i}")(
                         h, positions, None, 0, kv_valid, segment_ids)
@@ -757,10 +792,10 @@ class LlamaForCausalLM(nn.Module):
 
     def __call__(self, ids, positions=None, kv_caches=None, cache_offset=0,
                  kv_valid=None, segment_ids=None, block_table=None,
-                 adapters=None):
+                 adapters=None, paged_kernel=False):
         h, new_caches = self.model(
             ids, positions, kv_caches, cache_offset, kv_valid, segment_ids,
-            block_table, adapters)
+            block_table, adapters, paged_kernel)
         if self.config.sequence_parallel and kv_caches is None:
             # gather the sequence back before the (batched) head matmul
             h = shard_activation(h, trailing_spec(h.ndim, seq=None, last=None))

@@ -185,6 +185,9 @@ def _summarize_kvcache(scalars: Dict[str, dict]) -> Optional[dict]:
         "prefills_skipped": last("kvcache/prefill_skipped_total"),
         "evictions": last("kvcache/evictions_total"),
         "cow_copies": last("kvcache/cow_copies_total"),
+        # bytes the gather decode path spent on [B, T] rematerialization;
+        # 0 means the block-table-native kernel served every decode step
+        "gather_bytes": last("kvcache/gather_bytes_total"),
     }
 
 
@@ -490,13 +493,16 @@ def render_markdown(report: dict) -> str:
         hit = (f"{kv['prefix_hit_rate']:.1%} prefix hit rate "
                f"({kv['prefix_hits']:.0f}/{kv['prefix_hits'] + kv['prefix_misses']:.0f} pages)"
                if kv["prefix_hit_rate"] is not None else "no prefix lookups")
+        gather = (f"{kv.get('gather_bytes', 0.0):,.0f} gather-path bytes"
+                  if kv.get("gather_bytes") else
+                  "0 gather-path bytes (kernel decode)")
         lines.append(
             f"- kv cache: {kv['pages_in_use']:.0f}/{kv['pages_total']:.0f} "
             f"pages in use ({kv['occupancy']:.1%}, "
             f"{kv['pages_cached']:.0f} held by the prefix cache); {hit}; "
             f"{kv['prefills_skipped']:.0f} prefills skipped, "
             f"{kv['evictions']:.0f} evictions, "
-            f"{kv['cow_copies']:.0f} cow copies")
+            f"{kv['cow_copies']:.0f} cow copies; {gather}")
     fleet = h.get("fleet")
     if fleet:
         aff = (f"{fleet['affinity_hit_rate']:.1%} affinity hits "

@@ -128,6 +128,10 @@ REGISTRY_METRICS: Dict[str, str] = {
     "kvcache/prefill_skipped_total": "counter",
     "kvcache/cow_copies_total": "counter",
     "kvcache/evictions_total": "counter",
+    # paged GATHER-path decode accounting: bytes spent rematerializing the
+    # contiguous [B, T] K/V views from the page pool — stays ZERO when the
+    # block-table-native kernel (ops.paged_attention) serves decode
+    "kvcache/gather_bytes_total": "counter",
     # int8 KV pages (kvcache.quant): pages written through a
     # quantize-on-write path (prefill page writes + decode requant writes)
     "kvcache/quant_pages_total": "counter",

@@ -8,6 +8,10 @@ from neuronx_distributed_tpu.ops.flash_attention import (
     flash_attention_with_lse,
     mha_reference,
 )
+from neuronx_distributed_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+)
 from neuronx_distributed_tpu.ops.ring_attention import (
     ring_attention,
     ulysses_attention,
@@ -20,6 +24,8 @@ __all__ = [
     "flash_attention_segmented",
     "flash_attention_with_lse",
     "mha_reference",
+    "paged_attention",
+    "paged_attention_reference",
     "ring_attention",
     "ulysses_attention",
     "zigzag_permute",

@@ -581,6 +581,7 @@ class ParallelInferenceModel(_ServingBase):
         num_layers: Optional[int] = None,
         num_kv_heads: Optional[int] = None,
         head_dim: Optional[int] = None,
+        paged_kernel: Any = "auto",
     ):
         mcfg = getattr(module, "config", None)
         self.module = module
@@ -589,6 +590,18 @@ class ParallelInferenceModel(_ServingBase):
         self.num_layers = num_layers if num_layers is not None else mcfg.num_layers
         self.num_kv_heads = num_kv_heads if num_kv_heads is not None else mcfg.num_kv_heads
         self.head_dim = head_dim if head_dim is not None else mcfg.head_dim_
+        # block-table-native paged decode (ops.paged_attention): "auto"
+        # resolves to the kernel on TPU at tp == 1 and the [B, T] gather
+        # path elsewhere; the per-call `paged_kernel=` kwarg on
+        # decode_pages / decode_pages_lora / verify_pages overrides this
+        # default (each value compiles its own cached program)
+        from neuronx_distributed_tpu.ops.paged_attention import (
+            resolve_paged_kernel,
+        )
+
+        tp = (get_mesh().shape[TENSOR_AXIS]
+              if model_parallel_is_initialized() else 1)
+        self.paged_kernel = resolve_paged_kernel(paged_kernel, tp)
         self._build()
 
     # -- phase functions (pure; also used by the export path) --------------
@@ -800,37 +813,49 @@ class ParallelInferenceModel(_ServingBase):
             caches)
 
     def _decode_pages_fn(self, params, tok, offsets, block_table, caches,
-                         valid, adapters=None):
+                         valid, adapters=None, paged_kernel=False):
         """The paged twin of :meth:`_decode_slots_fn`: same per-slot offsets,
         validity update, and mask-derived positions, but the KV state is the
         page pool + block tables (the model scatters the new token into its
-        physical page and attends over the gathered per-row view).  An
-        offset of ``T`` parks an idle slot.  ``adapters`` (the tenancy path)
-        rides as an extra apply kwarg, so the offset/validity/position math
-        — the token-identity contract — exists exactly once."""
+        physical page and attends over the gathered per-row view — or, with
+        ``paged_kernel``, straight over the pool via the block-table-native
+        ``ops.paged_attention`` kernel, no per-row clone).  An offset of
+        ``T`` parks an idle slot.  ``adapters`` (the tenancy path) rides as
+        an extra apply kwarg, so the offset/validity/position math — the
+        token-identity contract — exists exactly once."""
         T = valid.shape[1]
         hot = jnp.arange(T)[None, :] == offsets[:, None]  # [B, T]
         valid = jnp.where(hot, 1, valid)  # the new token becomes a key
         before = jnp.where(jnp.arange(T)[None, :] < offsets[:, None], valid, 0)
         positions = jnp.sum(before, axis=1, keepdims=True).astype(jnp.int32)
         extra = {} if adapters is None else {"adapters": adapters}
+        if paged_kernel:
+            extra["paged_kernel"] = True
         logits, caches = self.module.apply(
             params, tok, positions, caches, offsets, kv_valid=valid,
             block_table=block_table, **extra,
         )
         return logits[:, -1, :], caches, valid
 
-    def decode_pages(self, tok, offsets, block_table, caches, valid):
+    def decode_pages(self, tok, offsets, block_table, caches, valid,
+                     paged_kernel=None):
         """Compiled paged per-slot decode step (page pool donated).
         ``block_table`` is the ``[B, max_total_len // page_size]`` int32
         logical→physical page map; ``caches`` the pool pytree (fp pairs or
-        the int8 six-tuples — each layout compiles its own program)."""
+        the int8 six-tuples — each layout compiles its own program).
+        ``paged_kernel`` (default: the model's resolved flag) selects the
+        block-table-native kernel over the gather path; each value is its
+        own cached program."""
+        import functools as _ft
+
         self._serving_lru()
-        key = ("decode_pages", self._pool_tag(caches))
+        pk = self.paged_kernel if paged_kernel is None else bool(paged_kernel)
+        key = ("decode_pages", self._pool_tag(caches), pk)
         fn = self._serving_cache.get(key)
         if fn is None:
             fn = jax.jit(
-                self._decode_pages_fn, donate_argnums=(4,),
+                _ft.partial(self._decode_pages_fn, paged_kernel=pk),
+                donate_argnums=(4,),
                 out_shardings=(None, self._pool_out_shardings(caches),
                                self._io_shardings["batch"](None)))
             self._serving_cache.put(key, fn)
@@ -891,7 +916,8 @@ class ParallelInferenceModel(_ServingBase):
         return out
 
     def _decode_pages_lora_fn(self, params, tok, offsets, block_table,
-                              caches, valid, apool, atables):
+                              caches, valid, apool, atables,
+                              paged_kernel=False):
         """The multi-adapter twin of :meth:`_decode_pages_fn` — the SAME
         phase fn (one copy of the offsets/validity/position math), plus
         per-slot LoRA deltas gathered from the adapter pool as one
@@ -899,20 +925,27 @@ class ParallelInferenceModel(_ServingBase):
         heterogeneous-adapter decode)."""
         return self._decode_pages_fn(
             params, tok, offsets, block_table, caches, valid,
-            adapters=self._gather_adapters(apool, atables))
+            adapters=self._gather_adapters(apool, atables),
+            paged_kernel=paged_kernel)
 
     def decode_pages_lora(self, tok, offsets, block_table, caches, valid,
-                          apool, atables):
+                          apool, atables, paged_kernel=None):
         """Compiled multi-adapter paged decode step (page pool donated).
         ``apool`` is the device adapter pool, ``atables`` the per-slot
         ``[B, adapter_pages]`` int32 page map (all-NULL rows = adapter 0 =
-        exact no-op)."""
+        exact no-op).  ``paged_kernel`` as on :meth:`decode_pages` — the
+        LoRA deltas land on q/v BEFORE the scatter/attend, so both paths
+        see identical adapted projections."""
+        import functools as _ft
+
         self._serving_lru()
-        key = ("decode_pages_lora", self._pool_tag(caches))
+        pk = self.paged_kernel if paged_kernel is None else bool(paged_kernel)
+        key = ("decode_pages_lora", self._pool_tag(caches), pk)
         fn = self._serving_cache.get(key)
         if fn is None:
             fn = jax.jit(
-                self._decode_pages_lora_fn, donate_argnums=(4,),
+                _ft.partial(self._decode_pages_lora_fn, paged_kernel=pk),
+                donate_argnums=(4,),
                 out_shardings=(None, self._pool_out_shardings(caches),
                                self._io_shardings["batch"](None)))
             self._serving_cache.put(key, fn)
@@ -993,7 +1026,8 @@ class ParallelInferenceModel(_ServingBase):
                   jnp.asarray(block_table, jnp.int32), caches,
                   jnp.asarray(valid, jnp.int32))
 
-    def _verify_pages_fn(self, params, toks, offsets, block_table, caches, valid):
+    def _verify_pages_fn(self, params, toks, offsets, block_table, caches,
+                         valid, paged_kernel=False):
         """Score a ``[B, S]`` chunk at PER-SLOT offsets against the page
         pool — the batched target-verification step of speculative decoding
         (the per-slot generalization of :meth:`_score_chunk_fn`): token
@@ -1010,23 +1044,31 @@ class ParallelInferenceModel(_ServingBase):
         valid = jnp.where(hot, 1, valid)  # the chunk's tokens become keys
         counts = jnp.cumsum(valid, axis=1) - valid  # valid keys strictly before
         positions = jnp.take_along_axis(counts, jnp.clip(idx, 0, T - 1), axis=1)
+        extra = {"paged_kernel": True} if paged_kernel else {}
         logits, caches = self.module.apply(
             params, toks, positions.astype(jnp.int32), caches, offsets,
-            kv_valid=valid, block_table=block_table,
+            kv_valid=valid, block_table=block_table, **extra,
         )
         return logits, caches, valid
 
-    def verify_pages(self, toks, offsets, block_table, caches, valid):
+    def verify_pages(self, toks, offsets, block_table, caches, valid,
+                     paged_kernel=None):
         """Compiled batched speculative-verification step (page pool
         donated), lazily jitted per chunk width ``S = k + 1`` so one program
         serves every round at a given draft depth.  Outputs pinned to the
-        AOT executables' shardings like :meth:`decode_pages`."""
+        AOT executables' shardings like :meth:`decode_pages`.
+        ``paged_kernel`` as there — the verification chunk is the same
+        block-table-native kernel with ``S = k + 1`` query rows."""
+        import functools as _ft
+
         self._serving_lru()
-        key = ("verify_pages", int(toks.shape[1]))
+        pk = self.paged_kernel if paged_kernel is None else bool(paged_kernel)
+        key = ("verify_pages", int(toks.shape[1]), pk)
         fn = self._serving_cache.get(key)
         if fn is None:
             fn = jax.jit(
-                self._verify_pages_fn, donate_argnums=(4,),
+                _ft.partial(self._verify_pages_fn, paged_kernel=pk),
+                donate_argnums=(4,),
                 out_shardings=(None, self._pool_out_shardings(caches),
                                self._io_shardings["batch"](None)))
             self._serving_cache.put(key, fn)

@@ -798,6 +798,135 @@ def run_spec(args, module, params, cfg, icfg) -> int:
     return rc
 
 
+def run_paged_kernel(args, module, params, cfg, icfg) -> int:
+    """Block-table-native decode kernel vs the [B, T] gather path: decode
+    step cost at a FIXED real context across growing ``max_total_len``.
+
+    The claim under test is the ISSUE-11/ROADMAP-2 contract: the gather
+    path rematerializes the whole padded ``[B, T]`` view every step, so its
+    step cost grows with T even when the actual context is constant; the
+    kernel walks only the pages the slot's chain actually holds, so its
+    step cost is FLAT in T.  One JSON line per (T, mode); rc 1 unless the
+    kernel's metric stays within ``1.3x`` smallest→largest T while the
+    gather path's grows past it, or if per-step logits diverge.
+
+    On a real TPU the metric is measured step wall-time; on the CPU
+    interpreter wall time measures the pallas interpreter, not HBM, so the
+    rung gates on the bytes-moved model instead (gather: the full clone;
+    kernel: the pages actually read) — the silicon wall-clock confirmation
+    rides ``tpu_watch`` as ``serving_paged_kernel``."""
+    import dataclasses
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.kvcache.quant import page_layer_bytes
+    from neuronx_distributed_tpu.trace import ParallelInferenceModel
+
+    B, C = args.batch_size, args.context_len
+    page = args.page_size
+    lens = sorted({int(x) for x in args.paged_kernel_lens.split(",")})
+    if any(t % page for t in lens) or C % page:
+        raise SystemExit(f"--page-size {page} must divide --context-len {C} "
+                         f"and every --paged-kernel-lens entry {lens}")
+    if any(t <= C for t in lens):
+        raise SystemExit(f"--paged-kernel-lens {lens} must all exceed "
+                         f"--context-len {C} (the fixed real context)")
+    on_tpu = jax.devices()[0].platform != "cpu"
+    steps = args.kernel_steps if on_tpu else min(args.kernel_steps, 3)
+    rs = np.random.RandomState(args.seed)
+    need = math.ceil((C + steps + 1) / page)  # pages one slot really uses
+    kv_dtype = icfg.kv_cache_dtype
+    itemsize = jnp.dtype(kv_dtype).itemsize
+    L, NKV, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+
+    def step_bytes(mode, T):
+        """The bytes-moved model: K+V across layers, per decode step."""
+        if mode == "gather":
+            return L * 2 * B * T * NKV * D * itemsize
+        return B * need * L * page_layer_bytes(page, NKV, D, None, kv_dtype)
+
+    records, rc = [], 0
+    for T in lens:
+        PP = T // page
+        num_pages = B * need + 1
+        model = ParallelInferenceModel(
+            module, params,
+            dataclasses.replace(icfg, max_total_len=T), paged_kernel=False)
+        # each slot owns `need` distinct physical pages; the table's tail
+        # rides the NULL page like any unwritten decode tail
+        tables = np.zeros((B, PP), np.int32)
+        for b in range(B):
+            tables[b, :need] = 1 + b * need + np.arange(need)
+        host_caches = [
+            tuple(rs.standard_normal((num_pages, page, NKV, D)).astype(
+                np.float32) for _ in range(2))
+            for _ in range(L)
+        ]
+        valid = np.zeros((B, T), np.int32)
+        valid[:, :C] = 1
+        tok = rs.randint(1, cfg.vocab_size, size=(B, 1)).astype(np.int32)
+        offs = np.full((B,), C, np.int32)
+
+        logits_by_mode = {}
+        for mode in ("gather", "kernel"):
+            pk = mode == "kernel"
+            caches = [tuple(jnp.asarray(x, kv_dtype) for x in lyr)
+                      for lyr in host_caches]
+            v = jnp.asarray(valid)
+            # warm (compile) once, then time `steps` donated decode steps
+            logits, caches, v = model.decode_pages(
+                jnp.asarray(tok), offs, tables, caches, v, paged_kernel=pk)
+            jax.block_until_ready(logits)
+            logits_by_mode[mode] = np.asarray(logits)
+            o = offs + 1
+            t0 = time.monotonic()
+            for s in range(steps):
+                logits, caches, v = model.decode_pages(
+                    jnp.asarray(tok), o + s, tables, caches, v,
+                    paged_kernel=pk)
+            jax.block_until_ready(logits)
+            ms = (time.monotonic() - t0) * 1e3 / steps
+            rec = {"metric": "serving_paged_kernel", "mode": mode,
+                   "max_total_len": T, "context_len": C, "page_size": page,
+                   "pages_used_per_slot": need, "batch": B,
+                   "step_ms": round(ms, 3), "step_bytes": step_bytes(mode, T),
+                   "gate_on": "step_ms" if on_tpu else "step_bytes"}
+            records.append(rec)
+            print(json.dumps(rec))
+        # tolerance keys on the COMPUTE dtype: the two paths accumulate in
+        # different orders, so bf16 models differ at bf16 rounding scale
+        tol = (2e-4 if jnp.dtype(cfg.dtype).itemsize >= 4
+               and jnp.dtype(kv_dtype).itemsize >= 4 else 5e-2)
+        if not np.allclose(logits_by_mode["gather"], logits_by_mode["kernel"],
+                           rtol=0.0, atol=tol):
+            print(f"serve_bench: paged-kernel logits diverged from the "
+                  f"gather path at T={T}", file=sys.stderr)
+            rc = 1
+
+    gate = "step_ms" if on_tpu else "step_bytes"
+    kern = [r[gate] for r in records if r["mode"] == "kernel"]
+    gath = [r[gate] for r in records if r["mode"] == "gather"]
+    flat = max(kern) / max(min(kern), 1e-9)
+    growth = max(gath) / max(min(gath), 1e-9)
+    if flat > 1.3:
+        print(f"serve_bench: kernel {gate} NOT flat in T "
+              f"({min(kern)} -> {max(kern)}, x{flat:.2f} > 1.3)",
+              file=sys.stderr)
+        rc = 1
+    if growth <= 1.3:
+        print(f"serve_bench: gather {gate} did not grow with T "
+              f"({min(gath)} -> {max(gath)}, x{growth:.2f}) — the "
+              "comparison is vacuous", file=sys.stderr)
+        rc = 1
+    print(json.dumps({"metric": "serving_paged_kernel_gate", "gate_on": gate,
+                      "kernel_ratio": round(flat, 3),
+                      "gather_ratio": round(growth, 3), "rc": rc}))
+    return rc
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--tiny", action="store_true", help="CPU smoke config")
@@ -847,6 +976,18 @@ def main() -> int:
     p.add_argument("--lora-adapters", type=int, default=8,
                    help="distinct adapters the --lora rung registers and "
                         "round-robins requests across")
+    p.add_argument("--paged-kernel", action="store_true",
+                   help="paged decode kernel mode: block-table-native "
+                        "kernel vs the [B, T] gather path at a fixed real "
+                        "context across growing max_total_len (one JSON "
+                        "line per (T, mode); rc 1 unless the kernel's step "
+                        "cost is flat in T while the gather path's grows)")
+    p.add_argument("--paged-kernel-lens", default="512,2048,8192",
+                   help="comma-separated max_total_len sweep for "
+                        "--paged-kernel")
+    p.add_argument("--kernel-steps", type=int, default=20,
+                   help="timed decode steps per --paged-kernel rung "
+                        "(capped at 3 on the CPU interpreter)")
     p.add_argument("--kv-quant", action="store_true",
                    help="int8-KV mode: int8 vs fp pages at a fixed HBM "
                         "budget (one JSON line each; rc 1 unless int8 "
@@ -925,6 +1066,10 @@ def main() -> int:
         cfg = LlamaConfig.tiny(max_seq_len=args.max_total_len,
                                sequence_parallel=False, remat="none")
         args.max_new_tokens = min(args.max_new_tokens, 8)
+        if args.paged_kernel and args.paged_kernel_lens == "512,2048,8192":
+            # interpreter-scale sweep (still >1.3x T growth end to end);
+            # the gate runs on the bytes-moved model off-TPU anyway
+            args.paged_kernel_lens = "192,320,576"
         # the --slo rung gates on an interactive p99 — it needs more
         # samples than the other tiny modes to keep the percentile stable
         args.num_requests = min(args.num_requests, 16 if args.slo else 8)
@@ -955,6 +1100,8 @@ def main() -> int:
         max_total_len=args.max_total_len,
         kv_cache_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
     )
+    if args.paged_kernel:
+        return run_paged_kernel(args, module, params, cfg, icfg)
     if args.paged:
         return run_paged(args, module, params, cfg, icfg)
     if args.slo:

@@ -150,6 +150,14 @@ def run_extra_jobs(results_path: str) -> None:
         ("serving_kv_quant", [sys.executable,
                               os.path.join(REPO, "tools", "serve_bench.py"),
                               "--kv-quant"]),
+        # block-table-native paged decode kernel vs the [B, T] gather path:
+        # on silicon the gate runs on MEASURED step wall-time — rc 1 unless
+        # the kernel's decode step is flat in max_total_len (<= 1.3x
+        # smallest -> largest T) while the gather path's grows
+        ("serving_paged_kernel", [sys.executable,
+                                  os.path.join(REPO, "tools",
+                                               "serve_bench.py"),
+                                  "--paged-kernel"]),
         # standalone kernel programs compile fast: block-size evidence fits
         # any window even when the full train step's compile does not
         ("flash_autotune", [sys.executable,
