@@ -279,6 +279,11 @@ def cmd_serve(args):
                              "serving runs over the paged KV cache")
         _, _, _, draft = build_model(args, preset=args.draft)
         paged_kw.update(draft=draft, spec_k=args.spec_k)
+    tracer = None
+    if args.trace_out:
+        from neuronx_distributed_tpu.obs import Tracer
+
+        tracer = Tracer()
     fleet = args.replicas > 1
     if fleet:
         # in-process fleet: N engines share the one compiled model (one
@@ -287,23 +292,30 @@ def cmd_serve(args):
         # every replica, so a requeued clone is admissible anywhere);
         # --stats-out becomes the router's router_stats.jsonl instead of a
         # single engine's serving_stats.jsonl
-        def factory():
-            kw = dict(paged_kw)
-            if n_adapters:
-                kw["adapter_store"] = make_store()
-            return ServingEngine(
-                model, rng=jax.random.PRNGKey(args.seed),
-                registry=MetricRegistry(), **kw)
+        def make_factory(rid):
+            def factory():
+                kw = dict(paged_kw)
+                if n_adapters:
+                    kw["adapter_store"] = make_store()
+                if tracer is not None:
+                    # one shared ring, per-replica span tags: a request's
+                    # trace stitches across replicas by its global id
+                    kw["tracer"] = tracer.scoped(rid)
+                return ServingEngine(
+                    model, rng=jax.random.PRNGKey(args.seed),
+                    registry=MetricRegistry(), **kw)
+            return factory
 
         target = FleetRouter(
-            [Replica(i, factory) for i in range(args.replicas)],
-            policy=args.routing, seed=args.seed, stats_path=args.stats_out)
+            [Replica(i, make_factory(i)) for i in range(args.replicas)],
+            policy=args.routing, seed=args.seed, stats_path=args.stats_out,
+            tracer=tracer)
     else:
         if n_adapters:
             paged_kw["adapter_store"] = make_store()
         target = engine = ServingEngine(
             model, rng=jax.random.PRNGKey(args.seed),
-            stats_path=args.stats_out, **paged_kw)
+            stats_path=args.stats_out, tracer=tracer, **paged_kw)
     requests = [
         Request(
             request_id=i,
@@ -325,9 +337,50 @@ def cmd_serve(args):
             ev["client_id"] = target.client_id(out.request_id)
         print(json.dumps(ev), flush=True)
 
+    msrv = None
+    if args.metrics_port is not None:
+        # live scrape endpoint for the run's duration: /metrics serves the
+        # front door's registry (router metrics for a fleet, engine
+        # metrics solo); /healthz answers 503 once liveness is gone
+        from neuronx_distributed_tpu.obs.metrics_server import MetricsServer
+
+        if fleet:
+            def health():
+                alive = sum(1 for r in target.replicas.values() if r.alive)
+                return {"ok": alive > 0, "replicas": args.replicas,
+                        "alive_replicas": alive,
+                        "inflight": target.inflight}
+        else:
+            def health():
+                return {"ok": True, "steps": engine._steps,
+                        "active": engine.scheduler.active_count,
+                        "queued": engine.scheduler.queue_depth}
+
+        msrv = MetricsServer(registry=target.registry, health_fn=health,
+                             port=args.metrics_port)
+        print(json.dumps({"event": "metrics_server", "port": msrv.port,
+                          "endpoints": ["/metrics", "/healthz"]}),
+              flush=True)
+
     t0 = time.monotonic()
-    outputs = replay(target, arrivals, requests, on_output=done)
+    try:
+        outputs = replay(target, arrivals, requests, on_output=done,
+                         tracer=tracer)
+    finally:
+        if msrv is not None:
+            msrv.close()
     wall = time.monotonic() - t0
+    if tracer is not None:
+        from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+        os.makedirs(args.trace_out, exist_ok=True)
+        ev = os.path.join(args.trace_out, "trace_events.jsonl")
+        ch = os.path.join(args.trace_out, "trace.json")
+        tracer.export_jsonl(ev)
+        tracer.export_chrome(ch)
+        validate_jsonl("trace_event", ev)
+        print(json.dumps({"event": "trace", "trace_events": ev,
+                          "trace_perfetto": ch}), flush=True)
     if fleet:
         snap = target.registry.snapshot()
         prefix = target.fleet_prefix_stats()
@@ -511,6 +564,17 @@ def main():
                     help="serve through a FleetRouter over this many "
                          "in-process engine replicas (1 = a bare engine); "
                          "--stats-out then writes router_stats.jsonl")
+    sp.add_argument("--metrics-port", type=int, default=None,
+                    help="expose /metrics (Prometheus text over the live "
+                         "registry) and /healthz (engine/fleet liveness) "
+                         "on this port for the duration of the serve run "
+                         "(0 = ephemeral; the chosen port is printed as a "
+                         "metrics_server event)")
+    sp.add_argument("--trace-out", default=None,
+                    help="directory to drop request-lifecycle trace "
+                         "artifacts into after the run: trace_events.jsonl "
+                         "(schema-checked spans, stitched across replicas) "
+                         "+ trace.json (Perfetto)")
     sp.add_argument("--routing", default="prefix_affinity",
                     choices=["round_robin", "random", "least_loaded",
                              "prefix_affinity"],

@@ -21,8 +21,16 @@ reproduced that fragmentation across ``trainer/metrics.py``,
   executable;
 - :mod:`.schemas` — the checked-in schema list every JSONL artifact is
   validated against (the contract downstream tooling relies on);
+- :mod:`.tracing` — request-lifecycle distributed tracing for the serving
+  stack (ring-bounded span tracer, ``trace_events.jsonl`` + Perfetto
+  exporters) and the trainer's Chrome-trace :class:`Timeline` (moved here
+  from ``utils/timeline.py``, which re-exports it);
+- :mod:`.metrics_server` — stdlib HTTP ``/metrics`` (live Prometheus
+  text) + ``/healthz`` endpoints over a registry (CLI:
+  ``tools/metrics_server.py``; live: ``runner.py serve --metrics-port``);
 - :mod:`.report` — merges scalars + timeline traces + flight records + HLO
-  audits into one run summary (CLI: ``tools/obs_report.py``).
+  audits + request traces into one run summary (CLI:
+  ``tools/obs_report.py``).
 
 :class:`Observability` glues them into the one object ``fit()`` (and any
 other driver) wires in.
@@ -61,6 +69,14 @@ from neuronx_distributed_tpu.obs.schemas import (
     validate_jsonl,
     validate_record,
     validate_registry_metrics,
+)
+from neuronx_distributed_tpu.obs.tracing import (
+    TRACE_EVENT_SCHEMA,
+    TRACE_EVENTS_FILE,
+    Span,
+    Tracer,
+    read_trace_events,
+    write_chrome_trace,
 )
 from neuronx_distributed_tpu.obs.transfer_audit import TransferAudit
 from neuronx_distributed_tpu.utils.logger import get_logger
@@ -209,6 +225,12 @@ __all__ = [
     "validate_jsonl",
     "validate_registry_metrics",
     "TransferAudit",
+    "Tracer",
+    "Span",
+    "read_trace_events",
+    "write_chrome_trace",
+    "TRACE_EVENTS_FILE",
+    "TRACE_EVENT_SCHEMA",
     "SCALARS_FILE",
     "FLIGHT_FILE",
     "HLO_AUDIT_FILE",

@@ -145,14 +145,23 @@ class MetricRegistry:
         return h
 
     def metrics(self) -> List[object]:
-        return list(self._metrics.values())
+        with self._lock:
+            return list(self._metrics.values())
+
+    def _items(self) -> List[Tuple[str, object]]:
+        """Sorted (name, metric) snapshot taken under the lock — the
+        serializers iterate THIS, not the live dict, so a concurrent
+        scrape (obs.metrics_server runs on its own thread) can never race
+        a hot-path metric registration mid-iteration."""
+        with self._lock:
+            return sorted(self._metrics.items())
 
     # -- serialization -----------------------------------------------------
 
     def snapshot(self) -> Dict[str, object]:
         """Plain-data view: scalars map to floats, histograms to a dict."""
         out: Dict[str, object] = {}
-        for name, m in self._metrics.items():
+        for name, m in self._items():
             if isinstance(m, Histogram):
                 out[name] = {
                     "count": m.count,
@@ -163,9 +172,17 @@ class MetricRegistry:
                 out[name] = m.value
         return out
 
-    def to_scalar_records(self, step: int, now: Optional[float] = None) -> List[dict]:
-        """Flatten every metric into ``scalars.jsonl``-schema records."""
+    def to_scalar_records(self, step: int, now: Optional[float] = None,
+                          mono: Optional[float] = None) -> List[dict]:
+        """Flatten every metric into ``scalars.jsonl``-schema records.
+
+        Every record is stamped with BOTH clocks: ``time`` (wall — the
+        shared epoch cross-host tooling merges on) and ``mono`` (the
+        host-monotonic instant — skew-free ordering against the serving
+        stack's monotonic-clocked spans and scheduler timestamps; wall
+        time alone mis-sorts cross-replica artifacts after NTP steps)."""
         now = time.time() if now is None else now
+        mono = time.monotonic() if mono is None else mono
         recs: List[dict] = []
 
         def rec(tag: str, value: float):
@@ -174,9 +191,9 @@ class MetricRegistry:
                 return  # a NaN gauge (e.g. diverged loss) must not poison
                 # the JSONL stream; the anomaly detectors carry that signal
             recs.append({"step": int(step), "tag": tag, "value": value,
-                         "time": now})
+                         "time": now, "mono": mono})
 
-        for name, m in sorted(self._metrics.items()):
+        for name, m in self._items():
             if isinstance(m, Histogram):
                 rec(f"{name}/count", m.count)
                 rec(f"{name}/sum", m.sum)
@@ -196,7 +213,7 @@ class MetricRegistry:
     def prometheus_text(self) -> str:
         """Prometheus text exposition of the current state."""
         lines: List[str] = []
-        for name, m in sorted(self._metrics.items()):
+        for name, m in self._items():
             pname = _prom_name(name)
             if isinstance(m, Counter):
                 lines.append(f"# TYPE {pname} counter")

@@ -25,9 +25,17 @@ from neuronx_distributed_tpu.obs import FLIGHT_FILE, HLO_AUDIT_FILE, SCALARS_FIL
 from neuronx_distributed_tpu.obs.flight import read_flight
 from neuronx_distributed_tpu.obs.hlo_audit import read_audits
 from neuronx_distributed_tpu.obs.registry import read_histograms
+from neuronx_distributed_tpu.obs.tracing import (
+    PHASE_NAMES,
+    TRACE_EVENTS_FILE,
+    read_trace_events,
+)
 
-OBS_REPORT_SCHEMA = "obs_report_v1"
+# v2 (tracing PR): the document gains the required "trace" section
+# (per-request waterfalls from trace_events.jsonl; null when no trace)
+OBS_REPORT_SCHEMA = "obs_report_v2"
 SUPERVISOR_EVENTS_FILE = "supervisor_events.jsonl"
+SERVING_STATS_FILE = "serving_stats.jsonl"
 
 
 def _read_scalar_file(path: str) -> List[dict]:
@@ -345,6 +353,110 @@ def _summarize_slo(scalars: Dict[str, dict],
     }
 
 
+def read_serving_stats(path: str) -> List[dict]:
+    """Read a ``serving_stats.jsonl`` stream ACROSS schema versions: v4
+    records (pre-tracing) lack ``decode_steps``/``prefill_chunks``/
+    ``preempted_ms``/``trace_id``/``mono``; they are filled with defaults
+    so downstream consumers never branch on the version."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            rec.setdefault("decode_steps", 0)
+            rec.setdefault("prefill_chunks", 0)
+            rec.setdefault("preempted_ms", 0.0)
+            rec.setdefault("trace_id", None)
+            rec.setdefault("mono", None)
+            out.append(rec)
+    return out
+
+
+def summarize_trace(trace_paths: Sequence[str],
+                    stats_records: Sequence[dict] = (),
+                    top: int = 5) -> Optional[dict]:
+    """The ``--trace`` section: per-request waterfalls reconstructed from
+    ``trace_events.jsonl`` spans.
+
+    Spans group by fleet-global ``request_id`` (one stitched trace per
+    request, across replicas and failover hops); the four PHASE spans
+    (queue, prefill, decode, preempted) tile a request's lifetime, so
+    their per-phase sums ARE the latency decomposition.  ``stats_records``
+    (``serving_stats`` v4/v5) link each waterfall to its terminal record
+    via ``trace_id`` for the reported-latency cross-check.  Returns None
+    when no spans exist (the report's "trace" key is null, not {})."""
+    spans: List[dict] = []
+    for p in trace_paths:
+        if os.path.exists(p):
+            spans.extend(read_trace_events(p))
+    if not spans:
+        return None
+    stats_by_trace = {r["trace_id"]: r for r in stats_records
+                      if r.get("trace_id") is not None}
+
+    by_req: Dict[int, List[dict]] = {}
+    for s in spans:
+        rid = s.get("request_id", -1)
+        if rid >= 0:
+            by_req.setdefault(rid, []).append(s)
+
+    requests: List[dict] = []
+    agg_phases = {name: 0.0 for name in PHASE_NAMES}
+    for rid, group in by_req.items():
+        phases = {name: 0.0 for name in PHASE_NAMES}
+        hops = 0
+        replicas = set()
+        state = None
+        roots = 0
+        for s in group:
+            dur = max(s["t_end"] - s["t_start"], 0.0) * 1e3
+            if s["name"] in phases:
+                phases[s["name"]] += dur
+            replicas.add(s["replica"])
+            attrs = s.get("attrs", {})
+            if s["name"] == "request":
+                roots += 1
+                hops = max(hops, int(attrs.get("hop", 0)))
+                if attrs.get("state") is not None:
+                    state = attrs["state"]
+            elif s["name"] == "route/requeue":
+                hops = max(hops, int(attrs.get("hop", 0)))
+        for name, ms in phases.items():
+            agg_phases[name] += ms
+        total = sum(phases.values())
+        entry = {
+            "request_id": rid,
+            "state": state,
+            "total_ms": round(total, 3),
+            "queue_ms": round(phases["queue"], 3),
+            "prefill_ms": round(phases["prefill"], 3),
+            "decode_ms": round(phases["decode"], 3),
+            "preempted_ms": round(phases["preempted"], 3),
+            "hops": hops,
+            "replicas": sorted(replicas - {-1}) or [-1],
+            "spans": len(group),
+            "window_ms": round(
+                (max(s["t_end"] for s in group)
+                 - min(s["t_start"] for s in group)) * 1e3, 3),
+        }
+        rec = stats_by_trace.get(rid)
+        if rec is not None:
+            entry["stats_total_ms"] = rec.get("total_ms")
+            entry["stats_state"] = rec.get("state")
+        requests.append(entry)
+
+    requests.sort(key=lambda e: -e["total_ms"])
+    return {
+        "files": len([p for p in trace_paths if os.path.exists(p)]),
+        "spans": len(spans),
+        "requests": len(requests),
+        "by_phase_ms": {k: round(v, 3) for k, v in agg_phases.items()},
+        "slowest": requests[:top],
+    }
+
+
 def _summarize_timeline(paths: Sequence[str]) -> dict:
     events = instants = 0
     dur_by_name: Dict[str, float] = {}
@@ -379,6 +491,8 @@ def build_report(
     hlo_audit_path: Optional[str] = None,
     timeline_paths: Sequence[str] = (),
     supervisor_events_path: Optional[str] = None,
+    trace_paths: Sequence[str] = (),
+    serving_stats_path: Optional[str] = None,
     tail: int = 10,
 ) -> dict:
     """Merge the artifacts into one summary document.
@@ -389,6 +503,7 @@ def build_report(
     to / override them."""
     scalar_paths = list(scalar_paths)
     timeline_paths = list(timeline_paths)
+    trace_paths = list(trace_paths)
     if run_dir:
         p = os.path.join(run_dir, SCALARS_FILE)
         if os.path.exists(p) and p not in scalar_paths:
@@ -405,6 +520,13 @@ def build_report(
         for q in sorted(glob.glob(os.path.join(run_dir, "*trace*.json"))):
             if q not in timeline_paths:
                 timeline_paths.append(q)
+        for q in sorted(glob.glob(
+                os.path.join(run_dir, f"*{TRACE_EVENTS_FILE}"))):
+            if q not in trace_paths:
+                trace_paths.append(q)
+        if serving_stats_path is None:
+            q = os.path.join(run_dir, SERVING_STATS_FILE)
+            serving_stats_path = q if os.path.exists(q) else None
 
     scalar_records: List[dict] = []
     for p in scalar_paths:
@@ -438,6 +560,10 @@ def build_report(
     fleet = _summarize_fleet(scalars)
     tenancy = _summarize_tenancy(scalars)
     slo = _summarize_slo(scalars, histograms)
+    stats_records = (read_serving_stats(serving_stats_path)
+                     if serving_stats_path
+                     and os.path.exists(serving_stats_path) else [])
+    trace = summarize_trace(trace_paths, stats_records)
     report = {
         "schema": OBS_REPORT_SCHEMA,
         "generated_at": time.time(),
@@ -448,6 +574,8 @@ def build_report(
             "hlo_audit": hlo_audit_path,
             "timelines": timeline_paths,
             "supervisor_events": supervisor_events_path,
+            "traces": trace_paths,
+            "serving_stats": serving_stats_path,
         },
         "scalars": scalars,
         "histograms": histograms,
@@ -456,6 +584,7 @@ def build_report(
         "hlo_audits": audits,
         "timeline": _summarize_timeline(timeline_paths),
         "supervisor": supervisor,
+        "trace": trace,
         "health": {
             "anomaly_count": len(anomalies),
             "host_blocked": host_blocked,
@@ -621,6 +750,30 @@ def render_markdown(report: dict) -> str:
                 f"- `{a['name']}`: {counts or 'no collectives'}; "
                 f"{a['total_collective_bytes']:,} bytes")
         lines.append("")
+
+    trace = report.get("trace")
+    if trace:
+        lines += ["## Request traces", "",
+                  f"{trace['spans']} spans across {trace['requests']} "
+                  f"request(s) ({trace['files']} trace file(s)); aggregate "
+                  "phase time: "
+                  + ", ".join(f"{k} {v:.1f} ms"
+                              for k, v in trace["by_phase_ms"].items()), ""]
+        if trace["slowest"]:
+            lines += ["Slowest requests (per-request waterfall):", "",
+                      "| request | state | total ms | queue | prefill | "
+                      "decode | preempted | hops | replicas |",
+                      "|---|---|---|---|---|---|---|---|---|"]
+            for e in trace["slowest"]:
+                check = (f" (stats {e['stats_total_ms']:.1f})"
+                         if e.get("stats_total_ms") is not None else "")
+                lines.append(
+                    f"| {e['request_id']} | {e['state'] or '?'} | "
+                    f"{e['total_ms']:.1f}{check} | {e['queue_ms']:.1f} | "
+                    f"{e['prefill_ms']:.1f} | {e['decode_ms']:.1f} | "
+                    f"{e['preempted_ms']:.1f} | {e['hops']} | "
+                    f"{','.join(str(r) for r in e['replicas'])} |")
+            lines.append("")
 
     tl = report["timeline"]
     if tl["events"] or tl["instants"]:

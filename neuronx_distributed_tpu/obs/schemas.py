@@ -39,6 +39,22 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     },
     # one line of docs/tpu_watch_results.jsonl (tools/tpu_watch.py append)
     "tpu_watch": {"ts": str, "kind": str},
+    # one line of trace_events.jsonl (obs.tracing.Tracer.export_jsonl) —
+    # one record per finished span: the request-lifecycle distributed
+    # trace.  request_id is the fleet-global id (-1 for batch-level spans
+    # like one engine decode step), replica the producing replica (-1
+    # off-fleet), parent_id the enclosing span (null at a trace root).
+    # Every span carries BOTH clocks: ts (wall, shared epoch) and mono
+    # (monotonic start == t_start; t_start/t_end are the span's interval
+    # on the monotonic clock) so cross-replica merges sort correctly
+    # under wall-clock skew.  attrs is free-form span detail (phase
+    # boundaries, token ranges, hop counts, ...).
+    "trace_event": {
+        "schema": str, "name": str, "span_id": int,
+        "parent_id": (int, type(None)), "request_id": int, "replica": int,
+        "t_start": _NUM, "t_end": _NUM, "ts": _NUM, "mono": _NUM,
+        "attrs": dict,
+    },
     # one line of serving_stats.jsonl (serving.engine.ServingEngine) —
     # one record per TERMINAL request; ttft_ms is null for requests that
     # never produced a token (cancelled/timed out while queued).  v2 adds
@@ -64,6 +80,15 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "queue_wait_ms": _NUM,
         "preemptions": int,
         "shed_reason": (str, type(None)),
+        # v5 (tracing PR): second monotonic stamp pairing the wall `time`,
+        # per-request work decomposition, and the trace_events.jsonl
+        # linkage (null when the engine ran without a tracer).  v4 records
+        # lack these five fields; obs.report reads them with defaults.
+        "mono": _NUM,
+        "decode_steps": int,
+        "prefill_chunks": int,
+        "preempted_ms": _NUM,
+        "trace_id": (int, type(None)),
     },
     # one line of router_stats.jsonl (serving.fleet.router.FleetRouter) —
     # one record per TERMINAL request across the whole fleet: which replica
@@ -84,12 +109,14 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "supervisor_event": {
         "schema": str, "time": _NUM, "event": str, "attempt": int,
     },
-    # tools/obs_report.py output document
+    # tools/obs_report.py output document; v2 adds the required "trace"
+    # key — the per-request waterfall section built from
+    # trace_events.jsonl (null when the run produced no trace)
     "obs_report": {
         "schema": str, "generated_at": _NUM, "scalars": dict,
         "histograms": dict, "flight": (dict, type(None)),
         "anomalies": list, "hlo_audits": list, "timeline": dict,
-        "supervisor": (dict, type(None)),
+        "supervisor": (dict, type(None)), "trace": (dict, type(None)),
     },
 }
 

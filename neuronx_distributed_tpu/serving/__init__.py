@@ -41,7 +41,14 @@ host-side shadow of each replica's prefix chains) and zero-loss failover
 (crash -> drain -> requeue on siblings -> warm restart).  :mod:`.driver`
 is the shared Poisson drive loop — it takes an engine or a router.
 
-Stall-free SLO serving (this PR): ``ServingEngine(prefill_chunk_tokens=)``
+Request-lifecycle tracing (tracing PR): ``ServingEngine(tracer=)`` /
+``FleetRouter(tracer=)`` record one span tree per request — queue wait,
+prefill chunks, decode steps, preemption gaps, failover hops — stitched
+across replicas by the fleet-global id, exported as schema-checked
+``trace_events.jsonl`` + Perfetto JSON (:mod:`~..obs.tracing`), and linked
+from ``serving_stats`` v5 via ``trace_id``.  Zero overhead when off.
+
+Stall-free SLO serving (SLO PR): ``ServingEngine(prefill_chunk_tokens=)``
 interleaves page-aligned prefill chunks with decode steps (Sarathi-style —
 long prompts stop stalling co-batched decodes, token-identical to
 whole-prefill), ``Request.priority`` + deadlines turn the scheduler into a

@@ -88,7 +88,7 @@ from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
-SERVING_STATS_SCHEMA = "serving_stats/4"
+SERVING_STATS_SCHEMA = "serving_stats/5"
 
 FAIL_NON_FINITE = "non_finite_logits"
 
@@ -370,6 +370,19 @@ class ServingEngine:
       dead queue head never burns prefill compute.  Per-class TTFT and
       inter-token histograms (``serving/{ttft,intertoken}_ms_<class>``)
       carry the per-tier SLO story.
+
+    Request-lifecycle tracing (tracing PR): ``tracer=`` (an
+    ``obs.tracing.Tracer``, or a per-replica ``tracer.scoped(rid)`` in a
+    fleet) records one span tree per request — root span submit→terminal,
+    wait phases (queue, preempted park) from the scheduler, compute phases
+    (prefill with per-chunk children and prefix-hit attrs, decode) from
+    the engine, plus batch-level ``decode_step``/``spec_round`` spans with
+    per-slot children.  Phase boundaries share single timestamps, so a
+    request's phases tile its lifetime exactly (the ``obs_report --trace``
+    waterfall sums to its ``serving_stats`` latency).  ``tracer=None``
+    (default) is ZERO overhead: every call site is guarded, no span is
+    ever allocated.  Terminal ``serving_stats`` records carry ``trace_id``
+    linking them into ``trace_events.jsonl``.
     """
 
     def __init__(
@@ -397,6 +410,7 @@ class ServingEngine:
         max_batch_wait_s: Optional[float] = DEFAULT_MAX_BATCH_WAIT_S,
         shed_infeasible: bool = False,
         paged_kernel: Any = "auto",
+        tracer: Any = None,
     ):
         attrs = ("prefill_one", "insert_slot", "decode_slots")
         if page_size is not None:
@@ -561,11 +575,22 @@ class ServingEngine:
             getattr(model, "num_layers", 0) * 2 * self.B * self.T
             * getattr(model, "num_kv_heads", 0) * getattr(model, "head_dim", 0)
             * jnp.dtype(cfg.kv_cache_dtype).itemsize)
+        # request-lifecycle tracing (obs.tracing.Tracer or a per-replica
+        # scope of one, None = off): the engine owns the per-request root
+        # span and the COMPUTE phases (prefill incl. chunks, decode, spec
+        # rounds, adapter acquire); the scheduler owns the WAIT phases
+        # (queue, preempted park).  Every call site is guarded on `tracer
+        # is not None` so the default path allocates nothing — the
+        # zero-overhead-when-off contract tests assert via
+        # obs.tracing.SPANS_CREATED.
+        self.tracer = tracer
+        self._rt: dict = {}       # rid -> {"root": Span, "phase": Span?}
+        self._batch_span = None   # open decode_step/spec_round batch span
         self.scheduler = SlotScheduler(
             self.B, self.C, self.T, max_queue=max_queue,
             page_gate=self._kv, reserve_extra=self._spec_k,
             max_batch_wait_s=max_batch_wait_s,
-            shed_infeasible=shed_infeasible)
+            shed_infeasible=shed_infeasible, tracer=tracer)
         self.step_timeout_s = step_timeout_s
         self._steps = 0
         if transfer_guard not in ("off", "forbid"):
@@ -711,15 +736,46 @@ class ServingEngine:
                 raise AdmissionError(
                     f"request {request.request_id} names unregistered "
                     f"adapter {aid}")
+        tr = self.tracer
+        root = None
+        if tr is not None:
+            # the per-request root span (submit -> terminal emit); the
+            # scheduler parents its queue span under it via _trace_root.
+            # trace_id is what links the terminal serving_stats record to
+            # this trace; a fleet requeue clone keeps the global id, and
+            # its `hop` attr says which dispatch attempt these spans are.
+            request.trace_id = request.request_id
+            # every engine-side span is stamped from the ENGINE's clock
+            # (injectable): mixed clocks would corrupt the trace whenever
+            # a test or harness injects a fake clock
+            root = tr.begin(
+                "request", request_id=request.request_id,
+                t=self._clock(),
+                priority=request.priority, prompt_len=request.prompt_len,
+                max_new_tokens=request.max_new_tokens,
+                adapter_id=aid, hop=getattr(request, "hop", 0))
+            request._trace_root = root
+            self._rt[request.request_id] = {"root": root}
         try:
             self.scheduler.submit(request, now=self._clock())
         except SLOInfeasible:
             # distinct from queue-full backpressure: the deadline is already
             # dead under current load — shed at the edge, never admitted
             self.registry.counter("serving/shed_total").inc()
+            if root is not None:
+                self._rt.pop(request.request_id, None)
+                tr.end(root, t=self._clock(), shed="slo_infeasible")
             raise
         except BackpressureError:
             self.registry.counter("serving/rejected_total").inc()
+            if root is not None:
+                self._rt.pop(request.request_id, None)
+                tr.end(root, t=self._clock(), rejected="backpressure")
+            raise
+        except BaseException:
+            if root is not None:
+                self._rt.pop(request.request_id, None)
+                tr.end(root, t=self._clock(), rejected="error")
             raise
 
     def cancel(self, request_id: int) -> bool:
@@ -749,6 +805,9 @@ class ServingEngine:
         if swept:
             self._park_free_slots()
             for req in swept:
+                # a swept ACTIVE request still has its compute phase open
+                # (queued ones were closed by the scheduler's sweep)
+                self._trace_end_phase(req, t=now, swept=req.state.value)
                 self.registry.counter(
                     "serving/cancelled_total"
                     if req.state is RequestState.CANCELLED
@@ -855,6 +914,20 @@ class ServingEngine:
         return outputs
 
     def close(self) -> None:
+        tr = self.tracer
+        if tr is not None:
+            # seal every open span (replica death / engine teardown): an
+            # aborted span in the ring keeps the failover trace's pre-crash
+            # coverage instead of losing it with the engine object
+            now = self._clock()
+            self.scheduler.trace_abort(now)
+            if self._batch_span is not None:
+                tr.end(self._batch_span, t=now, aborted=True)
+                self._batch_span = None
+            for rid, rt in list(self._rt.items()):
+                tr.end(rt.pop("phase", None), t=now, aborted=True)
+                tr.end(rt.get("root"), t=now, aborted=True)
+            self._rt.clear()
         if self._stats_f is not None:
             self._stats_f.close()
             self._stats_f = None
@@ -866,6 +939,43 @@ class ServingEngine:
         self.close()
 
     # -- internals ---------------------------------------------------------
+
+    def _trace_begin_phase(self, req: Request, name: str,
+                           t: Optional[float] = None, **attrs) -> None:
+        """Open a compute-phase span (prefill / decode) under the request's
+        root.  Phase boundaries reuse ONE timestamp (the grant instant, the
+        first-token instant, the terminal instant), so a request's phases
+        tile its lifetime exactly and the waterfall sums to its latency."""
+        tr = self.tracer
+        if tr is None:
+            return
+        rt = self._rt.get(req.request_id)
+        if rt is None:
+            return
+        rt["phase"] = tr.begin(name, request_id=req.request_id,
+                               parent=rt["root"], t=t, **attrs)
+
+    def _trace_end_phase(self, req: Request, t: Optional[float] = None,
+                         **attrs) -> None:
+        tr = self.tracer
+        if tr is None:
+            return
+        rt = self._rt.get(req.request_id)
+        if rt is None:
+            return
+        tr.end(rt.pop("phase", None), t=t, **attrs)
+
+    def _trace_phase_attrs(self, req: Request, **attrs) -> None:
+        """Annotate the request's OPEN phase span (attrs merge at seal)."""
+        if self.tracer is None:
+            return
+        rt = self._rt.get(req.request_id)
+        if rt is not None and rt.get("phase") is not None:
+            rt["phase"].attrs.update(attrs)
+
+    def _trace_phase_of(self, req: Request):
+        rt = self._rt.get(req.request_id) if self.tracer is not None else None
+        return rt.get("phase") if rt is not None else None
 
     def _prefill_into_slot(self, slot: int, req: Request, outputs: list) -> None:
         """Single-request prefill, KV/validity slot-insert, first token.
@@ -882,6 +992,19 @@ class ServingEngine:
         budgeted chunk loop instead, and the request stays PREFILLING
         across steps while decodes keep ticking."""
         now = self._clock()
+        # a preemption park ends at the grant: bank the parked wall time
+        # (the serving_stats `preempted_ms` decomposition field)
+        if req.parked_at is not None:
+            t_grant = (req.prefill_time if req.prefill_time is not None
+                       else now)
+            req.preempted_ms += max(t_grant - req.parked_at, 0.0) * 1e3
+            req.parked_at = None
+        # the prefill phase starts at the GRANT instant (where the queue /
+        # preempted span ended), so the trace phases tile without gaps
+        self._trace_begin_phase(
+            req, "prefill",
+            t=req.prefill_time if req.prefill_time is not None else now,
+            slot=slot)
         # pre-dispatch expiry: the sweep ran at step start, but a request
         # can expire between sweep and prefill — never burn a prefill (or
         # its first chunk) on a deadline that is already dead
@@ -905,10 +1028,19 @@ class ServingEngine:
             # transient adapter-pool exhaustion fails THIS request cleanly
             # (the engine keeps serving); injected faults re-raise after
             # the same cleanup, like the KV path.
+            tr = self.tracer
+            aspan = (tr.begin("adapter_acquire", request_id=req.request_id,
+                              parent=self._trace_phase_of(req),
+                              t=self._clock(), adapter_id=aid)
+                     if tr is not None else None)
             try:
                 loads = self._adapters.acquire(aid, engine_step=self._steps)
+                if aspan is not None:
+                    tr.end(aspan, t=self._clock(), loads=len(loads))
             except BaseException as e:
                 now = self._clock()
+                if aspan is not None:
+                    tr.end(aspan, t=now, failed=type(e).__name__)
                 self._fail_slot_state(
                     slot, req, now, reason=f"adapter:{type(e).__name__}")
                 logger.warning(
@@ -969,6 +1101,10 @@ class ServingEngine:
                     [valid_np, np.zeros((self.T - self.C,), np.int32)])
                 self._chunking[slot] = _ChunkPrefill(
                     req, ids[0].copy(), valid_full_np, fresh)
+                # the prefill phase span stays OPEN across chunked steps;
+                # each chunk adds a child span under it
+                self._trace_phase_attrs(req, chunked=True,
+                                        fresh_pages=len(fresh))
                 self._set_sampling_state(slot, req)
                 return
             if cached is not None:
@@ -977,6 +1113,7 @@ class ServingEngine:
                 # last-position logits — no prefill compute at all (keys
                 # are adapter-salted, so the cached KV/logits were computed
                 # under this same adapter)
+                self._trace_phase_attrs(req, prefix_hit=True)
                 logits = jnp.asarray(cached)
             else:
                 if aid:
@@ -990,6 +1127,7 @@ class ServingEngine:
                                  request_id=req.request_id,
                                  engine_step=self._steps)
                 fresh = self._kv.fresh_pages(slot)
+                self._trace_phase_attrs(req, fresh_pages=len(fresh))
                 for lp, phys in fresh:
                     self.caches = self.model.write_page(
                         self.caches, row_caches, lp, phys)
@@ -1073,6 +1211,10 @@ class ServingEngine:
         tok = int(first[0][0])
         req.transition(RequestState.DECODE)
         req.first_token_time = now
+        # prefill ends and decode begins at the SAME first-token instant —
+        # contiguous phases, so the waterfall sums to the request latency
+        self._trace_end_phase(req, t=now)
+        self._trace_begin_phase(req, "decode", t=now)
         if req.submit_time is not None:
             ttft_s = now - req.submit_time
             self.registry.histogram("serving/ttft_ms", MS_BUCKETS).observe(
@@ -1156,14 +1298,30 @@ class ServingEngine:
         off = st.fresh[st.next_i][0] * page
         width = n_pages * page
         ids_chunk = st.ids_row[off:off + width][None, :]
+        tr = self.tracer
+        cspan = (tr.begin("prefill_chunk", request_id=st.req.request_id,
+                          parent=self._trace_phase_of(st.req),
+                          t=self._clock(),
+                          tok_start=int(off), tok_end=int(off + width),
+                          pages=n_pages)
+                 if tr is not None else None)
         # chaos hook: a kill mid-chunked-prefill must reclaim every page
         # and leave the request cleanly requeue-able (tests/test_slo_*)
-        fault_point("serving/prefill_chunk", request_id=st.req.request_id,
-                    engine_step=self._steps, chunk_offset=off)
-        logits, self.caches = self.model.prefill_chunk_pages(
-            jnp.asarray(ids_chunk), off,
-            self._kv.tables[slot][None, :].copy(), self.caches,
-            st.valid_row[None, :].copy())
+        try:
+            fault_point("serving/prefill_chunk",
+                        request_id=st.req.request_id,
+                        engine_step=self._steps, chunk_offset=off)
+            logits, self.caches = self.model.prefill_chunk_pages(
+                jnp.asarray(ids_chunk), off,
+                self._kv.tables[slot][None, :].copy(), self.caches,
+                st.valid_row[None, :].copy())
+        except BaseException as e:
+            if cspan is not None:
+                tr.end(cspan, t=self._clock(), failed=type(e).__name__)
+            raise
+        if cspan is not None:
+            tr.end(cspan, t=self._clock())
+        st.req.prefill_chunks += 1
         st.next_i += n_pages
         # chunk prefill stays on the gather path (it attends the per-row
         # [1, T] view); its rematerialization is honest in the counter, so
@@ -1190,7 +1348,11 @@ class ServingEngine:
             if picked is None:
                 return
             slot, req = picked
-            self.scheduler.requeue(req)  # frees the slot, resets the request
+            # the active compute phase ends at the park instant; the
+            # scheduler opens the "preempted" gap span at the same `now`
+            self._trace_end_phase(req, t=now, preempted=True)
+            self.scheduler.requeue(req, now=now)  # frees slot, resets req
+            req.parked_at = now
             self._chunking.pop(slot, None)
             self._offsets[slot] = self.T  # park
             self._last_tok_time[slot] = None
@@ -1212,6 +1374,7 @@ class ServingEngine:
         req.finish_reason = RequestState.TIMED_OUT.value
         req.finish_time = now
         req.shed_reason = SHED_EXPIRED_BEFORE_PREFILL
+        self._trace_end_phase(req, t=now, expired=True)
         self.scheduler.release(req)
         self._offsets[slot] = self.T  # park
         self._last_tok_time[slot] = None
@@ -1239,6 +1402,10 @@ class ServingEngine:
         tok_idx = np.zeros((self.B,), np.int32)
         for slot, req in active:
             tok_idx[slot] = len(req.generated)
+        tr = self.tracer
+        bspan = (tr.begin("decode_step", t=self._clock(), step=self._steps,
+                          active=len(active))
+                 if tr is not None else None)
 
         if self._adapters is not None:
             logits, self.caches, self.valid = self.model.decode_pages_lora(
@@ -1277,6 +1444,11 @@ class ServingEngine:
                 self._fail_slot(slot, req, outputs, now)
                 continue
             tok = int(toks[slot])
+            req.decode_steps += 1
+            if bspan is not None:
+                tr.instant("decode_slot", request_id=req.request_id,
+                           parent=bspan, t=now, slot=slot,
+                           tok_idx=int(tok_idx[slot]))
             last = self._last_tok_time[slot]
             if last is not None:
                 self._observe_intertoken(req, (now - last) * 1e3)
@@ -1285,6 +1457,8 @@ class ServingEngine:
                 self._next_tok[slot] = tok
             else:
                 outputs.append(self._emit(req, now))
+        if bspan is not None:
+            tr.end(bspan, t=now)
 
     def _collect_decode(self) -> list:
         """Collect the in-flight decode step: ONE explicit packed fetch
@@ -1301,6 +1475,8 @@ class ServingEngine:
         packed = self._audit.fetch(packed_dev, label="serving")  # [2, B]
         toks, finite = packed[0], packed[1]
         now = self._clock()
+        tr = self.tracer
+        bspan, self._batch_span = self._batch_span, None
         post: list = []
         for slot, req, gen in active:
             if req.state is not RequestState.DECODE \
@@ -1324,6 +1500,11 @@ class ServingEngine:
             last = self._last_tok_time[slot]
             ms = (now - last) * 1e3 if last is not None else None
             req.generated.append(tok)
+            req.decode_steps += 1
+            if bspan is not None:
+                tr.instant("decode_slot", request_id=req.request_id,
+                           parent=bspan, t=now, slot=slot,
+                           tok_idx=len(req.generated) - 1)
             self._last_tok_time[slot] = now
             self.registry.counter("serving/tokens_total").inc()
             reason = self._stop_reason(req, tok)
@@ -1332,6 +1513,8 @@ class ServingEngine:
             else:
                 self._next_tok[slot] = tok
             post.append(("token", slot, req, tok, ms, now))
+        if bspan is not None:
+            tr.end(bspan, t=now)
         return post
 
     def _dispatch_decode(self, active: list) -> None:
@@ -1347,6 +1530,13 @@ class ServingEngine:
         tok_idx = np.zeros((self.B,), np.int32)
         for slot, req in active:
             tok_idx[slot] = len(req.generated)
+        if self.tracer is not None:
+            # the batch-level decode span covers dispatch -> collect (the
+            # honest in-flight device window of the pipelined engine);
+            # per-slot child spans land at collect time
+            self._batch_span = self.tracer.begin(
+                "decode_step", t=self._clock(), step=self._steps,
+                active=len(active))
         # eager slicing of a stacked [3, B] array would bind scalar start
         # indices host-side (an implicit transfer the guard rejects), so the
         # per-step inputs stage as one explicit pytree put instead; in paged
@@ -1427,6 +1617,10 @@ class ServingEngine:
         tok_idx = np.zeros((self.B,), np.int32)
         for slot, req in active:
             tok_idx[slot] = len(req.generated)
+        if self.tracer is not None:
+            self._batch_span = self.tracer.begin(
+                "spec_round", t=self._clock(), step=self._steps,
+                active=len(active), k=k)
         offs_steps = self._offsets[None, :] + np.arange(k, dtype=np.int32)[:, None]
         tidx_steps = tok_idx[None, :] + np.arange(k, dtype=np.int32)[:, None]
         staged = [self._next_tok[:, None].copy(), self._offsets.copy(),
@@ -1498,6 +1692,8 @@ class ServingEngine:
         packed = self._audit.fetch(packed_dev, label="serving")  # [k+3, B]
         commit, acc, finite = packed[:k + 1], packed[k + 1], packed[k + 2]
         now = self._clock()
+        tr = self.tracer
+        bspan, self._batch_span = self._batch_span, None
         post: list = []
         ingest = np.full((self.B,), self.T, np.int32)
         need_ingest = False
@@ -1535,6 +1731,13 @@ class ServingEngine:
                     break  # stop inside the accepted run: commit up to it
             m = len(toks)
             reg.counter("serving/spec_committed_total").inc(m)
+            req.decode_steps += 1
+            if bspan is not None:
+                # per-slot round outcome: proposals accepted + tokens
+                # committed (the accepted-run length the k-sweep tunes)
+                tr.instant("spec_slot", request_id=req.request_id,
+                           parent=bspan, t=now, slot=slot, accepted=a,
+                           committed=m)
             self._offsets[slot] += m
             self._last_tok_time[slot] = now
             if reason is not None:
@@ -1551,6 +1754,8 @@ class ServingEngine:
             # inter-token percentiles measure the effective per-token rate
             per_tok_ms = gap_ms / m if (gap_ms is not None and m) else None
             post.append(("tokens", slot, req, toks, per_tok_ms, now))
+        if bspan is not None:
+            tr.end(bspan, t=now)
         if need_ingest:
             (ing_offs,) = self._audit.put((ingest,))
             _, self._draft_caches, self._draft_valid = \
@@ -1614,6 +1819,7 @@ class ServingEngine:
         req.transition(RequestState.FINISHED)
         req.finish_reason = reason
         req.finish_time = now
+        self._trace_end_phase(req, t=now)
         self.scheduler.release(req)
         self._offsets[slot] = self.T  # park
         self._last_tok_time[slot] = None
@@ -1632,6 +1838,7 @@ class ServingEngine:
         req.transition(RequestState.FAILED)
         req.finish_reason = reason
         req.finish_time = now
+        self._trace_end_phase(req, t=now, failed=reason)
         self.scheduler.release(req)
         self._chunking.pop(slot, None)
         self._offsets[slot] = self.T  # park
@@ -1693,6 +1900,20 @@ class ServingEngine:
                 self._release_adapter(slot)  # idempotent pin release
 
     def _emit(self, req: Request, now: float) -> RequestOutput:
+        if req.parked_at is not None:
+            # terminal while parked (sweep/cancel between a preemption and
+            # its re-grant): the open park still counts as preempted time
+            req.preempted_ms += max(now - req.parked_at, 0.0) * 1e3
+            req.parked_at = None
+        tr = self.tracer
+        if tr is not None:
+            rt = self._rt.pop(req.request_id, None)
+            if rt is not None:
+                tr.end(rt.pop("phase", None), t=now)  # defensive: none open
+                tr.end(rt.get("root"), t=now, state=req.state.value,
+                       finish_reason=req.finish_reason,
+                       new_tokens=len(req.generated),
+                       preemptions=req.preemptions)
         out = RequestOutput.from_request(req, now)
         if self._stats_path is not None:
             if self._stats_f is None:
@@ -1722,6 +1943,16 @@ class ServingEngine:
                 "queue_wait_ms": out.queue_ms,
                 "preemptions": out.preemptions,
                 "shed_reason": req.shed_reason,
+                # tracing linkage + work decomposition (v5): the monotonic
+                # stamp pairs the wall `time` (cross-replica sort under
+                # clock skew) and comes from the ENGINE clock so it shares
+                # the spans' timescale; trace_id keys this request's spans
+                # in trace_events.jsonl (null when no tracer is attached)
+                "mono": self._clock(),
+                "decode_steps": out.decode_steps,
+                "prefill_chunks": out.prefill_chunks,
+                "preempted_ms": out.preempted_ms,
+                "trace_id": out.trace_id,
             }
             self._stats_f.write(json.dumps(rec) + "\n")
             self._stats_f.flush()

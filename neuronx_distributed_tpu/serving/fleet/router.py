@@ -148,7 +148,8 @@ class FleetRouter:
                  max_pending: Optional[int] = None,
                  retain_done: int = 4096,
                  clock: Callable[[], float] = time.monotonic,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 tracer: Any = None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         ids = [r.replica_id for r in replicas]
@@ -158,6 +159,13 @@ class FleetRouter:
         self.policy = make_policy(policy, seed=seed)
         self.alloc = RequestIdAllocator(namespace)
         self.registry = registry if registry is not None else MetricRegistry()
+        # request-lifecycle tracing (obs.tracing.Tracer, None = off): the
+        # router records the PLACEMENT edges — dispatch (with spill count
+        # and affinity evidence), failover requeue hops, terminal emission.
+        # Replica engines record their own lifecycle spans through
+        # per-replica scopes of the SAME tracer (tracer.scoped(rid)), and
+        # a request's whole cross-replica trace stitches by its global id.
+        self.tracer = tracer
         self._clock = clock
         self._stats_path = stats_path
         self._stats_f = None
@@ -483,8 +491,14 @@ class FleetRouter:
         ``max_pending`` bound — requeues of ALREADY-ACCEPTED requests must
         never be dropped by an admission limit that exists to bound NEW
         work."""
+        tr = self.tracer
+        dspan = (tr.begin("route/dispatch", request_id=rec.global_id,
+                          hop=rec.requeues)
+                 if tr is not None else None)
         candidates = [rid for rid, r in self.replicas.items() if r.alive]
         if not candidates:
+            if dspan is not None:
+                tr.end(dspan, parked=True, replica=-1)
             self._park(rec, force=force_park)
             return
         # load views cost a metrics scan per replica; rotation/random
@@ -509,7 +523,12 @@ class FleetRouter:
                     "router/affinity_hits_total" if rec.affinity_pages
                     else "router/affinity_misses_total").inc()
             self.shadows[rid].credit(rec.fps)
+            if dspan is not None:
+                tr.end(dspan, replica=rid, spills=i,
+                       affinity_pages=rec.affinity_pages)
             return
+        if dspan is not None:
+            tr.end(dspan, parked=True, replica=-1, spills=len(order))
         self._park(rec, force=force_park)
 
     def _park(self, rec: _Tracked, force: bool = False) -> None:
@@ -574,6 +593,9 @@ class FleetRouter:
             adapter_id=getattr(t, "adapter_id", 0),
             priority=getattr(t, "priority", "interactive"))
         clone.submit_time = rec.submit_time
+        # tracing: the clone's engine spans carry which requeue hop they
+        # belong to (the original global id already stitches the trace)
+        clone.hop = rec.requeues
         return clone
 
     def _deadline_expired(self, rec: _Tracked, now: float) -> bool:
@@ -623,6 +645,13 @@ class FleetRouter:
             rec.requeues += 1
             requeued += 1
             self.registry.counter("router/requeued_total").inc()
+            if self.tracer is not None:
+                # the failover hop edge: this request's spans continue on
+                # a sibling under the same global id, next hop number
+                self.tracer.instant(
+                    "route/requeue", request_id=rec.global_id, t=now,
+                    hop=rec.requeues, from_replica=replica.replica_id,
+                    cause=type(exc).__name__)
             try:
                 self._dispatch(rec, self._clone(rec), force_park=True)
             except Exception as req_err:
@@ -646,6 +675,13 @@ class FleetRouter:
     def _finish(self, rec: _Tracked, out: RequestOutput) -> None:
         rec.done = True
         self._inflight -= 1
+        if self.tracer is not None:
+            self.tracer.instant(
+                "route/terminal", request_id=rec.global_id,
+                state=out.state, replica=(rec.replica_id
+                                          if rec.replica_id is not None
+                                          else -1),
+                requeues=rec.requeues)
         if self._stats_path is not None:
             self._write_stats(rec, out)
         # a terminal record only serves the client_id mapping from here on:

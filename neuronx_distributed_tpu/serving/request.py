@@ -137,6 +137,20 @@ class Request:
     # before its prefill ran — why (e.g. "expired_before_prefill")
     preemptions: int = 0
     shed_reason: Optional[str] = None
+    # observability (engine-owned; tracing PR): cumulative work spent on
+    # the request ACROSS preemption round-trips — engine decode steps that
+    # committed at least one of its tokens, chunked-prefill dispatches it
+    # consumed, and wall milliseconds spent parked between a preemption and
+    # its re-grant (`parked_at` is the open park's start instant, engine
+    # clock).  `trace_id` links the terminal serving_stats record to the
+    # request's spans in trace_events.jsonl (None when no tracer is
+    # attached); it survives requeue clones because the fleet preserves the
+    # global id.
+    decode_steps: int = 0
+    prefill_chunks: int = 0
+    preempted_ms: float = 0.0
+    parked_at: Optional[float] = None
+    trace_id: Optional[int] = None
 
     def __post_init__(self):
         self.prompt_ids = [int(t) for t in self.prompt_ids]
@@ -227,6 +241,12 @@ class RequestOutput:
     priority: str = PRIORITY_INTERACTIVE
     deadline_s: Optional[float] = None
     preemptions: int = 0
+    # tracing/observability (v5): per-request work decomposition and the
+    # trace_events.jsonl linkage (None off tracing)
+    decode_steps: int = 0
+    prefill_chunks: int = 0
+    preempted_ms: float = 0.0
+    trace_id: Optional[int] = None
 
     @property
     def acceptance_rate(self) -> Optional[float]:
@@ -259,4 +279,8 @@ class RequestOutput:
             priority=req.priority,
             deadline_s=req.deadline_s,
             preemptions=req.preemptions,
+            decode_steps=req.decode_steps,
+            prefill_chunks=req.prefill_chunks,
+            preempted_ms=req.preempted_ms,
+            trace_id=req.trace_id,
         )

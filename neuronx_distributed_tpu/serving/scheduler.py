@@ -120,7 +120,7 @@ class SlotScheduler:
                  max_queue: Optional[int] = None, page_gate=None,
                  reserve_extra: int = 0,
                  max_batch_wait_s: Optional[float] = DEFAULT_MAX_BATCH_WAIT_S,
-                 shed_infeasible: bool = False):
+                 shed_infeasible: bool = False, tracer=None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_queue is not None and max_queue < 1:
@@ -162,6 +162,13 @@ class SlotScheduler:
         self._wait_ewma: Dict[str, Optional[float]] = {
             cls: None for cls in PRIORITIES}
         self._ttft_ewma: Optional[float] = None
+        # request-lifecycle tracing (obs.tracing.Tracer, or None = off):
+        # the scheduler owns the WAIT phases — a "queue" span from submit
+        # to grant and a "preempted" span from park to re-grant — plus
+        # blocked-head instants.  Every call site is guarded on `tracer is
+        # not None`, so the off path allocates nothing.
+        self.tracer = tracer
+        self._qspans: Dict[int, object] = {}  # rid -> open queue/park span
 
     # -- introspection -----------------------------------------------------
 
@@ -359,14 +366,24 @@ class SlotScheduler:
         self._by_id[request.request_id] = request
         self._keys[request.request_id] = key
         bisect.insort(self._queues[request.priority], key + (request,))
+        if self.tracer is not None:
+            # the QUEUED wait phase: starts at the submit instant, ends at
+            # grant (admit) or a queued sweep.  Parented under the engine's
+            # per-request root span when one exists.
+            self._qspans[request.request_id] = self.tracer.begin(
+                "queue", request_id=request.request_id,
+                parent=getattr(request, "_trace_root", None), t=now,
+                priority=request.priority, deadline_s=request.deadline_s)
 
-    def requeue(self, request: Request) -> int:
+    def requeue(self, request: Request, now: Optional[float] = None) -> int:
         """Slot preemption (the engine's half releases the device/page
         state): pull an active PREFILL/DECODE request out of its slot, park
         it back to QUEUED (partial generation discarded — see
         :meth:`~.request.Request.reset_for_requeue`), and re-insert it at
         its ORIGINAL EDF position (same deadline key and submission
-        sequence).  Returns the freed slot index."""
+        sequence).  Returns the freed slot index.  ``now`` (engine clock)
+        anchors the trace's park span so it abuts the ended compute phase
+        exactly."""
         slot = self._slot_of.pop(request.request_id, None)
         if slot is None:
             raise ValueError(
@@ -375,6 +392,13 @@ class SlotScheduler:
         request.reset_for_requeue()
         key = self._keys[request.request_id]
         bisect.insort(self._queues[request.priority], key + (request,))
+        if self.tracer is not None:
+            # the PREEMPTED gap: park instant -> re-grant (or sweep) — the
+            # per-request waterfall's "where did the victim's time go"
+            self._qspans[request.request_id] = self.tracer.begin(
+                "preempted", request_id=request.request_id,
+                parent=getattr(request, "_trace_root", None), t=now,
+                preemptions=request.preemptions)
         return slot
 
     def pick_preemption(self, now: Optional[float] = None
@@ -448,6 +472,10 @@ class SlotScheduler:
                     req.transition(reason)
                     req.finish_reason = reason.value
                     req.finish_time = now
+                    if self.tracer is not None:
+                        self.tracer.end(
+                            self._qspans.pop(req.request_id, None), t=now,
+                            swept=reason.value)
                     swept.append(req)
         for slot, req in self.active():
             reason = None
@@ -486,6 +514,15 @@ class SlotScheduler:
             if budget is not None:
                 need = self.page_gate.pages_needed(req)
                 if need > budget:
+                    if self.tracer is not None:
+                        # the head is BLOCKED on pages (it also blocks
+                        # everyone behind it) — the waterfall's "why did
+                        # the queue span stretch" annotation
+                        self.tracer.instant(
+                            "sched/blocked", request_id=req.request_id,
+                            parent=self._qspans.get(req.request_id), t=now,
+                            reason="pages", pages_needed=need,
+                            pages_free=budget)
                     break  # the chosen head waits for pages; nobody jumps it
                 budget -= need
             self._queues[cls].pop(idx)
@@ -497,6 +534,12 @@ class SlotScheduler:
             if req.submit_time is not None:
                 self._note_wait(req.priority,
                                 max(now - req.submit_time, 0.0))
+            if self.tracer is not None:
+                # the wait phase (queue or preempted park) ends exactly at
+                # the grant instant — the engine's prefill span begins at
+                # the same `now`, so the trace phases tile without gaps
+                self.tracer.end(self._qspans.pop(req.request_id, None),
+                                t=now, slot=slot)
             grants.append((slot, req))
         return grants
 
@@ -517,6 +560,16 @@ class SlotScheduler:
         self._keys.pop(request.request_id, None)
         self._cancel_requested.discard(request.request_id)
         return slot
+
+    def trace_abort(self, now: Optional[float] = None) -> None:
+        """Seal every still-open wait span (engine teardown / replica
+        death): an aborted span in the ring beats an open span lost with
+        the process — the failover trace keeps its pre-crash coverage."""
+        if self.tracer is None:
+            return
+        now = time.monotonic() if now is None else now
+        for rid in list(self._qspans):
+            self.tracer.end(self._qspans.pop(rid), t=now, aborted=True)
 
     # -- load estimators ---------------------------------------------------
 

@@ -1,146 +1,19 @@
-"""Chrome-trace host timeline + device profiler hooks.
+"""Chrome-trace host timeline + device profiler hooks — re-export shim.
 
-Replaces the reference's ``utils/timeline.py`` (Chrome trace-event writer
-whose ``mark_step_end`` gathers per-rank events over gloo and appends JSON on
-rank 0, ``:89-123``) and its PP instrumentation (``pipeline/timeline.py``).
-On TPU the device side is covered by ``jax.profiler`` (xplane traces for
-tensorboard); this module covers the *host-side task* timeline — scheduler
-steps, checkpoint waves, data stalls — in the ``chrome://tracing`` /
-Perfetto JSON format.
-
-Single-controller JAX has no per-rank gather: every process appends its own
-events tagged ``pid = process_index`` to its own file (or one file when
-single-process), which Perfetto merges natively.
+The implementation moved to :mod:`neuronx_distributed_tpu.obs.tracing`
+(the distributed-tracing PR unified the trainer's Chrome-trace writer with
+the serving stack's request-lifecycle span tracer, so both emit through
+one Perfetto serialization).  This module re-exports the historical names
+so trainer callers (``fit(timeline=...)``, the obs hub, the tools) are
+untouched.
 """
 
-from __future__ import annotations
+from neuronx_distributed_tpu.obs.tracing import (  # noqa: F401
+    Timeline,
+    append_chrome_events,
+    device_trace,
+    write_chrome_trace,
+)
 
-import json
-import os
-import threading
-import time
-from contextlib import contextmanager
-from typing import Optional
-
-import jax
-
-from neuronx_distributed_tpu.utils.logger import get_logger
-
-logger = get_logger(__name__)
-
-
-class Timeline:
-    """Buffered Chrome trace-event recorder.
-
-    Events are complete ("X") records with microsecond timestamps; flushes
-    are explicit (``mark_step_end``) so the hot loop never touches the
-    filesystem — the same discipline as the reference's step-end gather.
-    """
-
-    def __init__(self, trace_file_path: Optional[str], category: str = "host"):
-        self.category = category
-        self.enabled = trace_file_path is not None
-        self._open_events: dict = {}
-        self._buffer: list = []
-        self._lock = threading.Lock()
-        self._wrote_header = False
-        if self.enabled:
-            # one file per process: multi-host jobs on a shared filesystem
-            # must not clobber each other's traces
-            if jax.process_count() > 1:
-                root, ext = os.path.splitext(trace_file_path)
-                trace_file_path = f"{root}.proc{jax.process_index()}{ext or '.json'}"
-            os.makedirs(os.path.dirname(os.path.abspath(trace_file_path)), exist_ok=True)
-        self.path = trace_file_path
-
-    @staticmethod
-    def _now_us() -> float:
-        # wall clock (not perf_counter): cross-host merges need a shared
-        # epoch, and NTP-synced wall time is the best host-side option
-        return time.time_ns() / 1e3
-
-    def mark_event_start(self, name: str) -> None:
-        if not self.enabled:
-            return
-        with self._lock:
-            # key by (name, thread): same-named regions may run concurrently
-            # on prefetch/worker threads
-            self._open_events[(name, threading.get_ident())] = self._now_us()
-
-    def mark_event_end(self, name: str) -> None:
-        if not self.enabled:
-            return
-        tid = threading.get_ident()
-        with self._lock:
-            start = self._open_events.pop((name, tid), None)
-            if start is None:
-                logger.warning("timeline: end without start for %r", name)
-                return
-            self._buffer.append(
-                {
-                    "name": name,
-                    "cat": self.category,
-                    "ph": "X",
-                    "ts": start,
-                    "dur": self._now_us() - start,
-                    "pid": jax.process_index(),
-                    "tid": tid % 2**31,
-                }
-            )
-
-    @contextmanager
-    def event(self, name: str):
-        self.mark_event_start(name)
-        try:
-            yield
-        finally:
-            self.mark_event_end(name)
-
-    def instant(self, name: str, **args) -> None:
-        """Zero-duration marker (e.g. 'step boundary')."""
-        if not self.enabled:
-            return
-        with self._lock:
-            self._buffer.append(
-                {
-                    "name": name,
-                    "cat": self.category,
-                    "ph": "i",
-                    "s": "p",
-                    "ts": self._now_us(),
-                    "pid": jax.process_index(),
-                    "tid": 0,
-                    "args": args,
-                }
-            )
-
-    def mark_step_end(self, step: Optional[int] = None) -> None:
-        """Flush buffered events to the trace file (JSON-array format that
-        Perfetto accepts without a closing bracket)."""
-        if not self.enabled:
-            return
-        if step is not None:
-            self.instant("step_end", step=step)
-        with self._lock:
-            events, self._buffer = self._buffer, []
-            if not events:
-                return
-            mode = "a" if self._wrote_header else "w"
-            with open(self.path, mode) as f:
-                if not self._wrote_header:
-                    f.write("[\n")
-                    self._wrote_header = True
-                for e in events:
-                    f.write(json.dumps(e) + ",\n")
-
-
-@contextmanager
-def device_trace(log_dir: str):
-    """Capture an XLA device profile (tensorboard xplane) for the enclosed
-    region — the TPU-side replacement for the Neuron profiling tools the
-    reference delegates to (SURVEY §5.1)."""
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+__all__ = ["Timeline", "device_trace", "append_chrome_events",
+           "write_chrome_trace"]

@@ -31,7 +31,7 @@ def test_schema_list_is_complete():
     assert {"scalars", "flight_record", "flight_step", "anomaly",
             "hlo_audit", "tpu_watch", "obs_report",
             "serving_stats", "supervisor_event",
-            "router_stats"} <= set(SCHEMAS)
+            "router_stats", "trace_event"} <= set(SCHEMAS)
 
 
 def test_committed_tpu_watch_results_validate():
@@ -120,17 +120,21 @@ def test_serving_stats_schema(tmp_path):
          "new_tokens": 8, "queue_ms": 0.5, "ttft_ms": 12.0, "total_ms": 40.0,
          "spec_proposed": 12, "spec_accepted": 9, "acceptance_rate": 0.75,
          "adapter_id": 0, "priority": "interactive", "deadline_s": None,
-         "queue_wait_ms": 0.5, "preemptions": 0, "shed_reason": None},
-        # a non-speculative, multi-tenant, batch-tier record (v4 SLO
-        # fields): served under LoRA adapter 3, preempted once, shed at the
-        # pre-prefill expiry check
+         "queue_wait_ms": 0.5, "preemptions": 0, "shed_reason": None,
+         "mono": 100.25, "decode_steps": 4, "prefill_chunks": 0,
+         "preempted_ms": 0.0, "trace_id": None},
+        # a non-speculative, multi-tenant, batch-tier record: served under
+        # LoRA adapter 3, preempted once, shed at the pre-prefill expiry
+        # check, linked into trace_events.jsonl via trace_id (v5)
         {"schema": SERVING_STATS_SCHEMA, "time": 2.0, "request_id": 1,
          "state": "timed_out", "finish_reason": "timed_out", "prompt_len": 3,
          "new_tokens": 0, "queue_ms": 100.0, "ttft_ms": None,
          "total_ms": 100.0, "spec_proposed": 0, "spec_accepted": 0,
          "acceptance_rate": None, "adapter_id": 3, "priority": "batch",
          "deadline_s": 0.25, "queue_wait_ms": 100.0, "preemptions": 1,
-         "shed_reason": "expired_before_prefill"},
+         "shed_reason": "expired_before_prefill",
+         "mono": 101.5, "decode_steps": 0, "prefill_chunks": 2,
+         "preempted_ms": 40.0, "trace_id": 1},
     ]
     path = tmp_path / "serving_stats.jsonl"
     with open(path, "w") as f:
@@ -149,6 +153,15 @@ def test_serving_stats_schema(tmp_path):
                   "shed_reason"):
             v3.pop(f)
         validate_record("serving_stats", v3)
+    with pytest.raises(ValueError, match="missing required field"):
+        # a v4-shaped record (no tracing fields) no longer validates against
+        # the live-emitter floor — but obs.report still READS it (the
+        # version-tolerant reader is covered in tests/test_tracing.py)
+        v4 = dict(recs[0])
+        for f in ("mono", "decode_steps", "prefill_chunks", "preempted_ms",
+                  "trace_id"):
+            v4.pop(f)
+        validate_record("serving_stats", v4)
 
     # the SLO counters/per-class histograms are declared with their kinds,
     # and a live SLO-serving registry validates + grows the report line
@@ -341,3 +354,32 @@ def test_validate_record_rejects_bad_records():
     with pytest.raises(ValueError, match="bool"):
         validate_record("scalars",
                         {"step": 1, "tag": "x", "value": True, "time": 0.0})
+
+
+def test_trace_events_schema(tmp_path):
+    """trace_events.jsonl smoke: the Tracer's own export validates against
+    the checked-in trace_event schema (the live serving-engine emitter path
+    is covered end-to-end in tests/test_tracing.py), and hand-built records
+    missing either clock stamp are rejected."""
+    from neuronx_distributed_tpu.obs import Tracer
+
+    tr = Tracer()
+    root = tr.begin("request", request_id=7, priority="interactive")
+    q = tr.begin("queue", request_id=7, parent=root)
+    tr.end(q, slot=0)
+    tr.end(root, state="finished")
+    path = tmp_path / "trace_events.jsonl"
+    assert tr.export_jsonl(str(path)) == 2
+    assert validate_jsonl("trace_event", str(path)) == 2
+    recs = [json.loads(l) for l in open(path)]
+    assert recs[0]["name"] == "queue" and recs[0]["parent_id"] is not None
+    # both clocks on every span: wall ts for cross-host merges, monotonic
+    # mono for skew-free ordering
+    for r in recs:
+        assert r["mono"] == r["t_start"] and "ts" in r
+    with pytest.raises(ValueError, match="missing required field"):
+        bad = dict(recs[0])
+        bad.pop("mono")
+        validate_record("trace_event", bad)
+    with pytest.raises(ValueError, match="expected"):
+        validate_record("trace_event", dict(recs[0], attrs=None))

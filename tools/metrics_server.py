@@ -1,0 +1,101 @@
+"""metrics_server — scrape endpoint over a run's telemetry.
+
+The ``MetricRegistry`` has serialized Prometheus text since the first obs
+PR; this CLI finally puts it on the wire.  Two sources:
+
+- a run directory / scalars file: re-exposes the latest ``scalars.jsonl``
+  snapshot as ``/metrics`` (counters/gauges typed via the checked-in
+  ``REGISTRY_METRICS`` contract, histogram-flattened tags reassembled into
+  ``_bucket``/``_sum``/``_count`` lines).  The file is re-read per scrape,
+  so a still-appending run serves fresh numbers;
+- live in-process registries attach through the library half instead
+  (``obs.metrics_server.MetricsServer`` — see ``runner.py serve
+  --metrics-port N``, which also wires a real ``/healthz``).
+
+``/healthz`` here reports file freshness: ``ok`` is false when the scalars
+file is missing.
+
+Usage:
+    python tools/metrics_server.py --run-dir /runs/r1/obs --port 9100
+    python tools/metrics_server.py --scalars scalars.jsonl --print
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable as `python tools/metrics_server.py`
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--run-dir", default=None,
+                   help="obs run dir holding scalars.jsonl")
+    p.add_argument("--scalars", default=None,
+                   help="explicit scalars.jsonl path (overrides --run-dir)")
+    p.add_argument("--port", type=int, default=9100)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--print", action="store_true", dest="print_once",
+                   help="render the Prometheus text once to stdout and "
+                        "exit (no server) — the scriptable/test mode")
+    args = p.parse_args(argv)
+
+    from neuronx_distributed_tpu.obs import SCALARS_FILE
+    from neuronx_distributed_tpu.obs.metrics_server import (
+        MetricsServer,
+        prometheus_from_scalars,
+    )
+
+    path = args.scalars
+    if path is None:
+        if args.run_dir is None:
+            p.error("pass --run-dir or --scalars")
+        path = os.path.join(args.run_dir, SCALARS_FILE)
+
+    def read_records():
+        import json
+
+        recs = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    recs.append(json.loads(line))
+        return recs
+
+    def text():
+        return prometheus_from_scalars(read_records())
+
+    if args.print_once:
+        sys.stdout.write(text())
+        return 0
+
+    def health():
+        ok = os.path.exists(path)
+        doc = {"ok": ok, "scalars": path}
+        if ok:
+            doc["age_s"] = round(time.time() - os.path.getmtime(path), 1)
+        return doc
+
+    server = MetricsServer(text_fn=text, health_fn=health, port=args.port,
+                           host=args.host)
+    print(f"metrics_server: http://{args.host}:{server.port}/metrics "
+          f"(and /healthz) over {path}; ctrl-c to stop", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

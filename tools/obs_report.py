@@ -15,6 +15,13 @@ Usage:
     python tools/obs_report.py --run-dir /runs/r1/obs
     python tools/obs_report.py --run-dir obs/ --scalar-dir /tb/run1 \
         --timeline trace.json --out report.json --markdown report.md
+    python tools/obs_report.py --trace trace_events.jsonl \
+        --serving-stats serving_stats.jsonl --markdown report.md
+
+The ``--trace`` section reconstructs per-request waterfalls from the
+serving stack's ``trace_events.jsonl`` spans (queue / prefill / decode /
+preempted milliseconds, failover hops, top-5 slowest requests), linked to
+their terminal ``serving_stats`` records via ``trace_id``.
 """
 
 from __future__ import annotations
@@ -47,6 +54,15 @@ def main(argv=None) -> int:
                    help="supervisor_events.jsonl path (restarts / crash "
                         "causes / time-to-recover; auto-detected in "
                         "--run-dir)")
+    p.add_argument("--trace", action="append", default=[],
+                   help="trace_events.jsonl file (repeatable; auto-detected "
+                        "in --run-dir) — builds the per-request waterfall "
+                        "section (queue/prefill/decode/preempted ms, top-5 "
+                        "slowest with their span breakdown)")
+    p.add_argument("--serving-stats", default=None,
+                   help="serving_stats.jsonl path (v4 or v5; auto-detected "
+                        "in --run-dir) — links trace waterfalls to their "
+                        "terminal records via trace_id")
     p.add_argument("--tail", type=int, default=10,
                    help="flight-record tail length in the summary")
     p.add_argument("--out", default=None, help="write JSON here (default stdout)")
@@ -54,7 +70,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if not (args.run_dir or args.scalar_dir or args.scalars or args.flight
-            or args.hlo_audit or args.timeline or args.supervisor_events):
+            or args.hlo_audit or args.timeline or args.supervisor_events
+            or args.trace):
         p.error("nothing to report on: pass --run-dir or explicit artifact paths")
 
     from neuronx_distributed_tpu.obs.report import build_report, render_markdown
@@ -75,6 +92,8 @@ def main(argv=None) -> int:
         hlo_audit_path=args.hlo_audit,
         timeline_paths=args.timeline,
         supervisor_events_path=args.supervisor_events,
+        trace_paths=args.trace,
+        serving_stats_path=args.serving_stats,
         tail=args.tail,
     )
     validate_record("obs_report", report)  # the emitter honors its own schema

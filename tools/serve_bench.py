@@ -36,6 +36,12 @@ Three modes:
   percentiles, chunk and preemption counts.  rc 1 unless the SLO engine
   holds interactive inter-token p99 within 2x the baseline WHILE the
   control spikes past that bound.
+
+``--trace-out DIR`` (engine rungs: `--continuous`, `--slo`) attaches a
+request-lifecycle tracer to every measured engine and drops one
+schema-checked `<rung>.trace_events.jsonl` + one Perfetto-loadable
+`<rung>.trace.json` per rung — the per-request waterfall evidence
+`tools/obs_report.py --trace` renders.
 """
 
 from __future__ import annotations
@@ -53,6 +59,35 @@ def _percentiles(values, ps=(50, 99)):
     from neuronx_distributed_tpu.serving.driver import percentiles
 
     return percentiles(values, ps)
+
+
+def _make_tracer(args):
+    """A fresh request-lifecycle tracer when ``--trace-out`` is set (one
+    per rung, so each dropped file is self-contained), else None — the
+    zero-overhead default."""
+    if not getattr(args, "trace_out", None):
+        return None
+    from neuronx_distributed_tpu.obs import Tracer
+
+    return Tracer()
+
+
+def _export_trace(tracer, args, label: str) -> dict:
+    """Drop the rung's trace pair under ``--trace-out`` — a schema-checked
+    ``<label>.trace_events.jsonl`` and a Perfetto-loadable
+    ``<label>.trace.json`` — and return their paths for the JSON line."""
+    if tracer is None:
+        return {}
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    os.makedirs(args.trace_out, exist_ok=True)
+    ev = os.path.join(args.trace_out, f"{label}.trace_events.jsonl")
+    ch = os.path.join(args.trace_out, f"{label}.trace.json")
+    tracer.export_jsonl(ev)
+    tracer.export_chrome(ch)
+    validate_jsonl("trace_event", ev)  # the emitter honors its own schema
+    return {"trace_events": os.path.abspath(ev),
+            "trace_perfetto": os.path.abspath(ch)}
 
 
 def run_continuous(args, model, vocab_size: int) -> dict:
@@ -100,7 +135,9 @@ def run_continuous(args, model, vocab_size: int) -> dict:
         tempfile.mkdtemp(prefix="serve_bench_"), "serving_stats.jsonl")
     if os.path.exists(stats_path):
         os.remove(stats_path)
-    engine = ServingEngine(model, registry=registry, stats_path=stats_path)
+    tracer = _make_tracer(args)
+    engine = ServingEngine(model, registry=registry, stats_path=stats_path,
+                           tracer=tracer)
     t0 = time.monotonic()
     outputs = replay_trace(
         engine, arrivals,
@@ -108,6 +145,7 @@ def run_continuous(args, model, vocab_size: int) -> dict:
                  max_new_tokens=args.max_new_tokens) for i in range(n)])
     t_cont = time.monotonic() - t0
     engine.close()
+    trace_paths = _export_trace(tracer, args, "continuous")
 
     n_stats = validate_jsonl("serving_stats", stats_path)
     assert n_stats == n, f"expected {n} serving_stats records, got {n_stats}"
@@ -144,6 +182,7 @@ def run_continuous(args, model, vocab_size: int) -> dict:
         "finished": sum(1 for o in outputs.values() if o.state == "finished"),
         "stats_records": n_stats,
         "stats_path": os.path.abspath(stats_path),
+        **trace_paths,
     }
 
 
@@ -615,10 +654,13 @@ def run_slo(args, module, params, cfg, icfg) -> int:
         warm.run_until_complete(max_steps=2000)
         warm.close()
         del warm
-        engine = ServingEngine(model, registry=MetricRegistry(), **kw)
+        tracer = _make_tracer(args)
+        engine = ServingEngine(model, registry=MetricRegistry(),
+                               tracer=tracer, **kw)
         arrivals, requests = trace(with_long, batch_tier=mode == "slo")
         outputs, wall, peak = _drive_workload(engine, arrivals, requests)
         engine.close()
+        trace_paths = _export_trace(tracer, args, f"slo_{mode}")
         snap = engine.registry.snapshot()
         inter_i = [ms for o in outputs.values() if o.request_id < LONG_BASE
                    for ms in o.intertoken_ms]
@@ -644,6 +686,7 @@ def run_slo(args, module, params, cfg, icfg) -> int:
             "goodput_tok_s": total_tokens / max(wall, 1e-9),
             "wall_s": round(wall, 4),
             "max_concurrent": peak,
+            **trace_paths,
         }
 
     base_cfg = {"config": {"batch": B, "context": C, "max_total": T,
@@ -997,6 +1040,12 @@ def main() -> int:
                    help="Poisson arrival rate, requests/s")
     p.add_argument("--stats-out", default=None,
                    help="serving_stats.jsonl path (continuous mode)")
+    p.add_argument("--trace-out", default=None,
+                   help="directory to drop request-lifecycle trace "
+                        "artifacts into (engine rungs: --continuous and "
+                        "--slo): one schema-checked "
+                        "<rung>.trace_events.jsonl + one Perfetto "
+                        "<rung>.trace.json per measured engine")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 

@@ -111,8 +111,36 @@ def measure(attn: str, batch: int, remat: str, loss: str) -> dict:
             "error": f"rc={proc.returncode}: {tail[:400]}"}
 
 
+def _validate_trace_dir(trace_dir: str) -> tuple:
+    """Post-hook for the serving_trace job: every dropped
+    ``*.trace_events.jsonl`` must validate against the checked-in
+    ``trace_event`` schema and be non-empty.  Returns ``(ok, detail)``."""
+    import glob
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    files = sorted(glob.glob(os.path.join(trace_dir, "*.trace_events.jsonl")))
+    if not files:
+        return False, f"no trace_events artifacts in {trace_dir}"
+    counts = {}
+    for f in files:
+        try:
+            n = validate_jsonl("trace_event", f)
+        except ValueError as e:
+            return False, f"{os.path.basename(f)}: {e}"
+        if n == 0:
+            return False, f"{os.path.basename(f)}: empty trace"
+        counts[os.path.basename(f)] = n
+    return True, counts
+
+
 def run_extra_jobs(results_path: str) -> None:
     """One-shot jobs that ride the first healthy window (VERDICT r3 #6)."""
+    import tempfile
+
+    trace_dir = tempfile.mkdtemp(prefix="tpu_watch_trace_")
     jobs = [
         ("tp_allreduce", [sys.executable, os.path.join(REPO, "tools", "ici_bench.py")]),
         ("serving_latency", [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")]),
@@ -134,6 +162,13 @@ def run_extra_jobs(results_path: str) -> None:
         ("serving_slo", [sys.executable,
                          os.path.join(REPO, "tools", "serve_bench.py"),
                          "--slo"]),
+        # request-lifecycle tracing: the --slo rung with a tracer attached
+        # to every measured engine — each rung drops a Perfetto file + a
+        # trace_events.jsonl that must validate against the checked-in
+        # schema (asserted by the post-hook below)
+        ("serving_trace", [sys.executable,
+                           os.path.join(REPO, "tools", "serve_bench.py"),
+                           "--slo", "--trace-out", trace_dir]),
         # multi-replica fleet rungs (serving/fleet/ subsystem): N-replica
         # goodput scaling, affinity-vs-random aggregate prefix-hit rate
         # (rc 1 when affinity does not beat random), zero-loss failover
@@ -186,10 +221,24 @@ def run_extra_jobs(results_path: str) -> None:
                         break
                     except json.JSONDecodeError:
                         continue
-            append(results_path, {"kind": name, "ok": proc.returncode == 0,
-                                  "result": payload,
-                                  "error": None if proc.returncode == 0 else
-                                  " | ".join((proc.stderr or "").splitlines()[-3:])})
+            ok = proc.returncode == 0
+            error = (None if ok else
+                     " | ".join((proc.stderr or "").splitlines()[-3:]))
+            if name == "serving_trace":
+                # the trace job's gate is the ARTIFACT, not just the rc:
+                # every dropped trace must be schema-valid and non-empty.
+                # Validation runs regardless of the bench rc — a perf-gate
+                # rc 1 still dropped traces, and THEY are what this job
+                # certifies
+                trace_ok, detail = _validate_trace_dir(trace_dir)
+                if trace_ok:
+                    payload = {"trace_records": detail, **(payload or {})}
+                else:
+                    error = (f"trace validation: {detail}"
+                             + (f" | bench: {error}" if error else ""))
+                ok = ok and trace_ok
+            append(results_path, {"kind": name, "ok": ok,
+                                  "result": payload, "error": error})
         except subprocess.TimeoutExpired:
             append(results_path, {"kind": name, "ok": False, "error": "timeout"})
 
