@@ -50,12 +50,23 @@ from neuronx_distributed_tpu.obs.flight import (
     ThroughputRegressionDetector,
     default_detectors,
 )
+from neuronx_distributed_tpu.obs.compile_ledger import (
+    COMPILE_LEDGER_FILE,
+    CompileLedger,
+    read_compile_ledger,
+    summarize_compile_records,
+)
 from neuronx_distributed_tpu.obs.hlo_audit import (
     append_audit,
     collective_bytes,
     collective_counts,
     comm_audit,
     read_audits,
+)
+from neuronx_distributed_tpu.obs.memory_ledger import (
+    MEMORY_BREAKDOWN_FILE,
+    MemoryLedger,
+    read_memory_breakdown,
 )
 from neuronx_distributed_tpu.obs.registry import (
     Counter,
@@ -112,6 +123,7 @@ class Observability:
         detectors: Optional[list] = None,
         timeline: Any = None,
         registry: Optional[MetricRegistry] = None,
+        ledgers: bool = False,
     ):
         self.out_dir = out_dir
         os.makedirs(out_dir, exist_ok=True)
@@ -128,6 +140,22 @@ class Observability:
             timeline=timeline,
             registry=self.registry,
         )
+        # resource ledgers (ledgers=True): compile accounting streamed to
+        # compile_ledger.jsonl + per-subsystem memory watermarks with OOM
+        # forensics into memory_breakdown.json — fit() threads them through
+        # the train-step compile and its crash handler.  Off by default:
+        # every consumer guards on `is not None`, so the hot path stays
+        # allocation-free.
+        self.compile_ledger: Optional[CompileLedger] = None
+        self.memory_ledger: Optional[MemoryLedger] = None
+        if ledgers:
+            self.memory_ledger = MemoryLedger(
+                registry=self.registry,
+                path=os.path.join(out_dir, MEMORY_BREAKDOWN_FILE))
+            self.compile_ledger = CompileLedger(
+                path=os.path.join(out_dir, COMPILE_LEDGER_FILE),
+                registry=self.registry, flight=self.flight,
+                memory_ledger=self.memory_ledger)
         self._last_step = 0
         self._closed = False
         # pre-declare the step metrics so a zero-step run still exports them
@@ -192,6 +220,12 @@ class Observability:
         self._closed = True
         self.dump_scalars()
         self.dump_flight(reason)
+        if self.memory_ledger is not None:
+            try:
+                self.memory_ledger.poll_device()
+                self.memory_ledger.dump(reason=reason)
+            except OSError as e:  # telemetry IO must never mask the exit
+                logger.warning("obs: memory breakdown dump failed: %s", e)
         with open(self.prometheus_path, "w") as f:
             f.write(self.registry.prometheus_text())
 
@@ -205,6 +239,13 @@ class Observability:
 __all__ = [
     "Observability",
     "MetricRegistry",
+    "CompileLedger",
+    "MemoryLedger",
+    "read_compile_ledger",
+    "read_memory_breakdown",
+    "summarize_compile_records",
+    "COMPILE_LEDGER_FILE",
+    "MEMORY_BREAKDOWN_FILE",
     "Counter",
     "Gauge",
     "Histogram",

@@ -1,0 +1,274 @@
+"""Memory ledger: per-subsystem device-byte accounting + OOM forensics.
+
+Every HBM consumer in this framework is sized blind today: the KV page
+pool, the adapter pool, the draft model's contiguous caches, the params
+and optimizer state, and each compiled program's workspace all carve the
+same 16 GB, and the first time their sum is computed is the
+RESOURCE_EXHAUSTED traceback.  :class:`MemoryLedger` is the accounting:
+
+- **logical accounting first** (works everywhere, CPU mesh included):
+  each subsystem reports its bytes (``set``/``account_tree``), exported
+  live as ``mem/<subsystem>_bytes`` gauges with ``mem/<subsystem>_peak_
+  bytes`` watermarks — pool sizes are the same ``page_bytes``-derived
+  arithmetic the admission gates use, so the gauges' sum IS the sizing
+  model;
+- **device truth where the backend offers it**: :meth:`poll_device`
+  reads ``device.memory_stats()`` (TPU/GPU) into ``mem/device_*`` gauges
+  and falls back to a ``jax.live_arrays()`` sweep — the drift between
+  the logical sum and the device number is the unaccounted residue;
+- **per-program workspace** from the compile ledger's
+  ``memory_analysis`` stats: the largest temp allocation across compiled
+  programs is the ``workspace`` subsystem (the transient HBM a step
+  needs on top of the resident pools);
+- **OOM forensics**: :meth:`oom_dump` turns a RESOURCE_EXHAUSTED
+  anywhere in fit/serve into a ``memory_breakdown.json`` naming the
+  biggest holders — the artifact the post-mortem starts from instead of
+  a dead process.
+
+Ledger-off is allocation-free: every call site guards on
+``memory_ledger is not None`` (the hot path never even builds the
+argument tuples).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+MEMORY_BREAKDOWN_FILE = "memory_breakdown.json"
+MEMORY_BREAKDOWN_SCHEMA = "memory_breakdown/1"
+
+# substrings that mark an allocator exhaustion across backends (PJRT TPU,
+# CPU host allocator, CUDA) — the signal that triggers the forensics dump
+_OOM_MARKS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED", "Out of memory",
+              "out of memory", "OOM", "Allocation failure")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Does this exception look like device-memory exhaustion?"""
+    text = f"{type(exc).__name__}: {exc}"
+    return any(m in text for m in _OOM_MARKS)
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total array bytes across a pytree (logical: ``x.nbytes`` — for a
+    sharded array this is the GLOBAL footprint; divide by shard count
+    outside if per-device numbers are wanted)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        n = getattr(leaf, "nbytes", None)
+        if n is not None:
+            total += int(n)
+    return total
+
+
+class MemoryLedger:
+    """Per-subsystem byte accounting with live gauges, peak watermarks,
+    device polling, and the OOM breakdown dump.
+
+    ``registry`` (an ``obs.MetricRegistry``) receives ``mem/*`` gauges;
+    ``path`` is the default ``memory_breakdown.json`` location for
+    :meth:`dump` / :meth:`oom_dump`.  Both optional and attachable late.
+    """
+
+    def __init__(self, registry: Any = None, path: Optional[str] = None,
+                 wall=time.time):
+        self.registry = registry
+        self.path = path
+        self._wall = wall
+        # name -> {"bytes": int, "peak_bytes": int}
+        self._sub: Dict[str, dict] = {}
+        # program family -> {"temp_size_in_bytes": .., "output_...": ..}
+        self.programs: Dict[str, dict] = {}
+        self._device: Optional[dict] = None
+
+    # -- accounting --------------------------------------------------------
+
+    def set(self, subsystem: str, nbytes: int) -> None:
+        """Set a subsystem's current bytes; peaks are tracked and both are
+        exported as gauges when a registry is attached."""
+        nbytes = int(nbytes)
+        s = self._sub.get(subsystem)
+        if s is None:
+            s = {"bytes": 0, "peak_bytes": 0}
+            self._sub[subsystem] = s
+        s["bytes"] = nbytes
+        s["peak_bytes"] = max(s["peak_bytes"], nbytes)
+        reg = self.registry
+        if reg is not None:
+            reg.gauge(f"mem/{subsystem}_bytes").set(float(nbytes))
+            reg.gauge(f"mem/{subsystem}_peak_bytes").set(
+                float(s["peak_bytes"]))
+
+    def add(self, subsystem: str, nbytes: int) -> None:
+        """Adjust a subsystem by a delta (pools that grow/shrink)."""
+        cur = self._sub.get(subsystem, {"bytes": 0})["bytes"]
+        self.set(subsystem, cur + int(nbytes))
+
+    def account_tree(self, subsystem: str, tree: Any) -> int:
+        """Account a pytree's array bytes as a subsystem; returns them."""
+        n = tree_bytes(tree)
+        self.set(subsystem, n)
+        return n
+
+    def note_program(self, family: str, info: dict) -> None:
+        """Per-program temp/output bytes from the compile ledger's
+        ``memory_analysis`` stats; the max temp across programs becomes
+        the ``workspace`` subsystem (the transient HBM one step needs on
+        top of the resident pools)."""
+        keep = {k: float(v) for k, v in info.items()
+                if k in ("argument_size_in_bytes", "output_size_in_bytes",
+                         "temp_size_in_bytes") and v is not None}
+        if not keep:
+            return
+        prev = self.programs.get(family, {})
+        self.programs[family] = {
+            k: max(keep.get(k, 0.0), prev.get(k, 0.0))
+            for k in set(keep) | set(prev)}
+        workspace = max((p.get("temp_size_in_bytes", 0.0)
+                         for p in self.programs.values()), default=0.0)
+        if workspace:
+            self.set("workspace", int(workspace))
+
+    # -- device truth ------------------------------------------------------
+
+    def poll_device(self) -> Optional[dict]:
+        """Best-effort device-memory truth: ``device.memory_stats()`` where
+        the backend supports it (TPU/GPU), else a ``jax.live_arrays()``
+        byte sweep, else None (pure-logical accounting).  Exports
+        ``mem/device_*`` gauges and remembers the snapshot for
+        :meth:`breakdown`."""
+        try:
+            import jax
+
+            dev = jax.local_devices()[0]
+        except Exception:
+            return None
+        stats = None
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # pragma: no cover - backend-dependent
+            stats = None
+        out: Dict[str, float] = {}
+        if stats:
+            for src, name in (("bytes_in_use", "device_bytes_in_use"),
+                              ("peak_bytes_in_use", "device_peak_bytes"),
+                              ("bytes_limit", "device_bytes_limit")):
+                v = stats.get(src)
+                if v is not None:
+                    out[name] = float(v)
+        if not out:
+            try:
+                out["live_array_bytes"] = float(sum(
+                    getattr(x, "nbytes", 0) for x in jax.live_arrays()))
+            except Exception:  # pragma: no cover
+                return None
+        reg = self.registry
+        if reg is not None:
+            for name, v in out.items():
+                reg.gauge(f"mem/{name}").set(v)
+        self._device = out
+        return out
+
+    def headroom_bytes(self) -> Optional[int]:
+        """Device HBM headroom (limit - in use) from the last poll, when
+        the backend reports both; None otherwise (callers fall back to
+        their pool's logical free bytes)."""
+        d = self._device
+        if not d or "device_bytes_limit" not in d \
+                or "device_bytes_in_use" not in d:
+            return None
+        return int(d["device_bytes_limit"] - d["device_bytes_in_use"])
+
+    # -- views -------------------------------------------------------------
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(s["bytes"] for s in self._sub.values())
+
+    @property
+    def peak_total_bytes(self) -> int:
+        return sum(s["peak_bytes"] for s in self._sub.values())
+
+    def subsystems(self) -> Dict[str, dict]:
+        return {k: dict(v) for k, v in self._sub.items()}
+
+    def top(self, n: int = 5) -> List[list]:
+        """The biggest holders, descending — what the OOM log line names."""
+        ranked = sorted(self._sub.items(), key=lambda kv: -kv[1]["bytes"])
+        return [[name, s["bytes"]] for name, s in ranked[:n]]
+
+    def breakdown(self, reason: str = "snapshot") -> dict:
+        """The ``memory_breakdown.json`` document (``obs.schemas`` kind
+        ``memory_breakdown``)."""
+        return {
+            "schema": MEMORY_BREAKDOWN_SCHEMA,
+            "time": self._wall(),
+            "reason": reason,
+            "subsystems": self.subsystems(),
+            "total_bytes": self.total_bytes,
+            "peak_total_bytes": self.peak_total_bytes,
+            "device": self._device,
+            "programs": {k: dict(v) for k, v in self.programs.items()},
+            "top": self.top(),
+        }
+
+    # -- persistence -------------------------------------------------------
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "snapshot") -> Optional[str]:
+        """Atomically write the breakdown document; returns the path (None
+        when the ledger has no sink)."""
+        path = path or self.path
+        if path is None:
+            return None
+        doc = self.breakdown(reason)
+        parent = os.path.dirname(os.path.abspath(path))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+    def oom_dump(self, exc: BaseException,
+                 path: Optional[str] = None) -> Optional[str]:
+        """RESOURCE_EXHAUSTED forensics: when ``exc`` looks like memory
+        exhaustion, poll the device one last time, dump the breakdown, and
+        log the biggest holders.  Returns the dump path, or None when the
+        exception is not an OOM (or the ledger has no sink)."""
+        if not is_oom(exc):
+            return None
+        try:
+            self.poll_device()
+        except Exception:  # the device may be unusable mid-OOM
+            pass
+        holders = ", ".join(
+            f"{name}={nbytes / 2**20:.1f}MiB" for name, nbytes in self.top())
+        logger.error(
+            "memory ledger: OOM (%s); biggest holders: %s (logical total "
+            "%.1f MiB)", type(exc).__name__, holders or "none accounted",
+            self.total_bytes / 2**20)
+        try:
+            return self.dump(path, reason=f"oom:{type(exc).__name__}")
+        except OSError as e:  # forensics must never mask the real error
+            logger.warning("memory ledger: OOM dump failed: %s", e)
+            return None
+
+
+def read_memory_breakdown(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != MEMORY_BREAKDOWN_SCHEMA:
+        raise ValueError(f"{path}: schema {doc.get('schema')!r} != "
+                         f"{MEMORY_BREAKDOWN_SCHEMA!r}")
+    return doc

@@ -22,8 +22,17 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from neuronx_distributed_tpu.obs import FLIGHT_FILE, HLO_AUDIT_FILE, SCALARS_FILE
+from neuronx_distributed_tpu.obs.compile_ledger import (
+    COMPILE_LEDGER_FILE,
+    read_compile_ledger,
+    summarize_compile_records,
+)
 from neuronx_distributed_tpu.obs.flight import read_flight
 from neuronx_distributed_tpu.obs.hlo_audit import read_audits
+from neuronx_distributed_tpu.obs.memory_ledger import (
+    MEMORY_BREAKDOWN_FILE,
+    read_memory_breakdown,
+)
 from neuronx_distributed_tpu.obs.registry import read_histograms
 from neuronx_distributed_tpu.obs.tracing import (
     PHASE_NAMES,
@@ -31,9 +40,12 @@ from neuronx_distributed_tpu.obs.tracing import (
     read_trace_events,
 )
 
-# v2 (tracing PR): the document gains the required "trace" section
-# (per-request waterfalls from trace_events.jsonl; null when no trace)
-OBS_REPORT_SCHEMA = "obs_report_v2"
+# v2 (tracing PR): the document gained the required "trace" section
+# (per-request waterfalls from trace_events.jsonl; null when no trace).
+# v3 (resource-ledger PR): required "compile" (compile_ledger.jsonl
+# rollup) and "memory" (mem/* gauges + memory_breakdown.json) sections,
+# both null when the run carried no ledger.
+OBS_REPORT_SCHEMA = "obs_report_v3"
 SUPERVISOR_EVENTS_FILE = "supervisor_events.jsonl"
 SERVING_STATS_FILE = "serving_stats.jsonl"
 
@@ -353,6 +365,164 @@ def _summarize_slo(scalars: Dict[str, dict],
     }
 
 
+def _summarize_compile(scalars: Dict[str, dict],
+                       ledger_records: List[dict],
+                       histograms: Dict[str, dict]) -> Optional[dict]:
+    """The "compile" health section: the compile ledger's rollup (per-
+    family compiles / cold wall-time / distinct keys / evictions, storm and
+    thrash counts) joined with the live ``trace/compile*`` scalars.  None
+    when the run carried no compile ledger."""
+    if not ledger_records and scalars.get("trace/compiles_total") is None:
+        return None
+
+    def last(tag):
+        s = scalars.get(tag)
+        return s["last"] if s else 0.0
+
+    out = summarize_compile_records(ledger_records, cache={
+        "hits": last("trace/compiled_cache_hits_total"),
+        "misses": last("trace/compiled_cache_misses_total"),
+        "evictions": last("trace/compiled_cache_evictions_total"),
+    })
+    if not ledger_records:
+        # scalars-only view (the jsonl was not collected): keep the counts
+        out["compiles"] = last("trace/compiles_total")
+        out["storms"] = last("trace/compile_storms_total")
+        out["thrash_warnings"] = last("trace/compile_thrash_total")
+        h = histograms.get("trace/compile_ms")
+        if h:
+            out["cold_ms_total"] = round(h.get("sum", 0.0), 3)
+    return out
+
+
+def _summarize_memory(scalars: Dict[str, dict],
+                      breakdown: Optional[dict]) -> Optional[dict]:
+    """The "memory" health section: per-subsystem bytes + peak watermarks
+    from ``memory_breakdown.json`` when present, else reconstructed from
+    the live ``mem/*_bytes`` gauges.  None when the run carried no memory
+    ledger."""
+    if breakdown is not None:
+        return {
+            "subsystems": breakdown["subsystems"],
+            "total_bytes": breakdown["total_bytes"],
+            "peak_total_bytes": breakdown["peak_total_bytes"],
+            "device": breakdown.get("device"),
+            "top": breakdown.get("top", []),
+            "reason": breakdown.get("reason"),
+        }
+    subs: Dict[str, dict] = {}
+    device: Dict[str, float] = {}
+    for tag, s in scalars.items():
+        if not tag.startswith("mem/"):
+            continue
+        name = tag[len("mem/"):]
+        if name.startswith(("device_", "live_array")):
+            device[name] = s["last"]
+        elif name.endswith("_peak_bytes"):
+            subs.setdefault(name[:-len("_peak_bytes")], {})["peak_bytes"] = \
+                s["last"]
+        elif name.endswith("_bytes"):
+            subs.setdefault(name[:-len("_bytes")], {})["bytes"] = s["last"]
+    if not subs and not device:
+        return None
+    for v in subs.values():
+        v.setdefault("bytes", 0.0)
+        v.setdefault("peak_bytes", v["bytes"])
+    total = sum(v["bytes"] for v in subs.values())
+    return {
+        "subsystems": subs,
+        "total_bytes": total,
+        "peak_total_bytes": sum(v["peak_bytes"] for v in subs.values()),
+        "device": device or None,
+        "top": sorted(([k, v["bytes"]] for k, v in subs.items()),
+                      key=lambda kv: -kv[1])[:5],
+        "reason": None,
+    }
+
+
+def compare_resources(run_a: str, run_b: str,
+                      compile_threshold: float = 0.0,
+                      mem_threshold: float = 0.05) -> dict:
+    """Run-to-run compile/memory regression diff (``tools/obs_report.py
+    --compare RUN_A RUN_B``): reads each run dir's ``compile_ledger.jsonl``
+    and ``memory_breakdown.json`` and flags B against A — more compiles
+    than ``(1 + compile_threshold) * A`` (or any storm in B), or any
+    subsystem's peak bytes past ``(1 + mem_threshold) * A``'s.  Returns
+    ``{"a", "b", "compile", "memory", "regressions", "regressed",
+    "markdown"}``."""
+    def load(run_dir):
+        cl_path = os.path.join(run_dir, COMPILE_LEDGER_FILE)
+        mb_path = os.path.join(run_dir, MEMORY_BREAKDOWN_FILE)
+        compile_sum = (summarize_compile_records(read_compile_ledger(cl_path))
+                       if os.path.exists(cl_path) else None)
+        breakdown = (read_memory_breakdown(mb_path)
+                     if os.path.exists(mb_path) else None)
+        return compile_sum, breakdown
+
+    ca, ma = load(run_a)
+    cb, mb = load(run_b)
+    regressions: List[str] = []
+    lines = ["# Resource regression diff", "",
+             f"- A: `{run_a}`", f"- B: `{run_b}`", ""]
+
+    lines += ["## Compile", "",
+              "| metric | A | B |", "|---|---|---|"]
+    for key in ("compiles", "cold_ms_total", "cold_ms_max", "storms",
+                "thrash_warnings", "evictions"):
+        va = ca.get(key, 0) if ca else "n/a"
+        vb = cb.get(key, 0) if cb else "n/a"
+        lines.append(f"| {key} | {va} | {vb} |")
+    if ca and cb:
+        if cb["compiles"] > ca["compiles"] * (1.0 + compile_threshold):
+            regressions.append(
+                f"compiles regressed: {ca['compiles']} -> {cb['compiles']} "
+                f"(threshold {compile_threshold:.0%})")
+        if cb["storms"] > 0 and cb["storms"] > ca["storms"]:
+            regressions.append(
+                f"compile storms appeared: {ca['storms']} -> {cb['storms']}")
+    lines.append("")
+
+    lines += ["## Memory (peak bytes per subsystem)", "",
+              "| subsystem | A | B |", "|---|---|---|"]
+    subs_a = (ma or {}).get("subsystems", {})
+    subs_b = (mb or {}).get("subsystems", {})
+    for name in sorted(set(subs_a) | set(subs_b)):
+        pa = subs_a.get(name, {}).get("peak_bytes")
+        pb = subs_b.get(name, {}).get("peak_bytes")
+        lines.append(f"| {name} | {pa if pa is not None else 'n/a'} "
+                     f"| {pb if pb is not None else 'n/a'} |")
+        if pa and pb and pb > pa * (1.0 + mem_threshold):
+            regressions.append(
+                f"memory regressed: {name} peak {pa:,.0f} -> {pb:,.0f} "
+                f"bytes (threshold {mem_threshold:.0%})")
+        elif not pa and pb and ma is not None:
+            # a consumer with no baseline (absent or zero-peak in A) has no
+            # threshold to compare against — an arbitrarily large NEW
+            # footprint must not pass a regression gate silently
+            regressions.append(
+                f"memory regressed: new subsystem {name} appeared in B "
+                f"({pb:,.0f} peak bytes, no baseline in A)")
+    lines.append("")
+    if regressions:
+        lines += ["## Regressions", ""] + [f"- {r}" for r in regressions] \
+            + [""]
+    else:
+        lines += ["No regressions past thresholds.", ""]
+    return {
+        "a": run_a, "b": run_b,
+        "compile": {"a": ca, "b": cb},
+        "memory": {"a": ma and {k: ma[k] for k in
+                                ("subsystems", "total_bytes",
+                                 "peak_total_bytes")},
+                   "b": mb and {k: mb[k] for k in
+                                ("subsystems", "total_bytes",
+                                 "peak_total_bytes")}},
+        "regressions": regressions,
+        "regressed": bool(regressions),
+        "markdown": "\n".join(lines),
+    }
+
+
 def read_serving_stats(path: str) -> List[dict]:
     """Read a ``serving_stats.jsonl`` stream ACROSS schema versions: v4
     records (pre-tracing) lack ``decode_steps``/``prefill_chunks``/
@@ -493,6 +663,8 @@ def build_report(
     supervisor_events_path: Optional[str] = None,
     trace_paths: Sequence[str] = (),
     serving_stats_path: Optional[str] = None,
+    compile_ledger_path: Optional[str] = None,
+    memory_breakdown_path: Optional[str] = None,
     tail: int = 10,
 ) -> dict:
     """Merge the artifacts into one summary document.
@@ -527,6 +699,12 @@ def build_report(
         if serving_stats_path is None:
             q = os.path.join(run_dir, SERVING_STATS_FILE)
             serving_stats_path = q if os.path.exists(q) else None
+        if compile_ledger_path is None:
+            q = os.path.join(run_dir, COMPILE_LEDGER_FILE)
+            compile_ledger_path = q if os.path.exists(q) else None
+        if memory_breakdown_path is None:
+            q = os.path.join(run_dir, MEMORY_BREAKDOWN_FILE)
+            memory_breakdown_path = q if os.path.exists(q) else None
 
     scalar_records: List[dict] = []
     for p in scalar_paths:
@@ -564,6 +742,14 @@ def build_report(
                      if serving_stats_path
                      and os.path.exists(serving_stats_path) else [])
     trace = summarize_trace(trace_paths, stats_records)
+    ledger_records = (read_compile_ledger(compile_ledger_path)
+                      if compile_ledger_path
+                      and os.path.exists(compile_ledger_path) else [])
+    compile_section = _summarize_compile(scalars, ledger_records, histograms)
+    breakdown = (read_memory_breakdown(memory_breakdown_path)
+                 if memory_breakdown_path
+                 and os.path.exists(memory_breakdown_path) else None)
+    memory_section = _summarize_memory(scalars, breakdown)
     report = {
         "schema": OBS_REPORT_SCHEMA,
         "generated_at": time.time(),
@@ -576,6 +762,8 @@ def build_report(
             "supervisor_events": supervisor_events_path,
             "traces": trace_paths,
             "serving_stats": serving_stats_path,
+            "compile_ledger": compile_ledger_path,
+            "memory_breakdown": memory_breakdown_path,
         },
         "scalars": scalars,
         "histograms": histograms,
@@ -585,6 +773,8 @@ def build_report(
         "timeline": _summarize_timeline(timeline_paths),
         "supervisor": supervisor,
         "trace": trace,
+        "compile": compile_section,
+        "memory": memory_section,
         "health": {
             "anomaly_count": len(anomalies),
             "host_blocked": host_blocked,
@@ -593,6 +783,15 @@ def build_report(
             "fleet": fleet,
             "tenancy": tenancy,
             "slo": slo,
+            # slim rollups only — the full per-family/per-subsystem tables
+            # live once, at the top-level "compile"/"memory" sections
+            "compile": (None if compile_section is None else {
+                "compiles": compile_section["compiles"],
+                "storms": compile_section["storms"],
+                "thrash_warnings": compile_section["thrash_warnings"]}),
+            "memory": (None if memory_section is None else {
+                "total_bytes": memory_section["total_bytes"],
+                "peak_total_bytes": memory_section["peak_total_bytes"]}),
             "total_collective_count": sum(
                 a.get("total_collective_count", 0) for a in audits),
             "total_collective_bytes": sum(
@@ -685,6 +884,29 @@ def render_markdown(report: dict) -> str:
         lines.append(
             f"- speculative: {tps} over {spec['rounds']:.0f} rounds; {rate} "
             f"({spec['accepted']:.0f}/{spec['proposed']:.0f} draft tokens)")
+    comp = report.get("compile")
+    if comp:
+        cache = comp.get("cache") or {}
+        hit = (f"{cache['hit_rate']:.1%} cache hit rate"
+               if cache.get("hit_rate") is not None else "no cache lookups")
+        lines.append(
+            f"- compile: {comp['compiles']:.0f} compile(s) "
+            f"({comp.get('cold_ms_total', 0):,.0f} ms total wall); "
+            f"**{comp['storms']:.0f} storm(s)** after warmup, "
+            f"{comp['thrash_warnings']:.0f} thrash warning(s), "
+            f"{comp.get('evictions', 0):.0f} eviction(s); {hit}")
+    memh = report.get("memory")
+    if memh:
+        top = ", ".join(f"{name} {nbytes / 2**20:,.1f}MiB"
+                        for name, nbytes in memh.get("top", [])[:3])
+        dev = memh.get("device") or {}
+        used = dev.get("device_bytes_in_use")
+        device = (f"; device {used / 2**20:,.1f}MiB in use"
+                  if used is not None else "")
+        lines.append(
+            f"- memory: {memh['total_bytes'] / 2**20:,.1f} MiB accounted "
+            f"(peak {memh['peak_total_bytes'] / 2**20:,.1f} MiB); "
+            f"top holders: {top or 'none'}{device}")
     lines.append("")
 
     sup = report.get("supervisor")
@@ -749,6 +971,28 @@ def render_markdown(report: dict) -> str:
             lines.append(
                 f"- `{a['name']}`: {counts or 'no collectives'}; "
                 f"{a['total_collective_bytes']:,} bytes")
+        lines.append("")
+
+    comp = report.get("compile")
+    if comp and comp.get("families"):
+        lines += ["## Compile ledger", "",
+                  "| family | compiles | cold ms | distinct keys | "
+                  "evictions |",
+                  "|---|---|---|---|---|"]
+        for name, f in sorted(comp["families"].items()):
+            lines.append(
+                f"| {name} | {f['compiles']} | {f['cold_ms']:.1f} | "
+                f"{f['distinct_keys']} | {f['evictions']} |")
+        lines.append("")
+
+    memr = report.get("memory")
+    if memr and memr.get("subsystems"):
+        lines += ["## Memory ledger", "",
+                  "| subsystem | bytes | peak bytes |",
+                  "|---|---|---|"]
+        for name, s in sorted(memr["subsystems"].items()):
+            lines.append(f"| {name} | {s.get('bytes', 0):,.0f} | "
+                         f"{s.get('peak_bytes', 0):,.0f} |")
         lines.append("")
 
     trace = report.get("trace")

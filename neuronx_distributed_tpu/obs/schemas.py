@@ -109,14 +109,41 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     "supervisor_event": {
         "schema": str, "time": _NUM, "event": str, "attempt": int,
     },
-    # tools/obs_report.py output document; v2 adds the required "trace"
-    # key — the per-request waterfall section built from
-    # trace_events.jsonl (null when the run produced no trace)
+    # one line of compile_ledger.jsonl (obs.compile_ledger.CompileLedger)
+    # — events: "compile" (one program compiled: family is the program
+    # family, key the shape/static key, kind "aot" | "jit", wall_ms the
+    # measured compile wall time or null when only the event is known),
+    # "eviction" (an LRU dropped a compiled program — key is the EVICTED
+    # key, so thrash is attributable), "thrash" (a family's distinct keys
+    # exceeded its cache capacity), "warmup_done".  after_warmup marks
+    # compile rows recorded past declare_warmup_done — each one is a
+    # compile_storm.  Compile rows may carry extra cost/memory stats
+    # (flops, bytes_accessed, *_size_in_bytes, signature).
+    "compile_ledger": {
+        "schema": str, "time": _NUM, "mono": _NUM, "event": str,
+        "family": str, "key": str, "kind": str,
+        "wall_ms": (int, float, type(None)), "after_warmup": bool,
+    },
+    # memory_breakdown.json (obs.memory_ledger.MemoryLedger.dump) — the
+    # per-subsystem device-byte breakdown, dumped on demand and on
+    # RESOURCE_EXHAUSTED (reason "oom:<ExcType>"); "top" names the biggest
+    # holders, "device" the backend's memory_stats() truth when available
+    "memory_breakdown": {
+        "schema": str, "time": _NUM, "reason": str, "subsystems": dict,
+        "total_bytes": _NUM, "peak_total_bytes": _NUM,
+        "device": (dict, type(None)), "programs": dict, "top": list,
+    },
+    # tools/obs_report.py output document; v2 added the required "trace"
+    # key (per-request waterfalls from trace_events.jsonl); v3 adds the
+    # resource-ledger sections — "compile" (compile_ledger.jsonl rollup)
+    # and "memory" (mem/* gauges + memory_breakdown.json), both null when
+    # the run carried no ledger
     "obs_report": {
         "schema": str, "generated_at": _NUM, "scalars": dict,
         "histograms": dict, "flight": (dict, type(None)),
         "anomalies": list, "hlo_audits": list, "timeline": dict,
         "supervisor": (dict, type(None)), "trace": (dict, type(None)),
+        "compile": (dict, type(None)), "memory": (dict, type(None)),
     },
 }
 
@@ -211,6 +238,42 @@ REGISTRY_METRICS: Dict[str, str] = {
     "router/inflight": "gauge",
     "router/affinity_hit_rate": "gauge",
     "router/fleet_prefix_hit_rate": "gauge",
+    # compile ledger (obs.compile_ledger.CompileLedger): every intercepted
+    # .lower()/.compile() site counts + times here; storms are compiles
+    # after warmup was declared done, thrash warnings fire when a program
+    # family's distinct keys exceed its compiled-cache capacity, and the
+    # cache hit/miss/eviction counters join the _CompiledLRU's own
+    # eviction counter (below) so recompile churn is attributable
+    "trace/compiles_total": "counter",
+    "trace/compile_ms": "histogram",
+    "trace/compile_storms_total": "counter",
+    "trace/compile_thrash_total": "counter",
+    "trace/compiled_cache_hits_total": "counter",
+    "trace/compiled_cache_misses_total": "counter",
+    "trace/compiled_cache_evictions_total": "counter",
+    # memory ledger (obs.memory_ledger.MemoryLedger): per-subsystem device
+    # bytes + peak watermarks (the gauges' sum is the logical sizing
+    # model), device truth where the backend reports it, and the largest
+    # compiled program's temp bytes as the workspace subsystem.  Further
+    # mem/<subsystem>_bytes names are allowed as extras (this is a floor).
+    "mem/params_bytes": "gauge",
+    "mem/params_peak_bytes": "gauge",
+    "mem/opt_state_bytes": "gauge",
+    "mem/opt_state_peak_bytes": "gauge",
+    "mem/kv_pool_bytes": "gauge",
+    "mem/kv_pool_peak_bytes": "gauge",
+    "mem/kv_cache_bytes": "gauge",
+    "mem/kv_cache_peak_bytes": "gauge",
+    "mem/draft_kv_bytes": "gauge",
+    "mem/draft_kv_peak_bytes": "gauge",
+    "mem/adapter_pool_bytes": "gauge",
+    "mem/adapter_pool_peak_bytes": "gauge",
+    "mem/workspace_bytes": "gauge",
+    "mem/workspace_peak_bytes": "gauge",
+    "mem/device_bytes_in_use": "gauge",
+    "mem/device_peak_bytes": "gauge",
+    "mem/device_bytes_limit": "gauge",
+    "mem/live_array_bytes": "gauge",
 }
 
 

@@ -239,6 +239,30 @@ def _pack_tokens(toks, finite):
     return jnp.stack([toks.astype(jnp.int32), finite.astype(jnp.int32)])
 
 
+#: module-level jits shared by every engine in the process: their compiles
+#: are invisible to the per-model _CompiledLRU accounting, so the ledger-on
+#: engine polls their jit cache sizes per step instead (growth after
+#: warmup = a silent mid-serve recompile, the PR-9 ``_sample_rows``
+#: pathology)
+_MODULE_JITS = (("sample_rows", _sample_rows),
+                ("propose_rows", _propose_rows),
+                ("spec_accept", _spec_accept),
+                ("pack_tokens", _pack_tokens))
+
+
+def _module_jit_sizes() -> dict:
+    """{name: jit cache size} for the shared sampler jits (absent when the
+    jax version exposes no ``_cache_size``)."""
+    from neuronx_distributed_tpu.obs.compile_ledger import jit_cache_size
+
+    out = {}
+    for name, fn in _MODULE_JITS:
+        n = jit_cache_size(fn)
+        if n is not None:
+            out[name] = n
+    return out
+
+
 def replay_trace(engine: "ServingEngine", arrivals, requests,
                  on_output=None, clock=time.monotonic, sleep=time.sleep):
     """Replay an arrival trace through a live engine — the historical name
@@ -411,6 +435,8 @@ class ServingEngine:
         shed_infeasible: bool = False,
         paged_kernel: Any = "auto",
         tracer: Any = None,
+        compile_ledger: Any = None,
+        memory_ledger: Any = None,
     ):
         attrs = ("prefill_one", "insert_slot", "decode_slots")
         if page_size is not None:
@@ -529,6 +555,37 @@ class ServingEngine:
         if registry is None and obs is not None:
             registry = obs.registry
         self.registry = registry if registry is not None else MetricRegistry()
+        # resource ledgers (obs.compile_ledger / obs.memory_ledger).  An
+        # explicit compile ledger is attached to the MODEL (and the draft)
+        # so the AOT phase-fn wrappers and every _CompiledLRU family report
+        # to it — explicit wins over whatever a previous engine left there
+        # (benches build several engines over one model sequentially), and
+        # the attachment PERSISTS: a later ledger-less engine over the same
+        # model keeps reporting to it, so when reusing a model across
+        # independent measurement rungs, give EACH rung's engines (warm
+        # passes included) that rung's ledger or a warm-declared previous
+        # ledger would book the new rung's compiles as storms.
+        # Ledgers-off (the default) stays allocation-free: every call site
+        # below guards on `is not None`.
+        self.compile_ledger = compile_ledger
+        self.memory_ledger = memory_ledger
+        if compile_ledger is not None:
+            compile_ledger.attach(registry=self.registry, tracer=tracer,
+                                  flight=(getattr(obs, "flight", None)
+                                          if obs is not None else None),
+                                  memory_ledger=memory_ledger)
+            model.compile_ledger = compile_ledger
+            if draft is not None:
+                draft.compile_ledger = compile_ledger
+        if memory_ledger is not None and memory_ledger.registry is None:
+            memory_ledger.registry = self.registry
+        # module-level sampler jits (_sample_rows & co) recompile only when
+        # an argument's shape/dtype/placement changes — exactly the
+        # mid-serve recompile the PR-9 perf fix chased.  With the ledger
+        # on, step() polls their jit cache sizes (a few C++ attribute
+        # reads) and books any growth as a compile event.
+        self._jit_sizes = (_module_jit_sizes()
+                          if compile_ledger is not None else None)
         # paged KV mode (kvcache/ subsystem): KV lives in a global page pool
         # sized by `num_pages`, slots carry int32 block tables, admission
         # gates on pages free, and repeated prompts share prefix pages
@@ -629,10 +686,15 @@ class ServingEngine:
         # live device state: the batch as a resource pool — contiguous
         # [B, T] rows, or the global page pool in paged mode (the paged
         # pool's HBM is num_pages * page_bytes, decoupled from B * T)
+        self._page_bytes: Optional[int] = None
         if self._kv is not None:
             pool = model.make_page_pool(num_pages, page_size,
                                         quant=self._kv_quant)
             self.caches = pool.caches
+            # the pool's page_bytes-derived logical size: what the memory
+            # ledger accounts and what the fleet's headroom view is sized
+            # from (pages_free * page_bytes)
+            self._page_bytes = pool.page_bytes
             logger.info(
                 "serving: paged KV pool: %d pages x %d tokens%s "
                 "(%.1f MiB; contiguous [B=%d, T=%d] would be %.1f MiB)",
@@ -685,6 +747,33 @@ class ServingEngine:
             self._adapter_dirty = True
         if self._kv_quant is not None:
             self.registry.counter(QUANT_PAGES_TOTAL)
+
+        # memory ledger: account every HBM subsystem this engine owns at
+        # its LOGICAL size — the same page_bytes arithmetic the admission
+        # gates use, so the mem/*_bytes gauges' sum IS the sizing model —
+        # then take one device-truth poll where the backend supports it
+        ml = self.memory_ledger
+        if ml is not None:
+            ml.account_tree("params", model.params)
+            if self._kv is not None:
+                ml.set("kv_pool", num_pages * self._page_bytes)
+            else:
+                from neuronx_distributed_tpu.obs.memory_ledger import (
+                    tree_bytes,
+                )
+
+                ml.set("kv_cache", tree_bytes(self.caches))
+            if self._spec_k:
+                from neuronx_distributed_tpu.obs.memory_ledger import (
+                    tree_bytes,
+                )
+
+                ml.set("draft_kv", tree_bytes(self._draft_caches))
+                ml.account_tree("draft_params", draft.params)
+            if self._adapter_pool is not None:
+                ml.set("adapter_pool",
+                       int(getattr(self._adapter_pool, "nbytes", 0)))
+            ml.poll_device()
 
         # pre-declare so a zero-request engine still exports the full set
         reg = self.registry
@@ -791,10 +880,49 @@ class ServingEngine:
 
     # -- engine loop -------------------------------------------------------
 
+    def declare_warmup_done(self) -> None:
+        """Everything this engine will run is compiled now: any compile the
+        ledger sees from here on is a ``compile_storm`` (counted, flight-
+        warned, traced).  Benches call this between their warm pass and the
+        measured pass; no-op without a compile ledger."""
+        if self.compile_ledger is not None:
+            self.compile_ledger.declare_warmup_done("engine")
+
+    def _poll_module_jits(self, led) -> None:
+        """Book growth of the shared sampler jits' caches as compile events
+        — the only visibility into recompiles of programs that live outside
+        the per-model caches (wall time unknown: the compile happened
+        inside jit dispatch)."""
+        sizes = _module_jit_sizes()
+        for name, n in sizes.items():
+            if n > self._jit_sizes.get(name, 0):
+                led.record_compile(f"jit:{name}", f"cache_size_{n}", None,
+                                   kind="jit")
+        self._jit_sizes = sizes
+
     def step(self) -> List[RequestOutput]:
         """One engine iteration: sweep → admit/prefill → batched decode →
         per-slot stop detection → slot free.  Returns the requests that
-        reached a terminal state during this step."""
+        reached a terminal state during this step.
+
+        With a memory ledger attached, a RESOURCE_EXHAUSTED escaping the
+        step dumps ``memory_breakdown.json`` naming the biggest holders
+        before re-raising; with a compile ledger attached, the shared
+        sampler jits' cache sizes are polled after the step.  Ledgers-off
+        is two attribute reads."""
+        if self.compile_ledger is None and self.memory_ledger is None:
+            return self._step_impl()
+        try:
+            out = self._step_impl()
+        except Exception as e:
+            if self.memory_ledger is not None:
+                self.memory_ledger.oom_dump(e)
+            raise
+        if self.compile_ledger is not None:
+            self._poll_module_jits(self.compile_ledger)
+        return out
+
+    def _step_impl(self) -> List[RequestOutput]:
         outputs: List[RequestOutput] = []
         now = self._clock()
         t_step0 = now
@@ -928,6 +1056,11 @@ class ServingEngine:
                 tr.end(rt.pop("phase", None), t=now, aborted=True)
                 tr.end(rt.get("root"), t=now, aborted=True)
             self._rt.clear()
+        if self.memory_ledger is not None:
+            try:
+                self.memory_ledger.dump(reason="close")
+            except OSError as e:  # teardown IO must not mask the exit path
+                logger.warning("serving: memory breakdown dump failed: %s", e)
         if self._stats_f is not None:
             self._stats_f.close()
             self._stats_f = None

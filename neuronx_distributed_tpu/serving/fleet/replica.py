@@ -126,8 +126,20 @@ class Replica:
             view["active"] = sched.active_count
         view["slots"] = getattr(eng, "B", 1)
         kv = getattr(eng, "_kv", None)
+        view["kv_headroom_bytes"] = None
         if kv is not None:
             view["pages_free"] = kv.pages_free()
+            # per-replica HBM headroom for the router: the page_bytes-
+            # derived logical free KV bytes (what admission can actually
+            # still hold), refined by device truth when a memory ledger
+            # has polled it
+            pb = getattr(eng, "_page_bytes", None)
+            if pb:
+                view["kv_headroom_bytes"] = view["pages_free"] * pb
+        ml = getattr(eng, "memory_ledger", None)
+        view["mem_bytes"] = ml.total_bytes if ml is not None else None
+        view["hbm_headroom_bytes"] = (ml.headroom_bytes()
+                                      if ml is not None else None)
         store = getattr(eng, "_adapters", None)
         # the tenancy tiebreak evidence: which adapters this replica's pool
         # holds device-resident right now (None off multi-adapter mode)
@@ -175,6 +187,10 @@ class Replica:
             # serve it — same pool capacity, page width and rank, or the
             # homogeneity check refuses the fleet up front
             "kv_quant": getattr(eng, "_kv_quant", None),
+            # per-page HBM cost (static per config): with load()'s
+            # pages_free this is the router's byte-denominated headroom
+            # view — identical across a homogeneous fleet by construction
+            "kv_page_bytes": getattr(eng, "_page_bytes", None),
             "adapter_pages": store.capacity if store is not None else None,
             "adapter_page_elems": (store.layout.page_elems
                                    if store is not None else None),

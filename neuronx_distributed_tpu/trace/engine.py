@@ -83,7 +83,17 @@ class _CompiledLRU:
     ``owner`` is the serving wrapper; when it carries a ``metrics_registry``
     (an ``obs.MetricRegistry``, set by the serving engine), evictions are
     counted there as ``trace/compiled_cache_evictions_total`` so a long-lived
-    server's recompile churn is visible in the persisted telemetry."""
+    server's recompile churn is visible in the persisted telemetry.
+
+    When the owner additionally carries a ``compile_ledger`` (an
+    ``obs.CompileLedger``, set by the serving engine or the wrapper's
+    ``compile_ledger=`` kwarg), every cache event is accounted there too:
+    hits/misses as counters, evictions as rows carrying the EVICTED
+    ``(family, key)`` so thrash is attributable to the programs actually
+    cycling, and each entry's FIRST call is timed as that program's cold
+    compile (the timing wrapper then replaces itself with the raw fn, so
+    steady-state calls pay nothing).  Ledger-off is one ``getattr`` per
+    lookup — no allocation."""
 
     def __init__(self, name: str, capacity: int = COMPILED_CACHE_SIZE,
                  owner: Any = None):
@@ -96,11 +106,58 @@ class _CompiledLRU:
 
     def get(self, key):
         fn = self._d.get(key)
+        led = getattr(self.owner, "compile_ledger", None)
+        if led is not None:
+            (led.cache_hit if fn is not None else led.cache_miss)(self.name)
         if fn is not None:
             self._d.move_to_end(key)
         return fn
 
-    def put(self, key, fn) -> None:
+    def _family(self, key) -> str:
+        """Ledger program family for a cache key: the shared serving cache
+        keys lead with the phase-fn name (``("decode_pages", "fp", True)``,
+        ``"prefill_one"``), which IS the program family — per-family
+        attribution is what makes thrash diagnosable.  Keys without a
+        leading name (the per-shape decode_loop / score_chunk caches) fall
+        back to the cache name."""
+        if isinstance(key, tuple) and key and isinstance(key[0], str):
+            return key[0]
+        if isinstance(key, str):
+            return key
+        return self.name
+
+    def _timed_first_call(self, key, fn):
+        """First-call compile timing: the first invocation of a lazily
+        jitted entry traces + compiles synchronously before dispatch
+        returns, so its wall time IS the cold-compile cost.  After the
+        first call the raw fn replaces the wrapper in the cache — zero
+        overhead on the steady path."""
+        def first_call(*args, **kwargs):
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            if self._d.get(key) is first_call:  # unwrap unless evicted
+                self._d[key] = fn
+            led = getattr(self.owner, "compile_ledger", None)
+            if led is not None:
+                led.record_compile(self._family(key), key, wall_ms,
+                                   kind="jit")
+            return out
+
+        return first_call
+
+    def put(self, key, fn):
+        """Store ``fn`` and return the STORED callable — the timing wrapper
+        when a ledger is attached.  Call sites must invoke the return value
+        (not their local ``fn``), or the first — compiling — invocation
+        would bypass the wrapper and the cold compile would go unrecorded."""
+        led = getattr(self.owner, "compile_ledger", None)
+        if led is not None:
+            # the thrash threshold is the enclosing cache's capacity: one
+            # family whose distinct keys alone exceed it is guaranteed to
+            # cycle the LRU even with nothing else cached
+            led.set_capacity(self._family(key), self.capacity)
+            fn = self._timed_first_call(key, fn)
         self._d[key] = fn
         self._d.move_to_end(key)
         if len(self._d) > self.capacity:
@@ -112,6 +169,10 @@ class _CompiledLRU:
             reg = getattr(self.owner, "metrics_registry", None)
             if reg is not None:
                 reg.counter("trace/compiled_cache_evictions_total").inc()
+            if led is not None:
+                led.record_eviction(self._family(old_key), old_key,
+                                    capacity=self.capacity)
+        return fn
 
     def __len__(self) -> int:
         return len(self._d)
@@ -201,6 +262,7 @@ def parallel_model_trace(
     *example_args,
     donate_argnums: Sequence[int] = (),
     static_argnums: Sequence[int] = (),
+    compile_ledger: Any = None,
 ):
     """AOT-compile ``fn`` for the given example arguments (shapes/dtypes are
     taken from them; values are ignored).
@@ -209,7 +271,8 @@ def parallel_model_trace(
     (``trace/trace.py:118-186``): instead of per-rank subprocesses feeding
     neuronx-cc, the jit is lowered once over the live mesh and the XLA
     compiler emits the sharded program. Returns the compiled executable
-    (callable with real arrays)."""
+    (callable with real arrays).  ``compile_ledger`` (an
+    ``obs.CompileLedger``) records the compile's wall time + cost stats."""
     jitted = jax.jit(
         fn, donate_argnums=tuple(donate_argnums), static_argnums=tuple(static_argnums)
     )
@@ -220,7 +283,12 @@ def parallel_model_trace(
         example_args,
     )
     lowered = jitted.lower(*shapes)
+    t0 = time.perf_counter()
     compiled = lowered.compile()
+    if compile_ledger is not None:
+        compile_ledger.record_compile(
+            getattr(fn, "__name__", "fn"), "aot",
+            (time.perf_counter() - t0) * 1e3, kind="aot", compiled=compiled)
     from neuronx_distributed_tpu.utils.profiling import cost_report
 
     logger.info(
@@ -368,7 +436,7 @@ class _ServingBase:
             return toks.T  # [B, n]
 
         fn = jax.jit(loop, donate_argnums=(3,))
-        self._loop_cache.put(n, fn)
+        fn = self._loop_cache.put(n, fn)
         return fn
 
     def generate(
@@ -582,11 +650,18 @@ class ParallelInferenceModel(_ServingBase):
         num_kv_heads: Optional[int] = None,
         head_dim: Optional[int] = None,
         paged_kernel: Any = "auto",
+        compile_ledger: Any = None,
     ):
         mcfg = getattr(module, "config", None)
         self.module = module
         self.params = params
         self.config = config
+        # compile accounting (obs.CompileLedger): the AOT builds below and
+        # every _CompiledLRU family report their compiles/evictions here.
+        # None = off (allocation-free — each site is one getattr); the
+        # serving engine attaches its own ledger to this attribute when
+        # given one explicitly.
+        self.compile_ledger = compile_ledger
         self.num_layers = num_layers if num_layers is not None else mcfg.num_layers
         self.num_kv_heads = num_kv_heads if num_kv_heads is not None else mcfg.num_kv_heads
         self.head_dim = head_dim if head_dim is not None else mcfg.head_dim_
@@ -684,7 +759,7 @@ class ParallelInferenceModel(_ServingBase):
             # silently reintroduce the dp>1 placement mismatch, so fail loudly
             fn = jax.jit(self._score_chunk_fn, donate_argnums=(3,),
                          out_shardings=(None, io["cache_out"], io["batch"](None)))
-            self._score_cache.put(ids.shape[1], fn)
+            fn = self._score_cache.put(ids.shape[1], fn)
         return fn(self.params, ids, jnp.int32(offset), caches, valid)
 
     def _decode_fn(self, params, tok, offset, caches, valid):
@@ -740,7 +815,7 @@ class ParallelInferenceModel(_ServingBase):
             io = self._io_shardings
             fn = jax.jit(self._decode_slots_fn, donate_argnums=(3,),
                          out_shardings=(None, io["cache_out"], io["batch"](None)))
-            self._serving_cache.put("decode_slots", fn)
+            fn = self._serving_cache.put("decode_slots", fn)
         return fn(self.params, tok, jnp.asarray(offsets, jnp.int32), caches, valid)
 
     def prefill_one(self, ids, valid):
@@ -753,7 +828,7 @@ class ParallelInferenceModel(_ServingBase):
         fn = self._serving_cache.get("prefill_one")
         if fn is None:
             fn = jax.jit(self._context_fn)
-            self._serving_cache.put("prefill_one", fn)
+            fn = self._serving_cache.put("prefill_one", fn)
         return fn(self.params, ids.astype(jnp.int32), valid)
 
     def _insert_slot_fn(self, caches, row_caches, valid, row_valid, slot):
@@ -777,7 +852,7 @@ class ParallelInferenceModel(_ServingBase):
             io = self._io_shardings
             fn = jax.jit(self._insert_slot_fn, donate_argnums=(0, 2),
                          out_shardings=(io["cache_out"], io["batch"](None)))
-            self._serving_cache.put("insert_slot", fn)
+            fn = self._serving_cache.put("insert_slot", fn)
         return fn(caches, row_caches, valid.astype(jnp.int32),
                   jnp.asarray(row_valid, jnp.int32), jnp.int32(slot))
 
@@ -858,7 +933,7 @@ class ParallelInferenceModel(_ServingBase):
                 donate_argnums=(4,),
                 out_shardings=(None, self._pool_out_shardings(caches),
                                self._io_shardings["batch"](None)))
-            self._serving_cache.put(key, fn)
+            fn = self._serving_cache.put(key, fn)
         return fn(self.params, tok, jnp.asarray(offsets, jnp.int32),
                   jnp.asarray(block_table, jnp.int32), caches, valid)
 
@@ -890,7 +965,7 @@ class ParallelInferenceModel(_ServingBase):
         fn = self._serving_cache.get("write_adapter_page")
         if fn is None:
             fn = jax.jit(self._write_adapter_page_fn, donate_argnums=(0,))
-            self._serving_cache.put("write_adapter_page", fn)
+            fn = self._serving_cache.put("write_adapter_page", fn)
         return fn(pool, jnp.asarray(block, jnp.float32),
                   jnp.int32(phys_page))
 
@@ -948,7 +1023,7 @@ class ParallelInferenceModel(_ServingBase):
                 donate_argnums=(4,),
                 out_shardings=(None, self._pool_out_shardings(caches),
                                self._io_shardings["batch"](None)))
-            self._serving_cache.put(key, fn)
+            fn = self._serving_cache.put(key, fn)
         return fn(self.params, tok, jnp.asarray(offsets, jnp.int32),
                   jnp.asarray(block_table, jnp.int32), caches, valid,
                   apool, jnp.asarray(atables, jnp.int32))
@@ -972,7 +1047,7 @@ class ParallelInferenceModel(_ServingBase):
         fn = self._serving_cache.get("prefill_one_lora")
         if fn is None:
             fn = jax.jit(self._context_lora_fn)
-            self._serving_cache.put("prefill_one_lora", fn)
+            fn = self._serving_cache.put("prefill_one_lora", fn)
         return fn(self.params, ids.astype(jnp.int32), valid, apool,
                   jnp.asarray(atable, jnp.int32))
 
@@ -1020,7 +1095,7 @@ class ParallelInferenceModel(_ServingBase):
         if fn is None:
             fn = jax.jit(self._prefill_chunk_pages_fn, donate_argnums=(4,),
                          out_shardings=(None, self._pool_out_shardings(caches)))
-            self._serving_cache.put(key, fn)
+            fn = self._serving_cache.put(key, fn)
         return fn(self.params, ids.astype(jnp.int32),
                   jnp.asarray([offset], jnp.int32),
                   jnp.asarray(block_table, jnp.int32), caches,
@@ -1071,7 +1146,7 @@ class ParallelInferenceModel(_ServingBase):
                 donate_argnums=(4,),
                 out_shardings=(None, self._pool_out_shardings(caches),
                                self._io_shardings["batch"](None)))
-            self._serving_cache.put(key, fn)
+            fn = self._serving_cache.put(key, fn)
         return fn(self.params, toks.astype(jnp.int32),
                   jnp.asarray(offsets, jnp.int32),
                   jnp.asarray(block_table, jnp.int32), caches, valid)
@@ -1128,7 +1203,7 @@ class ParallelInferenceModel(_ServingBase):
                     else self._write_page_fn)
             fn = jax.jit(impl, donate_argnums=(0,),
                          out_shardings=self._pool_out_shardings(caches))
-            self._serving_cache.put(key, fn)
+            fn = self._serving_cache.put(key, fn)
         return fn(caches, row_caches, jnp.int32(logical_page),
                   jnp.int32(phys_page))
 
@@ -1152,7 +1227,7 @@ class ParallelInferenceModel(_ServingBase):
         if fn is None:
             fn = jax.jit(self._copy_page_fn, donate_argnums=(0,),
                          out_shardings=self._pool_out_shardings(caches))
-            self._serving_cache.put(key, fn)
+            fn = self._serving_cache.put(key, fn)
         return fn(caches, jnp.int32(src_page), jnp.int32(dst_page))
 
     def _insert_valid_fn(self, valid, row_valid, slot):
@@ -1168,7 +1243,7 @@ class ParallelInferenceModel(_ServingBase):
         if fn is None:
             fn = jax.jit(self._insert_valid_fn, donate_argnums=(0,),
                          out_shardings=self._io_shardings["batch"](None))
-            self._serving_cache.put("insert_valid", fn)
+            fn = self._serving_cache.put("insert_valid", fn)
         return fn(valid.astype(jnp.int32), jnp.asarray(row_valid, jnp.int32),
                   jnp.int32(slot))
 
@@ -1232,11 +1307,25 @@ class ParallelInferenceModel(_ServingBase):
             self._decode_fn, donate_argnums=(3,),
             out_shardings=(None, cache_out, bsh(None)),
         )
-        self.context = self._context_jit.lower(params_spec, ids_spec, vctx_spec).compile()
+        def aot(family, lowered):
+            # AOT phase-fn compile, ledger-timed: these are the programs a
+            # cold serving start pays for up front (the compile ledger's
+            # "aot" rows, with cost/memory stats off the executable)
+            led = self.compile_ledger
+            t0 = time.perf_counter()
+            compiled = lowered.compile()
+            if led is not None:
+                led.record_compile(family, (B, C, T),
+                                   (time.perf_counter() - t0) * 1e3,
+                                   kind="aot", compiled=compiled)
+            return compiled
+
+        self.context = aot(
+            "context", self._context_jit.lower(params_spec, ids_spec, vctx_spec))
         # donated caches (arg 3) → in-place KV update
-        self.decode = self._decode_jit.lower(
+        self.decode = aot("decode", self._decode_jit.lower(
             params_spec, tok_spec, off_spec, cache_spec, valid_spec
-        ).compile()
+        ))
         self._io_shardings = {
             "batch": bsh, "cache_out": cache_out,
         }
@@ -1245,9 +1334,9 @@ class ParallelInferenceModel(_ServingBase):
                 self._prefill_chunk_fn, donate_argnums=(3,),
                 out_shardings=(None, cache_out),
             )
-            self.prefill_chunk = self._prefill_chunk_jit.lower(
+            self.prefill_chunk = aot("prefill_chunk", self._prefill_chunk_jit.lower(
                 params_spec, ids_spec, off_spec, cache_spec, valid_spec
-            ).compile()
+            ))
         self._loop_cache = _CompiledLRU("decode_loop", owner=self)
         self._serving_lru(reset=True)
         self._arg_specs = (

@@ -290,6 +290,22 @@ def fit(
             str(obs), timeline=timeline)
     obs_audited = False
 
+    # resource ledgers (Observability(ledgers=True)): the compile ledger
+    # books the train-step compile (cold wall-time; the pipelined engine's
+    # schedule compiles inside the same jit, so this site covers it too)
+    # and treats any compile after step 0 as a storm; the memory ledger
+    # accounts params + optimizer state and dumps memory_breakdown.json on
+    # a RESOURCE_EXHAUSTED crash.  Both None by default — every hook below
+    # guards on `is not None`.
+    compile_led = getattr(obs_rt, "compile_ledger", None)
+    memory_led = getattr(obs_rt, "memory_ledger", None)
+    if compile_led is not None:
+        from neuronx_distributed_tpu.obs.compile_ledger import jit_cache_size
+    if memory_led is not None:
+        memory_led.account_tree("params", params)
+        memory_led.account_tree("opt_state", opt_state)
+        memory_led.poll_device()
+
     policy_rt = None
     if policy is not None:
         from neuronx_distributed_tpu.resilience.policy import PolicyEngine
@@ -485,6 +501,7 @@ def fit(
 
     final_step = steps
     last_saved_step = -1
+    step_cache_size = None  # train-step jit cache size at the last poll
     try:
         step = start_step
         while step < steps:
@@ -525,8 +542,14 @@ def fit(
                 # one extra AOT lower+compile for the audit; the persistent
                 # compilation cache (when enabled) dedupes the XLA work
                 try:
+                    t_aot = time.perf_counter()
                     compiled = step_fn.lower(
                         params, opt_state, batch, rng).compile()
+                    if compile_led is not None:
+                        compile_led.record_compile(
+                            "train_step", "aot_audit",
+                            (time.perf_counter() - t_aot) * 1e3,
+                            kind="aot", compiled=compiled)
                     obs_rt.audit_executable("train_step", compiled)
                 except Exception as e:
                     logger.warning("obs: train-step HLO audit failed: %s", e)
@@ -564,6 +587,25 @@ def fit(
                     loss = perturb("fit/loss", float(fetched[0]), step=step)
                     grad_norm = float(fetched[1])
                     t_done = time.perf_counter()
+            if compile_led is not None:
+                n = jit_cache_size(step_fn)
+                if step == start_step:
+                    # the first executed step's dispatch wall IS its
+                    # trace+compile cost (jit compiles synchronously before
+                    # dispatch returns); everything is warm after it, so
+                    # any later compile is a storm
+                    compile_led.record_compile(
+                        "train_step", "step0", (t_dispatch - t0) * 1e3,
+                        kind="jit")
+                    compile_led.declare_warmup_done("fit_step0")
+                elif n is not None and step_cache_size is not None \
+                        and n > step_cache_size:
+                    # the jit cache grew mid-run: a silent retrace/recompile
+                    # (shape or placement drift) — booked with no wall time
+                    # (it happened inside dispatch), flagged as a storm
+                    compile_led.record_compile(
+                        "train_step", f"cache_size_{n}", None, kind="jit")
+                step_cache_size = n
             if not deferred and obs_rt is not None:
                 obs_rt.observe_step(
                     step, loss=loss, grad_norm=grad_norm, seq_per_sec=seqs,
@@ -690,6 +732,14 @@ def fit(
         except Exception as flush_err:
             logger.warning("deferred-metric flush failed during crash "
                            "handling: %s", flush_err)
+        if memory_led is not None:
+            # RESOURCE_EXHAUSTED forensics: name the biggest HBM holders in
+            # memory_breakdown.json before the process dies (no-op for
+            # non-OOM exceptions; IO failures must not mask the crash)
+            try:
+                memory_led.oom_dump(e)
+            except Exception as dump_err:
+                logger.warning("obs: OOM breakdown dump failed: %s", dump_err)
         if obs_rt is not None:
             # the crash dump is the flight recorder's whole purpose: persist
             # the last K steps before the exception unwinds the process — but

@@ -15,6 +15,36 @@ DEFAULT_PEAK_FLOPS = 197e12
 DEFAULT_HBM_BYTES_PER_S = 819e9
 
 
+def memory_analysis(compiled: Any) -> Optional[Dict[str, float]]:
+    """Executable memory breakdown (argument / output / temp / generated-
+    code bytes) from ``compiled.memory_analysis()``, jax-version-guarded
+    like the ``cost_analysis`` list compat below: some versions return a
+    per-program list, some backends raise Unimplemented — both normalize to
+    a plain dict or None.  Feeds the memory ledger's per-program
+    temp/output accounting (``obs.memory_ledger.MemoryLedger
+    .note_program``): the temp bytes are the transient workspace a step
+    needs on top of the resident pools."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # pragma: no cover - backend-dependent
+        return None
+    if isinstance(ma, (list, tuple)):  # per-program list on some versions
+        ma = ma[0] if ma else None
+    if ma is None:
+        return None
+    out: Dict[str, float] = {}
+    for attr in (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "generated_code_size_in_bytes",
+    ):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[attr] = float(v)
+    return out or None
+
+
 def cost_report(compiled: Any, collectives: bool = False) -> Dict[str, Any]:
     """Summarize an executable from ``jax.jit(f).lower(...).compile()``:
     FLOPs, bytes accessed, and (when the backend reports it) the memory
@@ -31,20 +61,9 @@ def cost_report(compiled: Any, collectives: bool = False) -> Dict[str, Any]:
     for key in ("flops", "bytes accessed", "transcendentals"):
         if key in ca:
             out[key.replace(" ", "_")] = float(ca[key])
-    try:
-        ma = compiled.memory_analysis()
-    except Exception:  # pragma: no cover - backend-dependent
-        ma = None
+    ma = memory_analysis(compiled)
     if ma is not None:
-        for attr in (
-            "argument_size_in_bytes",
-            "output_size_in_bytes",
-            "temp_size_in_bytes",
-            "generated_code_size_in_bytes",
-        ):
-            v = getattr(ma, attr, None)
-            if v is not None:
-                out[attr] = float(v)
+        out.update(ma)
     if collectives:
         # late import: obs builds on this module's cost_report
         from neuronx_distributed_tpu.obs.hlo_audit import (

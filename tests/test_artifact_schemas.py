@@ -31,7 +31,8 @@ def test_schema_list_is_complete():
     assert {"scalars", "flight_record", "flight_step", "anomaly",
             "hlo_audit", "tpu_watch", "obs_report",
             "serving_stats", "supervisor_event",
-            "router_stats", "trace_event"} <= set(SCHEMAS)
+            "router_stats", "trace_event",
+            "compile_ledger", "memory_breakdown"} <= set(SCHEMAS)
 
 
 def test_committed_tpu_watch_results_validate():
@@ -354,6 +355,63 @@ def test_validate_record_rejects_bad_records():
     with pytest.raises(ValueError, match="bool"):
         validate_record("scalars",
                         {"step": 1, "tag": "x", "value": True, "time": 0.0})
+
+
+def test_compile_ledger_and_memory_breakdown_schemas(tmp_path):
+    """The resource-ledger emitters honor their checked-in schemas (the
+    live engine/fit paths are validated end-to-end in
+    tests/test_resource_ledgers.py), the trace/compile* + mem/* registry
+    metrics are declared with their kinds, and the obs report grows the
+    compile/memory sections from the artifacts."""
+    from neuronx_distributed_tpu.obs import CompileLedger, MemoryLedger
+    from neuronx_distributed_tpu.obs.schemas import (
+        REGISTRY_METRICS,
+        validate_registry_metrics,
+    )
+
+    led = CompileLedger(path=str(tmp_path / "compile_ledger.jsonl"))
+    led.set_capacity("decode_pages", 1)
+    led.record_compile("decode_pages", ("fp", True), 42.0, kind="jit")
+    led.record_eviction("decode_pages", ("fp", True))
+    led.declare_warmup_done()
+    led.record_compile("verify_pages", 3, 10.0, kind="jit")  # storm
+    n = validate_jsonl("compile_ledger", str(tmp_path / "compile_ledger.jsonl"))
+    assert n == len(led.rows)
+    with pytest.raises(ValueError, match="missing required field"):
+        validate_record("compile_ledger", {"schema": "compile_ledger/1"})
+    with pytest.raises(ValueError, match="expected"):
+        validate_record("compile_ledger", dict(led.rows[0], wall_ms="slow"))
+
+    ml = MemoryLedger(path=str(tmp_path / "memory_breakdown.json"))
+    ml.set("kv_pool", 4096)
+    ml.dump()
+    doc = json.load(open(tmp_path / "memory_breakdown.json"))
+    validate_record("memory_breakdown", doc)
+    with pytest.raises(ValueError, match="missing required field"):
+        validate_record("memory_breakdown", {"schema": doc["schema"]})
+
+    assert {"trace/compiles_total", "trace/compile_ms",
+            "trace/compile_storms_total", "trace/compile_thrash_total",
+            "trace/compiled_cache_evictions_total",
+            "mem/kv_pool_bytes", "mem/params_bytes",
+            "mem/workspace_bytes"} <= set(REGISTRY_METRICS)
+    reg = MetricRegistry()
+    led2 = CompileLedger(registry=reg)
+    led2.record_compile("context", "aot", 100.0, kind="aot")
+    MemoryLedger(registry=reg).set("kv_pool", 123)
+    validate_registry_metrics(reg)
+
+    from neuronx_distributed_tpu.obs.report import build_report, render_markdown
+
+    reg.dump_jsonl(str(tmp_path / "scalars.jsonl"), step=1)
+    report = build_report(run_dir=str(tmp_path))
+    validate_record("obs_report", report)
+    assert report["compile"]["compiles"] == 2  # from the jsonl rollup
+    assert report["compile"]["storms"] == 1
+    assert report["memory"]["subsystems"]["kv_pool"]["bytes"] == 4096
+    md = render_markdown(report)
+    assert "- compile:" in md and "1 storm(s)" in md
+    assert "## Memory ledger" in md
 
 
 def test_trace_events_schema(tmp_path):

@@ -17,11 +17,20 @@ Usage:
         --timeline trace.json --out report.json --markdown report.md
     python tools/obs_report.py --trace trace_events.jsonl \
         --serving-stats serving_stats.jsonl --markdown report.md
+    python tools/obs_report.py --compare RUN_A RUN_B
 
 The ``--trace`` section reconstructs per-request waterfalls from the
 serving stack's ``trace_events.jsonl`` spans (queue / prefill / decode /
 preempted milliseconds, failover hops, top-5 slowest requests), linked to
 their terminal ``serving_stats`` records via ``trace_id``.
+
+``--compare RUN_A RUN_B`` diffs two runs' resource ledgers
+(``compile_ledger.jsonl`` + ``memory_breakdown.json`` in each dir):
+markdown table to stdout (or ``--markdown``), JSON via ``--out``, and a
+NONZERO exit code when run B regressed — more compiles than ``(1 +
+--compile-regress-threshold) * A``, new compile storms, or any
+subsystem's peak bytes past ``(1 + --mem-regress-threshold) * A``'s —
+so CI can gate on it.
 """
 
 from __future__ import annotations
@@ -63,15 +72,55 @@ def main(argv=None) -> int:
                    help="serving_stats.jsonl path (v4 or v5; auto-detected "
                         "in --run-dir) — links trace waterfalls to their "
                         "terminal records via trace_id")
+    p.add_argument("--compile-ledger", default=None,
+                   help="compile_ledger.jsonl path (auto-detected in "
+                        "--run-dir) — builds the compile health section")
+    p.add_argument("--memory-breakdown", default=None,
+                   help="memory_breakdown.json path (auto-detected in "
+                        "--run-dir) — builds the memory health section")
+    p.add_argument("--compare", nargs=2, metavar=("RUN_A", "RUN_B"),
+                   default=None,
+                   help="compile/memory regression diff between two run "
+                        "dirs; nonzero rc when B regressed past the "
+                        "thresholds")
+    p.add_argument("--compile-regress-threshold", type=float, default=0.0,
+                   help="--compare: allowed fractional growth in compile "
+                        "count before rc 1 (default 0: any extra compile "
+                        "regresses)")
+    p.add_argument("--mem-regress-threshold", type=float, default=0.05,
+                   help="--compare: allowed fractional growth in any "
+                        "subsystem's peak bytes before rc 1 (default 5%%)")
     p.add_argument("--tail", type=int, default=10,
                    help="flight-record tail length in the summary")
     p.add_argument("--out", default=None, help="write JSON here (default stdout)")
     p.add_argument("--markdown", default=None, help="also write a markdown rendering")
     args = p.parse_args(argv)
 
+    if args.compare:
+        from neuronx_distributed_tpu.obs.report import compare_resources
+
+        diff = compare_resources(
+            args.compare[0], args.compare[1],
+            compile_threshold=args.compile_regress_threshold,
+            mem_threshold=args.mem_regress_threshold)
+        if args.out:
+            doc = {k: diff[k] for k in ("a", "b", "compile", "memory",
+                                        "regressions", "regressed")}
+            with open(args.out, "w") as f:
+                f.write(json.dumps(doc, indent=2) + "\n")
+        if args.markdown:
+            with open(args.markdown, "w") as f:
+                f.write(diff["markdown"])
+        print(diff["markdown"])
+        if diff["regressed"]:
+            for r in diff["regressions"]:
+                print(f"obs_report: REGRESSION: {r}", file=sys.stderr)
+            return 1
+        return 0
+
     if not (args.run_dir or args.scalar_dir or args.scalars or args.flight
             or args.hlo_audit or args.timeline or args.supervisor_events
-            or args.trace):
+            or args.trace or args.compile_ledger or args.memory_breakdown):
         p.error("nothing to report on: pass --run-dir or explicit artifact paths")
 
     from neuronx_distributed_tpu.obs.report import build_report, render_markdown
@@ -94,6 +143,8 @@ def main(argv=None) -> int:
         supervisor_events_path=args.supervisor_events,
         trace_paths=args.trace,
         serving_stats_path=args.serving_stats,
+        compile_ledger_path=args.compile_ledger,
+        memory_breakdown_path=args.memory_breakdown,
         tail=args.tail,
     )
     validate_record("obs_report", report)  # the emitter honors its own schema

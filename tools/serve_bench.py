@@ -42,6 +42,15 @@ request-lifecycle tracer to every measured engine and drops one
 schema-checked `<rung>.trace_events.jsonl` + one Perfetto-loadable
 `<rung>.trace.json` per rung — the per-request waterfall evidence
 `tools/obs_report.py --trace` renders.
+
+Every measured engine carries a compile ledger with warmup declared done
+at construction, so each rung reports ``compiles_during_measurement`` —
+the proof that its percentiles exclude compile time (any nonzero count is
+a compile storm inside the measured window).  ``--ledger-out DIR``
+additionally drops the full artifacts per rung: a schema-checked
+``<rung>.compile_ledger.jsonl`` and a ``<rung>.memory_breakdown.json``
+(the per-subsystem HBM accounting `tools/obs_report.py --compare` diffs
+between runs).
 """
 
 from __future__ import annotations
@@ -90,6 +99,50 @@ def _export_trace(tracer, args, label: str) -> dict:
             "trace_perfetto": os.path.abspath(ch)}
 
 
+def _make_ledgers(args):
+    """One compile ledger per rung, attached to the WARM engine too (the
+    warm pass's cold compiles are then the rung's warmup rows, and a later
+    rung's warm engine can never book into a previous rung's warm-declared
+    ledger), plus a memory ledger for the measured engine when
+    ``--ledger-out`` asks for the full artifacts."""
+    from neuronx_distributed_tpu.obs import CompileLedger, MemoryLedger
+
+    mem = MemoryLedger() if getattr(args, "ledger_out", None) else None
+    return CompileLedger(memory_ledger=mem), mem
+
+
+def _ledger_fields(led, mem, args, label: str) -> dict:
+    """The rung's ledger evidence: ``compiles_during_measurement`` (the
+    measured engine declared warmup done at construction, so every compile
+    past that point happened inside the measured window — percentiles
+    provably exclude compiles only when this is 0) plus, under
+    ``--ledger-out``, a schema-checked ``<label>.compile_ledger.jsonl`` +
+    ``<label>.memory_breakdown.json`` pair."""
+    out = {"compiles_during_measurement":
+           led.compile_count(after_warmup_only=True)}
+    if not getattr(args, "ledger_out", None):
+        return out
+    from neuronx_distributed_tpu.obs.memory_ledger import (
+        read_memory_breakdown,
+    )
+    from neuronx_distributed_tpu.obs.schemas import (
+        validate_jsonl,
+        validate_record,
+    )
+
+    os.makedirs(args.ledger_out, exist_ok=True)
+    cl = os.path.join(args.ledger_out, f"{label}.compile_ledger.jsonl")
+    led.dump(cl)
+    validate_jsonl("compile_ledger", cl)  # the emitter honors its schema
+    out["compile_ledger"] = os.path.abspath(cl)
+    if mem is not None:
+        mb = os.path.join(args.ledger_out, f"{label}.memory_breakdown.json")
+        mem.dump(mb, reason=f"serve_bench:{label}")
+        validate_record("memory_breakdown", read_memory_breakdown(mb))
+        out["memory_breakdown"] = os.path.abspath(mb)
+    return out
+
+
 def run_continuous(args, model, vocab_size: int) -> dict:
     """Replay a Poisson arrival trace through ServingEngine; compare against
     lockstep static batches of the same prompts."""
@@ -120,7 +173,9 @@ def run_continuous(args, model, vocab_size: int) -> dict:
     # one registry across warm + measured engines so model-level compiled-
     # cache metrics land in the snapshot we report
     registry = MetricRegistry()
-    warm = ServingEngine(model, registry=registry, stats_path=None)
+    led, mem = _make_ledgers(args)
+    warm = ServingEngine(model, registry=registry, stats_path=None,
+                         compile_ledger=led)
     warm.submit(Request(request_id=-1, prompt_ids=prompts[0],
                         max_new_tokens=min(2, args.max_new_tokens)))
     warm.run_until_complete(max_steps=1000)
@@ -137,7 +192,9 @@ def run_continuous(args, model, vocab_size: int) -> dict:
         os.remove(stats_path)
     tracer = _make_tracer(args)
     engine = ServingEngine(model, registry=registry, stats_path=stats_path,
-                           tracer=tracer)
+                           tracer=tracer, compile_ledger=led,
+                           memory_ledger=mem)
+    engine.declare_warmup_done()  # the warm engine compiled everything
     t0 = time.monotonic()
     outputs = replay_trace(
         engine, arrivals,
@@ -146,6 +203,7 @@ def run_continuous(args, model, vocab_size: int) -> dict:
     t_cont = time.monotonic() - t0
     engine.close()
     trace_paths = _export_trace(tracer, args, "continuous")
+    ledger_fields = _ledger_fields(led, mem, args, "continuous")
 
     n_stats = validate_jsonl("serving_stats", stats_path)
     assert n_stats == n, f"expected {n} serving_stats records, got {n_stats}"
@@ -183,6 +241,7 @@ def run_continuous(args, model, vocab_size: int) -> dict:
         "stats_records": n_stats,
         "stats_path": os.path.abspath(stats_path),
         **trace_paths,
+        **ledger_fields,
     }
 
 
@@ -262,7 +321,9 @@ def run_paged(args, module, params, cfg, icfg) -> int:
         kw = dict(page_size=page, num_pages=budget_pages) if paged else {}
         # warm every compiled phase on a throwaway engine (same model ⇒
         # shared compiled-fn caches) so compile time never pollutes TTFT
-        warm = ServingEngine(model, registry=MetricRegistry(), **kw)
+        led, mem = _make_ledgers(args)
+        warm = ServingEngine(model, registry=MetricRegistry(),
+                             compile_ledger=led, **kw)
         warm.submit(Request(request_id=-1,
                             prompt_ids=rs.randint(1, cfg.vocab_size,
                                                   size=L).tolist(),
@@ -270,7 +331,9 @@ def run_paged(args, module, params, cfg, icfg) -> int:
         warm.run_until_complete(max_steps=1000)
         warm.close()
         del warm  # its device KV must not double the measured HBM footprint
-        engine = ServingEngine(model, registry=MetricRegistry(), **kw)
+        engine = ServingEngine(model, registry=MetricRegistry(),
+                               compile_ledger=led, memory_ledger=mem, **kw)
+        engine.declare_warmup_done()
         outputs, wall, peak = _drive_workload(engine, arrivals, requests())
         snap = engine.registry.snapshot()
         total_tokens = sum(len(o.token_ids) for o in outputs.values())
@@ -299,6 +362,8 @@ def run_paged(args, module, params, cfg, icfg) -> int:
             rec["prefills_skipped"] = snap.get(
                 "kvcache/prefill_skipped_total", 0.0)
             rec["evictions"] = snap.get("kvcache/evictions_total", 0.0)
+        rec.update(_ledger_fields(led, mem, args,
+                                  "paged" if paged else "contiguous"))
         return rec
 
     base = {"config": {"batch": B, "context": C, "max_total": T,
@@ -385,7 +450,9 @@ def run_lora(args, module, params, cfg, icfg) -> int:
         kw = dict(page_size=page, num_pages=num_pages)
         if with_adapters:
             kw["adapter_store"] = make_store()
-        warm = ServingEngine(model, registry=MetricRegistry(), **kw)
+        led, mem = _make_ledgers(args)
+        warm = ServingEngine(model, registry=MetricRegistry(),
+                             compile_ledger=led, **kw)
         warm.submit(Request(request_id=-1, prompt_ids=prompts[0],
                             max_new_tokens=min(2, args.max_new_tokens),
                             adapter_id=1 if with_adapters else 0))
@@ -394,7 +461,9 @@ def run_lora(args, module, params, cfg, icfg) -> int:
         del warm
         if with_adapters:
             kw["adapter_store"] = make_store()  # fresh pins for the run
-        engine = ServingEngine(model, registry=MetricRegistry(), **kw)
+        engine = ServingEngine(model, registry=MetricRegistry(),
+                               compile_ledger=led, memory_ledger=mem, **kw)
+        engine.declare_warmup_done()
         peak_adapters = [0]
         orig_step = engine.step
 
@@ -433,6 +502,8 @@ def run_lora(args, module, params, cfg, icfg) -> int:
             rec["adapter_hits"] = snap.get("tenancy/adapter_hits_total", 0.0)
             rec["adapter_evictions"] = snap.get(
                 "tenancy/adapter_evictions_total", 0.0)
+        rec.update(_ledger_fields(led, mem, args,
+                                  "lora" if with_adapters else "lora_baseline"))
         return rec
 
     base = {"config": {"batch": B, "context": C, "max_total": T,
@@ -516,13 +587,17 @@ def run_kv_quant(args, module, params, cfg, icfg) -> int:
     def measure(quant, num_pages):
         kw = dict(page_size=page, num_pages=num_pages + 1,  # + NULL page
                   kv_quant=quant)
-        warm = ServingEngine(model, registry=MetricRegistry(), **kw)
+        led, mem = _make_ledgers(args)
+        warm = ServingEngine(model, registry=MetricRegistry(),
+                             compile_ledger=led, **kw)
         warm.submit(Request(request_id=-1, prompt_ids=prompts[0],
                             max_new_tokens=min(2, args.max_new_tokens)))
         warm.run_until_complete(max_steps=1000)
         warm.close()
         del warm
-        engine = ServingEngine(model, registry=MetricRegistry(), **kw)
+        engine = ServingEngine(model, registry=MetricRegistry(),
+                               compile_ledger=led, memory_ledger=mem, **kw)
+        engine.declare_warmup_done()
         outputs, wall, peak = _drive_workload(engine, arrivals, requests())
         engine.close()
         snap = engine.registry.snapshot()
@@ -532,6 +607,7 @@ def run_kv_quant(args, module, params, cfg, icfg) -> int:
         return {
             "metric": "serving_kv_quant",
             "mode": quant or "fp",
+            **_ledger_fields(led, mem, args, quant or "fp"),
             "hbm_budget_bytes": budget_bytes,
             "pool_pages": num_pages,
             "page_size": page,
@@ -642,7 +718,9 @@ def run_slo(args, module, params, cfg, icfg) -> int:
         # the whole path (full prefix hits ride it), and — in chunked
         # modes — one prompt per possible chunk width (1..budget pages),
         # so compile time never pollutes the measured percentiles
-        warm = ServingEngine(model, registry=MetricRegistry(), **kw)
+        led, mem = _make_ledgers(args)
+        warm = ServingEngine(model, registry=MetricRegistry(),
+                             compile_ledger=led, **kw)
         warm_prompts = [long_prompts[0], short_prompts[0], [1, 2]]
         if mode != "control":
             warm_prompts += [
@@ -656,11 +734,14 @@ def run_slo(args, module, params, cfg, icfg) -> int:
         del warm
         tracer = _make_tracer(args)
         engine = ServingEngine(model, registry=MetricRegistry(),
-                               tracer=tracer, **kw)
+                               tracer=tracer, compile_ledger=led,
+                               memory_ledger=mem, **kw)
+        engine.declare_warmup_done()
         arrivals, requests = trace(with_long, batch_tier=mode == "slo")
         outputs, wall, peak = _drive_workload(engine, arrivals, requests)
         engine.close()
         trace_paths = _export_trace(tracer, args, f"slo_{mode}")
+        ledger_fields = _ledger_fields(led, mem, args, f"slo_{mode}")
         snap = engine.registry.snapshot()
         inter_i = [ms for o in outputs.values() if o.request_id < LONG_BASE
                    for ms in o.intertoken_ms]
@@ -687,6 +768,7 @@ def run_slo(args, module, params, cfg, icfg) -> int:
             "wall_s": round(wall, 4),
             "max_concurrent": peak,
             **trace_paths,
+            **ledger_fields,
         }
 
     base_cfg = {"config": {"batch": B, "context": C, "max_total": T,
@@ -782,13 +864,17 @@ def run_spec(args, module, params, cfg, icfg) -> int:
             kw.update(draft=model, spec_k=spec_k)
         # warm every compiled phase on a throwaway engine (same model ⇒
         # shared compiled-fn caches) so compile time never pollutes TTFT
-        warm = ServingEngine(model, registry=MetricRegistry(), **kw)
+        led, mem = _make_ledgers(args)
+        warm = ServingEngine(model, registry=MetricRegistry(),
+                             compile_ledger=led, **kw)
         warm.submit(Request(request_id=-1, prompt_ids=prompts[0],
                             max_new_tokens=min(2, args.max_new_tokens)))
         warm.run_until_complete(max_steps=1000)
         warm.close()
         del warm
-        engine = ServingEngine(model, registry=MetricRegistry(), **kw)
+        engine = ServingEngine(model, registry=MetricRegistry(),
+                               compile_ledger=led, memory_ledger=mem, **kw)
+        engine.declare_warmup_done()
         outputs, wall, peak = _drive_workload(engine, arrivals, requests())
         engine.close()
         snap = engine.registry.snapshot()
@@ -815,6 +901,8 @@ def run_spec(args, module, params, cfg, icfg) -> int:
             "goodput_tok_s": total_tokens / max(wall, 1e-9),
             "wall_s": round(wall, 4),
             "max_concurrent": peak,
+            **_ledger_fields(led, mem, args,
+                             f"spec_k{spec_k}" if spec_k else "spec_baseline"),
         }
         return rec, {i: list(o.token_ids) for i, o in outputs.items()}
 
@@ -1046,6 +1134,13 @@ def main() -> int:
                         "--slo): one schema-checked "
                         "<rung>.trace_events.jsonl + one Perfetto "
                         "<rung>.trace.json per measured engine")
+    p.add_argument("--ledger-out", default=None,
+                   help="directory to drop resource-ledger artifacts into "
+                        "(engine rungs): one schema-checked "
+                        "<rung>.compile_ledger.jsonl + one "
+                        "<rung>.memory_breakdown.json per measured engine; "
+                        "every rung also reports "
+                        "compiles_during_measurement regardless")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 

@@ -136,11 +136,53 @@ def _validate_trace_dir(trace_dir: str) -> tuple:
     return True, counts
 
 
+def _validate_ledger_dir(ledger_dir: str) -> tuple:
+    """Post-hook for the resource_ledger job: every dropped
+    ``*.compile_ledger.jsonl`` must validate against the checked-in
+    ``compile_ledger`` schema (non-empty — the warmup_done row is always
+    there) and every ``*.memory_breakdown.json`` against
+    ``memory_breakdown``.  Returns ``(ok, detail)``."""
+    import glob
+    import json as _json
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from neuronx_distributed_tpu.obs.schemas import (
+        validate_jsonl,
+        validate_record,
+    )
+
+    ledgers = sorted(glob.glob(
+        os.path.join(ledger_dir, "*.compile_ledger.jsonl")))
+    breakdowns = sorted(glob.glob(
+        os.path.join(ledger_dir, "*.memory_breakdown.json")))
+    if not ledgers or not breakdowns:
+        return False, f"no ledger artifacts in {ledger_dir}"
+    counts = {}
+    for f in ledgers:
+        try:
+            n = validate_jsonl("compile_ledger", f)
+        except ValueError as e:
+            return False, f"{os.path.basename(f)}: {e}"
+        if n == 0:
+            return False, f"{os.path.basename(f)}: empty ledger"
+        counts[os.path.basename(f)] = n
+    for f in breakdowns:
+        try:
+            with open(f) as fh:
+                validate_record("memory_breakdown", _json.load(fh))
+        except (ValueError, OSError) as e:
+            return False, f"{os.path.basename(f)}: {e}"
+        counts[os.path.basename(f)] = 1
+    return True, counts
+
+
 def run_extra_jobs(results_path: str) -> None:
     """One-shot jobs that ride the first healthy window (VERDICT r3 #6)."""
     import tempfile
 
     trace_dir = tempfile.mkdtemp(prefix="tpu_watch_trace_")
+    ledger_dir = tempfile.mkdtemp(prefix="tpu_watch_ledger_")
     jobs = [
         ("tp_allreduce", [sys.executable, os.path.join(REPO, "tools", "ici_bench.py")]),
         ("serving_latency", [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")]),
@@ -169,6 +211,14 @@ def run_extra_jobs(results_path: str) -> None:
         ("serving_trace", [sys.executable,
                            os.path.join(REPO, "tools", "serve_bench.py"),
                            "--slo", "--trace-out", trace_dir]),
+        # compile & HBM resource ledgers: the paged rung with both ledgers
+        # attached to every measured engine — each rung must report
+        # compiles_during_measurement (0 = percentiles provably exclude
+        # compiles) and drop schema-valid compile_ledger.jsonl +
+        # memory_breakdown.json artifacts (asserted by the post-hook)
+        ("resource_ledger", [sys.executable,
+                             os.path.join(REPO, "tools", "serve_bench.py"),
+                             "--paged", "--ledger-out", ledger_dir]),
         # multi-replica fleet rungs (serving/fleet/ subsystem): N-replica
         # goodput scaling, affinity-vs-random aggregate prefix-hit rate
         # (rc 1 when affinity does not beat random), zero-loss failover
@@ -237,6 +287,16 @@ def run_extra_jobs(results_path: str) -> None:
                     error = (f"trace validation: {detail}"
                              + (f" | bench: {error}" if error else ""))
                 ok = ok and trace_ok
+            if name == "resource_ledger":
+                # same artifact-first discipline: the ledgers ARE the
+                # certification, whatever the bench gate said
+                led_ok, detail = _validate_ledger_dir(ledger_dir)
+                if led_ok:
+                    payload = {"ledger_records": detail, **(payload or {})}
+                else:
+                    error = (f"ledger validation: {detail}"
+                             + (f" | bench: {error}" if error else ""))
+                ok = ok and led_ok
             append(results_path, {"kind": name, "ok": ok,
                                   "result": payload, "error": error})
         except subprocess.TimeoutExpired:
