@@ -285,6 +285,29 @@ def cmd_serve(args):
 
         tracer = Tracer()
     fleet = args.replicas > 1
+    health = None
+    if args.alerts_out:
+        # the control room: default rule pack over the live registries,
+        # alert edges streamed to alerts.jsonl; a fleet gets per-replica
+        # monitors + one fleet monitor through the router, a bare engine
+        # one serving-scope monitor
+        os.makedirs(args.alerts_out, exist_ok=True)
+        alerts_path = os.path.join(args.alerts_out, "alerts.jsonl")
+        if os.path.exists(alerts_path):
+            os.remove(alerts_path)  # the sink appends: a rerun starts fresh
+        if fleet:
+            from neuronx_distributed_tpu.obs.aggregate import FleetHealth
+
+            health = FleetHealth(path=alerts_path, tracer=tracer)
+        else:
+            from neuronx_distributed_tpu.obs.health import (
+                HealthMonitor,
+                default_rules,
+            )
+
+            health = HealthMonitor(default_rules("serving"),
+                                   path=alerts_path, tracer=tracer,
+                                   eval_every=4)
     if fleet:
         # in-process fleet: N engines share the one compiled model (one
         # set of device params) but each owns its KV state — and, with
@@ -309,13 +332,14 @@ def cmd_serve(args):
         target = FleetRouter(
             [Replica(i, make_factory(i)) for i in range(args.replicas)],
             policy=args.routing, seed=args.seed, stats_path=args.stats_out,
-            tracer=tracer)
+            tracer=tracer, health=health)
     else:
         if n_adapters:
             paged_kw["adapter_store"] = make_store()
         target = engine = ServingEngine(
             model, rng=jax.random.PRNGKey(args.seed),
-            stats_path=args.stats_out, tracer=tracer, **paged_kw)
+            stats_path=args.stats_out, tracer=tracer, health=health,
+            **paged_kw)
     requests = [
         Request(
             request_id=i,
@@ -345,21 +369,33 @@ def cmd_serve(args):
         from neuronx_distributed_tpu.obs.metrics_server import MetricsServer
 
         if fleet:
-            def health():
+            def liveness():
                 alive = sum(1 for r in target.replicas.values() if r.alive)
                 return {"ok": alive > 0, "replicas": args.replicas,
                         "alive_replicas": alive,
                         "inflight": target.inflight}
         else:
-            def health():
+            def liveness():
                 return {"ok": True, "steps": engine._steps,
                         "active": engine.scheduler.active_count,
                         "queued": engine.scheduler.queue_depth}
 
-        msrv = MetricsServer(registry=target.registry, health_fn=health,
+        scopes = None
+        if fleet:
+            from neuronx_distributed_tpu.obs.aggregate import (
+                FleetAggregator,
+            )
+
+            scopes = {"fleet":
+                      FleetAggregator.for_router(target).prometheus_text}
+        msrv = MetricsServer(registry=target.registry, health_fn=liveness,
+                             monitor=health, scopes=scopes,
                              port=args.metrics_port)
+        endpoints = ["/metrics", "/healthz"]
+        if scopes:
+            endpoints.append("/metrics?scope=fleet")
         print(json.dumps({"event": "metrics_server", "port": msrv.port,
-                          "endpoints": ["/metrics", "/healthz"]}),
+                          "endpoints": endpoints}),
               flush=True)
 
     t0 = time.monotonic()
@@ -381,6 +417,14 @@ def cmd_serve(args):
         validate_jsonl("trace_event", ev)
         print(json.dumps({"event": "trace", "trace_events": ev,
                           "trace_perfetto": ch}), flush=True)
+    if health is not None:
+        from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+        health.close()
+        ap = os.path.join(args.alerts_out, "alerts.jsonl")
+        print(json.dumps({"event": "alerts", "alerts": ap,
+                          "edges": validate_jsonl("alert", ap)}),
+              flush=True)
     if fleet:
         snap = target.registry.snapshot()
         prefix = target.fleet_prefix_stats()
@@ -575,6 +619,14 @@ def main():
                          "artifacts into after the run: trace_events.jsonl "
                          "(schema-checked spans, stitched across replicas) "
                          "+ trace.json (Perfetto)")
+    sp.add_argument("--alerts-out", default=None,
+                    help="run under the default health-monitor rule pack "
+                         "(fleet: per-replica + fleet monitors) and stream "
+                         "schema-checked alert edges to "
+                         "DIR/alerts.jsonl; with --metrics-port, /healthz "
+                         "readiness then reflects firing-alert state (503 "
+                         "on page severity) and a fleet exposes "
+                         "/metrics?scope=fleet (replica-labeled merge)")
     sp.add_argument("--routing", default="prefix_affinity",
                     choices=["round_robin", "random", "least_loaded",
                              "prefix_affinity"],

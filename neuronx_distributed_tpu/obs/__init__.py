@@ -28,6 +28,15 @@ reproduced that fragmentation across ``trainer/metrics.py``,
 - :mod:`.metrics_server` — stdlib HTTP ``/metrics`` (live Prometheus
   text) + ``/healthz`` endpoints over a registry (CLI:
   ``tools/metrics_server.py``; live: ``runner.py serve --metrics-port``);
+- :mod:`.health` — the fleet health monitor: threshold / EWMA-trend /
+  multi-window SLO burn-rate rules evaluated over live registry
+  snapshots, firing/resolved edges streamed to schema-checked
+  ``alerts.jsonl`` (``fit(obs=Observability(health=True))``,
+  ``ServingEngine(health=...)``, ``FleetRouter(health=...)``);
+- :mod:`.aggregate` — fleet-wide metric aggregation: per-replica registry
+  merge (sum/max/histogram-merge per metric kind), the replica-labeled
+  ``/metrics?scope=fleet`` Prometheus exposition, and the
+  :class:`~.aggregate.FleetHealth` control room the router drives;
 - :mod:`.report` — merges scalars + timeline traces + flight records + HLO
   audits + request traces into one run summary (CLI:
   ``tools/obs_report.py``).
@@ -67,6 +76,17 @@ from neuronx_distributed_tpu.obs.memory_ledger import (
     MEMORY_BREAKDOWN_FILE,
     MemoryLedger,
     read_memory_breakdown,
+)
+from neuronx_distributed_tpu.obs.health import (
+    ALERT_SCHEMA,
+    ALERTS_FILE,
+    BurnRateRule,
+    HealthMonitor,
+    Rule,
+    ThresholdRule,
+    TrendRule,
+    default_rules,
+    read_alerts,
 )
 from neuronx_distributed_tpu.obs.registry import (
     Counter,
@@ -124,6 +144,7 @@ class Observability:
         timeline: Any = None,
         registry: Optional[MetricRegistry] = None,
         ledgers: bool = False,
+        health: Any = False,
     ):
         self.out_dir = out_dir
         os.makedirs(out_dir, exist_ok=True)
@@ -156,6 +177,29 @@ class Observability:
                 path=os.path.join(out_dir, COMPILE_LEDGER_FILE),
                 registry=self.registry, flight=self.flight,
                 memory_ledger=self.memory_ledger)
+        # fleet health monitor (health=True or a rule list builds one with
+        # the default pack; pass a HealthMonitor to keep the rules/sink):
+        # evaluated on the observe_step cadence over this hub's registry,
+        # alert edges streamed to alerts.jsonl under out_dir.  Off by
+        # default — every consumer guards on `is not None`, so the hot
+        # path stays allocation-free (the ALERTS_EVALUATED discipline).
+        self.health_monitor: Optional[HealthMonitor] = None
+        if isinstance(health, HealthMonitor):
+            self.health_monitor = health
+            health.attach_registry(self.registry)
+        elif health:
+            if isinstance(health, str):  # a default-pack scope name
+                rules = default_rules(health)
+            elif isinstance(health, (list, tuple)):
+                rules = list(health)
+            else:
+                # health=True: the hub serves BOTH fit() and serving
+                # engines, so the bare boolean gets the union pack —
+                # scope-specific rules over absent metrics stay silent
+                rules = default_rules("all")
+            self.health_monitor = HealthMonitor(
+                rules, registry=self.registry,
+                path=os.path.join(out_dir, ALERTS_FILE))
         self._last_step = 0
         self._closed = False
         # pre-declare the step metrics so a zero-step run still exports them
@@ -180,7 +224,10 @@ class Observability:
         if fields.get("data_wait_s") is not None:
             reg.histogram("train/data_wait_ms", MS_BUCKETS).observe(
                 1e3 * float(fields["data_wait_s"]))
-        return self.flight.record(step, **fields)
+        warnings = self.flight.record(step, **fields)
+        if self.health_monitor is not None:
+            self.health_monitor.on_step()
+        return warnings
 
     # -- compile path ------------------------------------------------------
 
@@ -226,6 +273,8 @@ class Observability:
                 self.memory_ledger.dump(reason=reason)
             except OSError as e:  # telemetry IO must never mask the exit
                 logger.warning("obs: memory breakdown dump failed: %s", e)
+        if self.health_monitor is not None:
+            self.health_monitor.close()
         with open(self.prometheus_path, "w") as f:
             f.write(self.registry.prometheus_text())
 
@@ -239,6 +288,15 @@ class Observability:
 __all__ = [
     "Observability",
     "MetricRegistry",
+    "HealthMonitor",
+    "Rule",
+    "ThresholdRule",
+    "TrendRule",
+    "BurnRateRule",
+    "default_rules",
+    "read_alerts",
+    "ALERTS_FILE",
+    "ALERT_SCHEMA",
     "CompileLedger",
     "MemoryLedger",
     "read_compile_ledger",

@@ -6,11 +6,17 @@ nothing could scrape a RUNNING trainer or serving engine.  This module is
 the missing transport: a stdlib ``http.server`` thread exposing
 
 - ``GET /metrics``  — the Prometheus text exposition (re-rendered per
-  scrape, so gauges/counters are always current);
-- ``GET /healthz``  — a JSON liveness document from a caller-supplied
-  probe (e.g. engine steps / active slots, or fleet replicas alive);
-  a falsy ``"ok"`` answers 503, so a dead fleet fails load-balancer
-  checks instead of serving stale 200s.
+  scrape, so gauges/counters are always current); ``?scope=NAME`` selects
+  an alternate renderer from ``scopes`` (the fleet wiring registers
+  ``scope=fleet`` — the replica-labeled merged exposition from
+  :class:`~.aggregate.FleetAggregator`);
+- ``GET /healthz``  — a JSON READINESS document: the caller-supplied
+  liveness probe (e.g. engine steps / active slots, or fleet replicas
+  alive) merged with the attached health ``monitor``'s rule state
+  (``monitor=`` — a :class:`~.health.HealthMonitor` or
+  :class:`~.aggregate.FleetHealth`); a falsy ``"ok"`` — liveness gone OR
+  a ``page``-severity alert firing — answers 503, so a dead-or-paging
+  fleet fails load-balancer checks instead of serving stale 200s.
 
 Attach points: ``examples/inference/runner.py serve --metrics-port N`` (a
 live serving engine or fleet) and the standalone ``tools/metrics_server.py``
@@ -25,6 +31,7 @@ import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Iterable, Optional
+from urllib.parse import parse_qs
 
 from neuronx_distributed_tpu.utils.logger import get_logger
 
@@ -37,29 +44,48 @@ class MetricsServer:
     """Background-thread HTTP server for ``/metrics`` + ``/healthz``.
 
     ``registry`` supplies the metrics text (or pass ``text_fn`` for a
-    custom renderer — the CLI's scalars-file mode does).  ``health_fn``
-    returns the liveness dict; omit it for a constant ``{"ok": true}``.
+    custom renderer — the CLI's scalars-file mode does).  ``scopes`` maps
+    ``?scope=NAME`` to alternate renderers (unknown scopes answer 400).
+    ``health_fn`` returns the liveness dict; ``monitor`` (an object with
+    ``healthz()`` — a health monitor or fleet health) folds rule state
+    into the same document, and the response is 503 unless BOTH agree ok.
     ``port=0`` binds an ephemeral port (read :attr:`port` after
     construction — the test harness pattern)."""
 
     def __init__(self, registry=None, *,
                  text_fn: Optional[Callable[[], str]] = None,
                  health_fn: Optional[Callable[[], dict]] = None,
+                 monitor=None,
+                 scopes: Optional[Dict[str, Callable[[], str]]] = None,
                  port: int = 0, host: str = "0.0.0.0"):
         if registry is None and text_fn is None:
             raise ValueError("MetricsServer needs a registry or a text_fn")
         self._text_fn = (text_fn if text_fn is not None
                          else registry.prometheus_text)
+        self._scopes = dict(scopes) if scopes else {}
+        self._monitor = monitor
         self._health_fn = health_fn if health_fn is not None else (
             lambda: {"ok": True})
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib handler name)
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
+                    params = parse_qs(query)
+                    scope = params.get("scope", [None])[0]
+                    if scope is None:
+                        fn = outer._text_fn
+                    else:
+                        fn = outer._scopes.get(scope)
+                        if fn is None:
+                            self._reply(
+                                400, "text/plain",
+                                f"unknown scope {scope!r} (known: "
+                                f"{sorted(outer._scopes)})\n".encode())
+                            return
                     try:
-                        body = outer._text_fn().encode()
+                        body = fn().encode()
                     except Exception as e:  # a broken renderer is a 500
                         self._reply(500, "text/plain",
                                     f"metrics error: {e}\n".encode())
@@ -68,6 +94,14 @@ class MetricsServer:
                 elif path == "/healthz":
                     try:
                         doc = outer._health_fn()
+                        if outer._monitor is not None:
+                            # readiness = liveness AND rule state: a
+                            # page-severity alert takes the target out of
+                            # the load balancer even while it still steps
+                            hz = outer._monitor.healthz()
+                            doc = {**doc, **hz,
+                                   "ok": bool(doc.get("ok", True))
+                                   and bool(hz.get("ok", True))}
                     except Exception as e:
                         doc = {"ok": False, "error": str(e)}
                     code = 200 if doc.get("ok") else 503

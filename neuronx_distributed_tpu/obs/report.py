@@ -45,9 +45,15 @@ from neuronx_distributed_tpu.obs.tracing import (
 # v3 (resource-ledger PR): required "compile" (compile_ledger.jsonl
 # rollup) and "memory" (mem/* gauges + memory_breakdown.json) sections,
 # both null when the run carried no ledger.
-OBS_REPORT_SCHEMA = "obs_report_v3"
+# v4 (fleet-health PR): required "alerts" section (alerts.jsonl rollup —
+# firing count, worst severity, per-rule edges and time-firing; null when
+# the run carried no health monitor), and --run-dir auto-discovers fleet
+# layouts (per-replica scalars/serving_stats subdirectories merged via
+# obs.aggregate, router_stats.jsonl rolled into the fleet section).
+OBS_REPORT_SCHEMA = "obs_report_v4"
 SUPERVISOR_EVENTS_FILE = "supervisor_events.jsonl"
 SERVING_STATS_FILE = "serving_stats.jsonl"
+ROUTER_STATS_FILE = "router_stats.jsonl"
 
 
 def _read_scalar_file(path: str) -> List[dict]:
@@ -443,12 +449,15 @@ def _summarize_memory(scalars: Dict[str, dict],
 def compare_resources(run_a: str, run_b: str,
                       compile_threshold: float = 0.0,
                       mem_threshold: float = 0.05) -> dict:
-    """Run-to-run compile/memory regression diff (``tools/obs_report.py
-    --compare RUN_A RUN_B``): reads each run dir's ``compile_ledger.jsonl``
-    and ``memory_breakdown.json`` and flags B against A — more compiles
-    than ``(1 + compile_threshold) * A`` (or any storm in B), or any
-    subsystem's peak bytes past ``(1 + mem_threshold) * A``'s.  Returns
-    ``{"a", "b", "compile", "memory", "regressions", "regressed",
+    """Run-to-run compile/memory/alert regression diff
+    (``tools/obs_report.py --compare RUN_A RUN_B``): reads each run dir's
+    ``compile_ledger.jsonl``, ``memory_breakdown.json`` and
+    ``*alerts.jsonl`` and flags B against A — more compiles than
+    ``(1 + compile_threshold) * A`` (or any storm in B), any subsystem's
+    peak bytes past ``(1 + mem_threshold) * A``'s, or any alert RULE that
+    fired in B without firing in A (a new alert under the same workload is
+    a health regression, threshold-free).  Returns ``{"a", "b",
+    "compile", "memory", "alerts", "regressions", "regressed",
     "markdown"}``."""
     def load(run_dir):
         cl_path = os.path.join(run_dir, COMPILE_LEDGER_FILE)
@@ -457,10 +466,12 @@ def compare_resources(run_a: str, run_b: str,
                        if os.path.exists(cl_path) else None)
         breakdown = (read_memory_breakdown(mb_path)
                      if os.path.exists(mb_path) else None)
-        return compile_sum, breakdown
+        alerts = summarize_alerts(
+            sorted(glob.glob(os.path.join(run_dir, "*alerts.jsonl"))))
+        return compile_sum, breakdown, alerts
 
-    ca, ma = load(run_a)
-    cb, mb = load(run_b)
+    ca, ma, aa = load(run_a)
+    cb, mb, ab = load(run_b)
     regressions: List[str] = []
     lines = ["# Resource regression diff", "",
              f"- A: `{run_a}`", f"- B: `{run_b}`", ""]
@@ -503,6 +514,34 @@ def compare_resources(run_a: str, run_b: str,
                 f"memory regressed: new subsystem {name} appeared in B "
                 f"({pb:,.0f} peak bytes, no baseline in A)")
     lines.append("")
+
+    def fired_rules(alerts):
+        if alerts is None:
+            return {}
+        return {name: agg for name, agg in alerts["rules"].items()
+                if agg["fired"]}
+
+    fa, fb = fired_rules(aa), fired_rules(ab)
+    if aa is not None or ab is not None:
+        lines += ["## Alerts (firing edges)", "",
+                  "| rule | A | B |", "|---|---|---|"]
+        for name in sorted(set(fa) | set(fb)):
+            va = fa[name]["fired"] if name in fa else (
+                0 if aa is not None else "n/a")
+            vb = fb[name]["fired"] if name in fb else (
+                0 if ab is not None else "n/a")
+            lines.append(f"| {name} | {va} | {vb} |")
+        if not (fa or fb):
+            lines.append("| (none fired) | 0 | 0 |")
+        lines.append("")
+    if aa is not None:
+        # a rule firing in B that never fired in A is a regression under
+        # the same workload — no threshold, presence is the signal
+        for name in sorted(set(fb) - set(fa)):
+            regressions.append(
+                f"alerts regressed: rule {name!r} fired "
+                f"{fb[name]['fired']}x in B (severity "
+                f"{fb[name]['severity']}), never in A")
     if regressions:
         lines += ["## Regressions", ""] + [f"- {r}" for r in regressions] \
             + [""]
@@ -517,10 +556,83 @@ def compare_resources(run_a: str, run_b: str,
                    "b": mb and {k: mb[k] for k in
                                 ("subsystems", "total_bytes",
                                  "peak_total_bytes")}},
+        "alerts": {"a": aa, "b": ab},
         "regressions": regressions,
         "regressed": bool(regressions),
         "markdown": "\n".join(lines),
     }
+
+
+def summarize_alerts(paths: Sequence[str]) -> Optional[dict]:
+    """The "alerts" section: roll every ``alerts.jsonl`` edge stream into
+    firing count, worst severity among still-firing alerts, and per-rule
+    edge counts + total time-firing (fire→resolve pairs on the monotonic
+    clock; an unresolved alert accrues until the stream's last stamp).
+    Returns None when no alert files exist (the report key is null, not
+    {}) — an existing-but-quiet file reports zero edges."""
+    from neuronx_distributed_tpu.obs.health import read_alerts, worst_severity
+
+    records: List[dict] = []
+    files = 0
+    for p in paths:
+        if os.path.exists(p):
+            files += 1
+            records.extend(read_alerts(p))
+    if not files:
+        return None
+    records.sort(key=lambda r: r.get("mono", 0.0))
+    last_mono = records[-1].get("mono", 0.0) if records else 0.0
+    per_key: Dict[tuple, dict] = {}
+    for r in records:
+        key = (r.get("rule", "?"), r.get("key", ""), r.get("replica", -1))
+        st = per_key.setdefault(key, {
+            "rule": key[0], "severity": r.get("severity", "warn"),
+            "fired": 0, "resolved": 0, "firing_since": None,
+            "time_firing_s": 0.0})
+        st["severity"] = r.get("severity", st["severity"])
+        if r.get("state") == "firing":
+            st["fired"] += 1
+            st["firing_since"] = r.get("mono", 0.0)
+        else:
+            st["resolved"] += 1
+            if st["firing_since"] is not None:
+                st["time_firing_s"] += max(
+                    r.get("mono", 0.0) - st["firing_since"], 0.0)
+                st["firing_since"] = None
+    rules: Dict[str, dict] = {}
+    firing_now: List[dict] = []
+    for st in per_key.values():
+        if st["firing_since"] is not None:  # never resolved: accrue to end
+            st["time_firing_s"] += max(last_mono - st["firing_since"], 0.0)
+            firing_now.append(st)
+        agg = rules.setdefault(st["rule"], {
+            "severity": st["severity"], "fired": 0, "resolved": 0,
+            "firing": 0, "time_firing_s": 0.0})
+        agg["fired"] += st["fired"]
+        agg["resolved"] += st["resolved"]
+        agg["firing"] += int(st["firing_since"] is not None)
+        agg["time_firing_s"] = round(
+            agg["time_firing_s"] + st["time_firing_s"], 6)
+        if _sev_rank(st["severity"]) > _sev_rank(agg["severity"]):
+            agg["severity"] = st["severity"]
+    top = sorted(((name, agg["time_firing_s"])
+                  for name, agg in rules.items()),
+                 key=lambda kv: -kv[1])[:5]
+    return {
+        "files": files,
+        "records": len(records),
+        "firing": len(firing_now),
+        "worst_severity": worst_severity(
+            [st["severity"] for st in firing_now]),
+        "rules": dict(sorted(rules.items())),
+        "top_firing_s": [[name, s] for name, s in top if s > 0],
+    }
+
+
+def _sev_rank(severity: str) -> int:
+    from neuronx_distributed_tpu.obs.health import _SEV_ORDER
+
+    return _SEV_ORDER.get(severity, 0)
 
 
 def read_serving_stats(path: str) -> List[dict]:
@@ -665,18 +777,56 @@ def build_report(
     serving_stats_path: Optional[str] = None,
     compile_ledger_path: Optional[str] = None,
     memory_breakdown_path: Optional[str] = None,
+    alerts_paths: Sequence[str] = (),
+    router_stats_path: Optional[str] = None,
     tail: int = 10,
 ) -> dict:
     """Merge the artifacts into one summary document.
 
     ``run_dir`` seeds the default artifact locations (``scalars.jsonl``,
     ``flight_record.json``, ``hlo_audit.jsonl``, ``supervisor_events.jsonl``
-    and any ``*trace*.json`` inside it); the explicit path arguments add
-    to / override them."""
+    and any ``*trace*.json`` / ``*alerts.jsonl`` inside it); the explicit
+    path arguments add to / override them.  A FLEET run dir — immediate
+    subdirectories each holding a replica's ``scalars.jsonl`` /
+    ``serving_stats.jsonl`` — is auto-discovered: per-replica scalars
+    merge through :mod:`~.aggregate` (counters/histograms sum, so the
+    fleet histogram is the histogram of every replica's samples),
+    serving stats concatenate, and a top-level ``router_stats.jsonl``
+    rolls into the fleet section."""
     scalar_paths = list(scalar_paths)
     timeline_paths = list(timeline_paths)
     trace_paths = list(trace_paths)
+    alerts_paths = list(alerts_paths)
+    serving_stats_paths = ([serving_stats_path]
+                           if serving_stats_path else [])
+    fleet_scalar_streams: List[List[dict]] = []
+    fleet_replicas: List[str] = []
     if run_dir:
+        from neuronx_distributed_tpu.obs.aggregate import (
+            discover_replica_dirs,
+        )
+
+        for label, sub in discover_replica_dirs(run_dir):
+            fleet_replicas.append(label)
+            q = os.path.join(sub, SCALARS_FILE)
+            if os.path.exists(q):
+                fleet_scalar_streams.append(_read_scalar_file(q))
+            q = os.path.join(sub, SERVING_STATS_FILE)
+            if os.path.exists(q) and q not in serving_stats_paths:
+                serving_stats_paths.append(q)
+            for q in sorted(glob.glob(os.path.join(sub, "*alerts.jsonl"))):
+                if q not in alerts_paths:
+                    alerts_paths.append(q)
+            for q in sorted(glob.glob(
+                    os.path.join(sub, f"*{TRACE_EVENTS_FILE}"))):
+                if q not in trace_paths:
+                    trace_paths.append(q)
+        if router_stats_path is None:
+            q = os.path.join(run_dir, ROUTER_STATS_FILE)
+            router_stats_path = q if os.path.exists(q) else None
+        for q in sorted(glob.glob(os.path.join(run_dir, "*alerts.jsonl"))):
+            if q not in alerts_paths:
+                alerts_paths.append(q)
         p = os.path.join(run_dir, SCALARS_FILE)
         if os.path.exists(p) and p not in scalar_paths:
             scalar_paths.append(p)
@@ -699,6 +849,9 @@ def build_report(
         if serving_stats_path is None:
             q = os.path.join(run_dir, SERVING_STATS_FILE)
             serving_stats_path = q if os.path.exists(q) else None
+        if serving_stats_path and serving_stats_path \
+                not in serving_stats_paths:
+            serving_stats_paths.append(serving_stats_path)
         if compile_ledger_path is None:
             q = os.path.join(run_dir, COMPILE_LEDGER_FILE)
             compile_ledger_path = q if os.path.exists(q) else None
@@ -709,6 +862,15 @@ def build_report(
     scalar_records: List[dict] = []
     for p in scalar_paths:
         scalar_records.extend(_read_scalar_file(p))
+    if fleet_scalar_streams:
+        # per-replica streams merge into ONE synthetic stream (counters +
+        # histogram buckets sum across replicas) — concatenating the raw
+        # streams would let one replica's latest snapshot shadow the rest
+        from neuronx_distributed_tpu.obs.aggregate import (
+            merge_scalar_records,
+        )
+
+        scalar_records.extend(merge_scalar_records(fleet_scalar_streams))
 
     flight = None
     if flight_path and os.path.exists(flight_path):
@@ -738,10 +900,28 @@ def build_report(
     fleet = _summarize_fleet(scalars)
     tenancy = _summarize_tenancy(scalars)
     slo = _summarize_slo(scalars, histograms)
-    stats_records = (read_serving_stats(serving_stats_path)
-                     if serving_stats_path
-                     and os.path.exists(serving_stats_path) else [])
+    if len(serving_stats_paths) > 1:
+        from neuronx_distributed_tpu.obs.aggregate import merge_serving_stats
+
+        stats_records = merge_serving_stats(serving_stats_paths)
+    else:
+        stats_records = (read_serving_stats(serving_stats_paths[0])
+                         if serving_stats_paths
+                         and os.path.exists(serving_stats_paths[0]) else [])
     trace = summarize_trace(trace_paths, stats_records)
+    alerts_section = summarize_alerts(alerts_paths)
+    if router_stats_path:
+        from neuronx_distributed_tpu.obs.aggregate import (
+            summarize_router_stats,
+        )
+
+        router_stats = summarize_router_stats(router_stats_path)
+    else:
+        router_stats = None
+    if router_stats is not None and fleet is not None:
+        fleet = {**fleet, "router_stats": router_stats}
+    elif router_stats is not None:
+        fleet = {"router_stats": router_stats}
     ledger_records = (read_compile_ledger(compile_ledger_path)
                       if compile_ledger_path
                       and os.path.exists(compile_ledger_path) else [])
@@ -761,9 +941,12 @@ def build_report(
             "timelines": timeline_paths,
             "supervisor_events": supervisor_events_path,
             "traces": trace_paths,
-            "serving_stats": serving_stats_path,
+            "serving_stats": serving_stats_paths,
             "compile_ledger": compile_ledger_path,
             "memory_breakdown": memory_breakdown_path,
+            "alerts": alerts_paths,
+            "router_stats": router_stats_path,
+            "fleet_replicas": fleet_replicas,
         },
         "scalars": scalars,
         "histograms": histograms,
@@ -775,6 +958,7 @@ def build_report(
         "trace": trace,
         "compile": compile_section,
         "memory": memory_section,
+        "alerts": alerts_section,
         "health": {
             "anomaly_count": len(anomalies),
             "host_blocked": host_blocked,
@@ -792,6 +976,14 @@ def build_report(
             "memory": (None if memory_section is None else {
                 "total_bytes": memory_section["total_bytes"],
                 "peak_total_bytes": memory_section["peak_total_bytes"]}),
+            # slim alerts rollup — the full per-rule table lives once, at
+            # the top-level "alerts" section
+            "alerts": (None if alerts_section is None else {
+                "firing": alerts_section["firing"],
+                "worst_severity": alerts_section["worst_severity"],
+                "rules_fired": sum(
+                    1 for agg in alerts_section["rules"].values()
+                    if agg["fired"])}),
             "total_collective_count": sum(
                 a.get("total_collective_count", 0) for a in audits),
             "total_collective_bytes": sum(
@@ -806,6 +998,14 @@ def render_markdown(report: dict) -> str:
     """Human-readable rendering of :func:`build_report` output."""
     lines = ["# Run report", ""]
     h = report["health"]
+    alerts = report.get("alerts")
+    if alerts:
+        worst = alerts["worst_severity"] or "none"
+        fired = sum(agg["fired"] for agg in alerts["rules"].values())
+        lines.append(
+            f"- alerts: **{alerts['firing']} firing** (worst severity "
+            f"{worst}); {fired} firing edge(s) across "
+            f"{len(alerts['rules'])} rule(s)")
     lines.append(f"- anomalies: **{h['anomaly_count']}**")
     lines.append(f"- supervisor restarts: **{h.get('restarts', 0)}**")
     lines.append(f"- collectives across audited programs: "
@@ -832,7 +1032,14 @@ def render_markdown(report: dict) -> str:
             f"{kv['evictions']:.0f} evictions, "
             f"{kv['cow_copies']:.0f} cow copies; {gather}")
     fleet = h.get("fleet")
-    if fleet:
+    if fleet and "router_stats" in fleet and fleet["router_stats"]:
+        rstats = fleet["router_stats"]
+        states = ", ".join(f"{k} {v}" for k, v in rstats["by_state"].items())
+        lines.append(
+            f"- router stats: {rstats['records']} terminal record(s) "
+            f"({states}); {rstats['requeued']} survived a failover across "
+            f"replicas {rstats['replicas_seen']}")
+    if fleet and "dispatched" in fleet:
         aff = (f"{fleet['affinity_hit_rate']:.1%} affinity hits "
                f"({fleet['affinity_hits']:.0f}/"
                f"{fleet['affinity_hits'] + fleet['affinity_misses']:.0f})"
@@ -956,6 +1163,21 @@ def render_markdown(report: dict) -> str:
             lines.append(f"- step {rec['step']}: " + ", ".join(
                 f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in rec.items() if k not in ("step", "time")))
+        lines.append("")
+
+    alerts = report.get("alerts")
+    if alerts and alerts["rules"]:
+        lines += ["## Alerts", "",
+                  "| rule | severity | fired | resolved | firing | "
+                  "time firing (s) |",
+                  "|---|---|---|---|---|---|"]
+        for name, agg in sorted(
+                alerts["rules"].items(),
+                key=lambda kv: -kv[1]["time_firing_s"]):
+            lines.append(
+                f"| {name} | {agg['severity']} | {agg['fired']} | "
+                f"{agg['resolved']} | {agg['firing']} | "
+                f"{agg['time_firing_s']:.3f} |")
         lines.append("")
 
     if report["anomalies"]:

@@ -124,6 +124,20 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "family": str, "key": str, "kind": str,
         "wall_ms": (int, float, type(None)), "after_warmup": bool,
     },
+    # one line of alerts.jsonl (obs.health.HealthMonitor) — one record per
+    # alert EDGE (state "firing" | "resolved"; steady states are never
+    # re-emitted).  rule names the rule (or externally-driven condition,
+    # e.g. replica_down), window labels a burn-rate rule's window pair
+    # (null for point rules), observed/bound carry the evidence at the
+    # edge (null when the edge is event-driven), replica tags the emitting
+    # monitor (-1 = fleet/off-fleet).  Extra keys carry rule detail
+    # (duration_s on resolves, key/cause on conditions, slow_ewma, ...).
+    "alert": {
+        "schema": str, "time": _NUM, "mono": _NUM, "rule": str,
+        "severity": str, "state": str, "window": (str, type(None)),
+        "observed": (int, float, type(None)),
+        "bound": (int, float, type(None)), "replica": int,
+    },
     # memory_breakdown.json (obs.memory_ledger.MemoryLedger.dump) — the
     # per-subsystem device-byte breakdown, dumped on demand and on
     # RESOURCE_EXHAUSTED (reason "oom:<ExcType>"); "top" names the biggest
@@ -137,13 +151,17 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     # key (per-request waterfalls from trace_events.jsonl); v3 adds the
     # resource-ledger sections — "compile" (compile_ledger.jsonl rollup)
     # and "memory" (mem/* gauges + memory_breakdown.json), both null when
-    # the run carried no ledger
+    # the run carried no ledger; v4 (fleet health PR) adds the required
+    # "alerts" section (alerts.jsonl rollup: firing count, worst severity,
+    # per-rule edge counts and time-firing; null when the run carried no
+    # health monitor)
     "obs_report": {
         "schema": str, "generated_at": _NUM, "scalars": dict,
         "histograms": dict, "flight": (dict, type(None)),
         "anomalies": list, "hlo_audits": list, "timeline": dict,
         "supervisor": (dict, type(None)), "trace": (dict, type(None)),
         "compile": (dict, type(None)), "memory": (dict, type(None)),
+        "alerts": (dict, type(None)),
     },
 }
 
@@ -274,6 +292,11 @@ REGISTRY_METRICS: Dict[str, str] = {
     "mem/device_peak_bytes": "gauge",
     "mem/device_bytes_limit": "gauge",
     "mem/live_array_bytes": "gauge",
+    # fleet health monitor (obs.health.HealthMonitor): alerts currently
+    # firing and total firing edges since start — the two numbers an
+    # external pager scrapes alongside /healthz
+    "obs/alerts_firing": "gauge",
+    "obs/alerts_total": "counter",
 }
 
 

@@ -407,6 +407,16 @@ class ServingEngine:
     (default) is ZERO overhead: every call site is guarded, no span is
     ever allocated.  Terminal ``serving_stats`` records carry ``trace_id``
     linking them into ``trace_events.jsonl``.
+
+    Fleet health monitor (this PR): ``health=`` (an
+    ``obs.health.HealthMonitor``; defaults to ``obs.health_monitor`` when
+    an ``Observability(health=...)`` hub is attached) evaluates its rule
+    pack over this engine's registry on the step cadence — threshold /
+    EWMA-trend / SLO burn-rate rules firing schema-checked ``alerts.jsonl``
+    edges — and every terminal request feeds its per-class deadline
+    attainment into the burn-rate windows.  ``health=None`` (default) is
+    allocation-free: every call site is guarded, proven by the
+    ``obs.health.ALERTS_EVALUATED`` counter.
     """
 
     def __init__(
@@ -437,6 +447,7 @@ class ServingEngine:
         tracer: Any = None,
         compile_ledger: Any = None,
         memory_ledger: Any = None,
+        health: Any = None,
     ):
         attrs = ("prefill_one", "insert_slot", "decode_slots")
         if page_size is not None:
@@ -643,6 +654,16 @@ class ServingEngine:
         self.tracer = tracer
         self._rt: dict = {}       # rid -> {"root": Span, "phase": Span?}
         self._batch_span = None   # open decode_step/spec_round batch span
+        # fleet health monitor (obs.health.HealthMonitor, None = off;
+        # falls back to the Observability hub's when one is attached):
+        # evaluated on the step cadence over THIS registry, fed one SLO
+        # event per terminal request.  Guarded at every call site so the
+        # default path allocates nothing (ALERTS_EVALUATED discipline).
+        if health is None and obs is not None:
+            health = getattr(obs, "health_monitor", None)
+        self._health = health
+        if health is not None:
+            health.attach_registry(self.registry)
         self.scheduler = SlotScheduler(
             self.B, self.C, self.T, max_queue=max_queue,
             page_gate=self._kv, reserve_extra=self._spec_k,
@@ -1017,6 +1038,10 @@ class ServingEngine:
                 queue_depth=self.scheduler.queue_depth,
                 slots_active=self.scheduler.active_count,
                 terminal=len(outputs))
+        if self._health is not None:
+            # rule evaluation rides the engine clock (alert edges share
+            # the spans'/stats' timescale under a fake-clock harness)
+            self._health.on_step(now=self._clock())
         return outputs
 
     def dump_flight(self, reason: str) -> Optional[str]:
@@ -2089,4 +2114,8 @@ class ServingEngine:
             }
             self._stats_f.write(json.dumps(rec) + "\n")
             self._stats_f.flush()
+        if self._health is not None:
+            # per-class deadline attainment feeds the SLO burn-rate
+            # windows: good = finished within its deadline
+            self._health.note_output(out, now)
         return out

@@ -137,7 +137,11 @@ class FleetRouter:
     created when omitted).  ``shadow_resync_every`` (router steps) bounds
     shadow staleness against evictions; restarts always resync immediately.
     ``max_pending`` bounds the router-held queue used when no live replica
-    can take a dispatch (``BackpressureError`` beyond it)."""
+    can take a dispatch (``BackpressureError`` beyond it).  ``health`` (an
+    ``obs.aggregate.FleetHealth``, None = off) wires the fleet control
+    room: per-replica + fleet-level rule monitors evaluated on the step
+    cadence, terminal outputs feeding the SLO burn-rate windows, and
+    failover/restart edges firing/resolving the ``replica_down`` alert."""
 
     def __init__(self, replicas: Sequence[Replica], *,
                  policy: "str | RoutingPolicy" = "prefix_affinity",
@@ -149,7 +153,8 @@ class FleetRouter:
                  retain_done: int = 4096,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
-                 tracer: Any = None):
+                 tracer: Any = None,
+                 health: Any = None):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         ids = [r.replica_id for r in replicas]
@@ -166,6 +171,16 @@ class FleetRouter:
         # per-replica scopes of the SAME tracer (tracer.scoped(rid)), and
         # a request's whole cross-replica trace stitches by its global id.
         self.tracer = tracer
+        # fleet health monitor (obs.aggregate.FleetHealth, None = off):
+        # per-replica monitors + one fleet monitor over the MERGED
+        # registry snapshot, evaluated on the fleet-step cadence; every
+        # terminal output feeds the fleet burn-rate windows, and
+        # failover/warm-restart edges raise/clear the `replica_down`
+        # condition.  Guarded at every call site — health off allocates
+        # nothing (the ALERTS_EVALUATED discipline).
+        self._health = health
+        if health is not None:
+            health.attach_router(self)
         self._clock = clock
         self._stats_path = stats_path
         self._stats_f = None
@@ -302,6 +317,9 @@ class FleetRouter:
                 # shadow tracks exactly what the fresh index holds (nothing)
                 self.shadows[replica.replica_id].resync(
                     replica.prefix_fingerprints())
+                if self._health is not None:
+                    # warm restart: the replica_down alert resolves
+                    self._health.replica_up(replica.replica_id, now)
             elif replica.state is ReplicaState.RETIRED:
                 # a failed REBUILD spent the budget (factory raised):
                 # DEAD -> RETIRED happened inside try_restart, so count it
@@ -357,6 +375,13 @@ class FleetRouter:
                     self.shadows[rid].resync(replica.prefix_fingerprints())
 
         self._export_gauges(full=resync or failed_over)
+        if self._health is not None:
+            # every terminal output — engine-emitted or router-synthetic —
+            # feeds the fleet SLO burn-rate windows exactly once, then the
+            # monitors evaluate on their cadence over the merged snapshot
+            for out in outputs:
+                self._health.note_output(out, now)
+            self._health.step(self, now)
         return outputs
 
     def run_until_complete(self, max_steps: Optional[int] = None
@@ -617,6 +642,10 @@ class FleetRouter:
         logger.warning("fleet: replica %d crashed mid-step (%s) — draining",
                        replica.replica_id, cause)
         self.registry.counter("router/failovers_total").inc()
+        if self._health is not None:
+            # the replica_down condition fires (page severity) and stays
+            # firing until try_restart re-enters the replica into rotation
+            self._health.replica_down(replica.replica_id, cause, now)
         orphans = [rec for rec in self._tracked.values()
                    if not rec.done and rec.replica_id == replica.replica_id]
         replica.mark_dead(f"step_crash:{type(exc).__name__}", now)

@@ -32,7 +32,7 @@ def test_schema_list_is_complete():
             "hlo_audit", "tpu_watch", "obs_report",
             "serving_stats", "supervisor_event",
             "router_stats", "trace_event",
-            "compile_ledger", "memory_breakdown"} <= set(SCHEMAS)
+            "compile_ledger", "memory_breakdown", "alert"} <= set(SCHEMAS)
 
 
 def test_committed_tpu_watch_results_validate():
@@ -412,6 +412,55 @@ def test_compile_ledger_and_memory_breakdown_schemas(tmp_path):
     md = render_markdown(report)
     assert "- compile:" in md and "1 storm(s)" in md
     assert "## Memory ledger" in md
+
+
+def test_alert_schema_and_registry_metrics(tmp_path):
+    """alerts.jsonl smoke: the HealthMonitor's own sink validates against
+    the checked-in alert schema (the live engine/fleet emitter paths are
+    covered end-to-end in tests/test_health.py), the obs/alerts_* registry
+    pair is declared with its kinds, and hand-built records missing the
+    edge fields are rejected."""
+    from neuronx_distributed_tpu.obs.health import (
+        HealthMonitor,
+        ThresholdRule,
+        read_alerts,
+    )
+    from neuronx_distributed_tpu.obs.schemas import (
+        REGISTRY_METRICS,
+        validate_registry_metrics,
+    )
+
+    assert {"obs/alerts_firing", "obs/alerts_total"} <= set(REGISTRY_METRICS)
+    reg = MetricRegistry()
+    path = str(tmp_path / "alerts.jsonl")
+    mon = HealthMonitor([ThresholdRule("queue_backlog", "g", 1.0)],
+                        registry=reg, path=path)
+    reg.gauge("g").set(5.0)
+    mon.evaluate()
+    reg.gauge("g").set(0.0)
+    mon.evaluate()
+    mon.set_condition("replica_down", True, key="1", severity="page")
+    mon.close()
+    assert validate_jsonl("alert", path) == 3
+    recs = read_alerts(path)
+    assert [r["state"] for r in recs] == ["firing", "resolved", "firing"]
+    assert recs[1]["duration_s"] >= 0.0  # resolve edges carry duration
+    assert recs[2]["key"] == "1"         # conditions carry their key
+    validate_registry_metrics(reg)
+    with pytest.raises(ValueError, match="missing required field"):
+        bad = dict(recs[0])
+        del bad["mono"]
+        validate_record("alert", bad)
+    with pytest.raises(ValueError, match="expected"):
+        validate_record("alert", dict(recs[0], observed="high"))
+
+    # ... and the report's alerts section builds from the artifact
+    from neuronx_distributed_tpu.obs.report import build_report
+
+    report = build_report(run_dir=str(tmp_path))
+    validate_record("obs_report", report)
+    assert report["alerts"]["firing"] == 1
+    assert report["alerts"]["worst_severity"] == "page"
 
 
 def test_trace_events_schema(tmp_path):

@@ -40,7 +40,8 @@ import tempfile
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 
-def _build_fleet(model, n_replicas, policy, seed, stats_path=None, **engine_kw):
+def _build_fleet(model, n_replicas, policy, seed, stats_path=None,
+                 health=None, **engine_kw):
     from neuronx_distributed_tpu.obs import MetricRegistry
     from neuronx_distributed_tpu.serving import FleetRouter, Replica, ServingEngine
 
@@ -49,7 +50,54 @@ def _build_fleet(model, n_replicas, policy, seed, stats_path=None, **engine_kw):
 
     return FleetRouter(
         [Replica(i, factory, backoff_base_s=0.01) for i in range(n_replicas)],
-        policy=policy, seed=seed, stats_path=stats_path)
+        policy=policy, seed=seed, stats_path=stats_path, health=health)
+
+
+# rungs whose <rung>.alerts.jsonl was already truncated this process: a
+# rung's sequential fleets (best-of-two, policy pairs) APPEND to one file,
+# but a rerun into a previously-used --alerts-out must start fresh
+_ALERT_RUNGS_STARTED: set = set()
+
+
+def _make_fleet_health(args, rung: str):
+    """A per-rung :class:`~...obs.aggregate.FleetHealth` (default fleet +
+    per-replica rule packs streaming to one ``<rung>.alerts.jsonl``) when
+    ``--alerts-out`` is set, else None."""
+    if not getattr(args, "alerts_out", None):
+        return None, None
+    from neuronx_distributed_tpu.obs.aggregate import FleetHealth
+
+    os.makedirs(args.alerts_out, exist_ok=True)
+    path = os.path.join(args.alerts_out, f"{rung}.alerts.jsonl")
+    if rung not in _ALERT_RUNGS_STARTED:
+        _ALERT_RUNGS_STARTED.add(rung)
+        if os.path.exists(path):
+            os.remove(path)
+    return FleetHealth(path=path), path
+
+
+def _fleet_health_fields(health, path) -> dict:
+    """Close one fleet's health and report ITS alert evidence (counted
+    from the in-memory monitors, never the shared file — the rung file
+    accumulates every sequential fleet's edges and validates as a whole
+    via ``validate_jsonl``)."""
+    if health is None:
+        return {}
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    health.close()
+    edges = health.edges()
+    return {
+        "alerts": os.path.abspath(path),
+        "alert_edges": validate_jsonl("alert", path),
+        "page_alerts": health.page_edges(),
+        "replica_down_fired": sum(1 for r in edges
+                                  if r["rule"] == "replica_down"
+                                  and r["state"] == "firing"),
+        "replica_down_resolved": sum(1 for r in edges
+                                     if r["rule"] == "replica_down"
+                                     and r["state"] == "resolved"),
+    }
 
 
 def _warm(model, prompt_ids, **engine_kw):
@@ -93,15 +141,21 @@ def run_scale(args, model, vocab_size, engine_kw) -> dict:
 
     def measure_once(n_replicas):
         # round-robin: the even-spread baseline policy — this rung measures
-        # replica COUNT, not placement cleverness
+        # replica COUNT, not placement cleverness.  The fleet measurement
+        # carries the rung's health monitors (--alerts-out); sequential
+        # monitors append to one <rung>.alerts.jsonl
+        health, path = (_make_fleet_health(args, "scale")
+                        if n_replicas > 1 else (None, None))
         router = _build_fleet(model, n_replicas, "round_robin", args.seed,
-                              **engine_kw)
+                              health=health, **engine_kw)
         outs = _drive(router, requests())
         busy = [r.busy_s for r in router.replicas.values()]
         tokens = sum(len(o.token_ids) for o in outs.values()
                      if o.state == "finished")
         router.close()
+        hf = _fleet_health_fields(health, path)
         return {
+            **hf,
             "replicas": n_replicas,
             "finished": sum(1 for o in outs.values()
                             if o.state == "finished"),
@@ -164,13 +218,16 @@ def run_affinity(args, model, vocab_size, engine_kw) -> dict:
     requests = _shared_prefix_trace(args, vocab_size, C, args.page_size)
 
     def measure(policy):
+        health, path = _make_fleet_health(args, "affinity")
         router = _build_fleet(model, args.replicas, policy, args.seed,
-                              **engine_kw)
+                              health=health, **engine_kw)
         outs = _drive(router, requests())
         stats = router.fleet_prefix_stats()
         snap = router.registry.snapshot()
         router.close()
+        hf = _fleet_health_fields(health, path)
         return {
+            **hf,
             "policy": policy,
             "finished": sum(1 for o in outs.values()
                             if o.state == "finished"),
@@ -214,15 +271,18 @@ def run_failover(args, model, vocab_size, engine_kw) -> dict:
         "point": "fleet/replica_step", "action": "exception",
         "match": {"replica": 0, "step": args.kill_step}, "count": 1,
         "message": "fleet_bench: injected replica kill"}]})
+    health, alerts_path = _make_fleet_health(args, "failover")
     try:
         router = _build_fleet(model, args.replicas, "round_robin", args.seed,
-                              stats_path=stats_path, **engine_kw)
+                              stats_path=stats_path, health=health,
+                              **engine_kw)
         outs = _drive(router, requests())
         router.assert_invariants()
         snap = router.registry.snapshot()
         router.close()
     finally:
         clear_plan()
+    health_fields = _fleet_health_fields(health, alerts_path)
 
     n = args.num_requests
     n_stats = validate_jsonl("router_stats", stats_path)
@@ -241,6 +301,7 @@ def run_failover(args, model, vocab_size, engine_kw) -> dict:
         "stats_finished": sum(1 for r in records if r["state"] == "finished"),
         "stats_requeued": sum(1 for r in records if r["requeues"] > 0),
         "stats_path": os.path.abspath(stats_path),
+        **health_fields,
     }
     rec["ok"] = (
         finished == n                          # every accepted request done
@@ -250,6 +311,12 @@ def run_failover(args, model, vocab_size, engine_kw) -> dict:
         and n_stats == n                       # the ledger agrees
         and rec["stats_finished"] == n
         and rec["stats_requeued"] >= 1)
+    if health is not None:
+        # alert acceptance: the kill must FIRE replica_down and the warm
+        # restart must RESOLVE it — the control room saw the failover
+        rec["ok"] = (rec["ok"]
+                     and rec["replica_down_fired"] >= 1
+                     and rec["replica_down_resolved"] >= 1)
     return rec
 
 
@@ -276,6 +343,13 @@ def main() -> int:
     p.add_argument("--stats-dir", default=None,
                    help="directory for the failover rung's "
                         "router_stats.jsonl (default: a temp dir)")
+    p.add_argument("--alerts-out", default=None,
+                   help="directory for per-rung fleet-health artifacts: "
+                        "every rung's fleet runs under the default rule "
+                        "pack and drops a schema-checked "
+                        "<rung>.alerts.jsonl; the failover rung "
+                        "additionally requires the replica_down alert to "
+                        "fire at the kill and resolve at the warm restart")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 

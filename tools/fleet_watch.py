@@ -1,0 +1,253 @@
+"""fleet_watch — the live terminal control room over a serving run.
+
+A refresh loop rendering three panes from a run's artifacts (re-read each
+tick, so it follows a LIVE run appending to them) or from a running
+metrics server:
+
+- **fleet rollup** — requests finished/failed, tokens served, queue depth,
+  replicas alive, fleet prefix-hit rate (merged across replicas via
+  ``obs.aggregate``);
+- **firing alerts** — every ``*alerts.jsonl`` edge stream folded into the
+  currently-firing set (rule, severity, observed vs bound, time firing);
+- **per-replica view** — one row per replica artifact dir: KV occupancy
+  (pages in use / total), active slots, queue depth, tokens.
+
+Usage:
+    python tools/fleet_watch.py --run-dir /runs/r1/obs          # artifacts
+    python tools/fleet_watch.py --url http://host:9100          # scrape
+    python tools/fleet_watch.py --run-dir obs/ --once           # one frame
+
+Artifact mode expects the fleet layout ``obs_report --run-dir`` reads:
+per-replica subdirectories each holding a ``scalars.jsonl``, plus
+top-level (or per-replica) ``*alerts.jsonl`` and an optional
+``router_stats.jsonl``.  Scrape mode hits a ``MetricsServer``'s
+``/healthz`` (readiness + firing alerts) and ``/metrics?scope=fleet``
+(the replica-labeled merged exposition) — the same two endpoints an
+external pager consumes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable as `python tools/fleet_watch.py`
+    sys.path.insert(0, REPO)
+
+
+def _read_jsonl(path: str) -> list:
+    """Best-effort JSONL reader for LIVE files: a torn trailing line (the
+    writer mid-append) is skipped, not fatal — the watch loop must survive
+    re-reading artifacts that are still being written."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def _latest(records: list) -> dict:
+    """tag -> latest value of a scalars.jsonl stream."""
+    latest: dict = {}
+    for r in records:
+        tag = r.get("tag")
+        if tag is None:
+            continue
+        prev = latest.get(tag)
+        if prev is None or int(r.get("step", 0)) >= prev[0]:
+            latest[tag] = (int(r.get("step", 0)), float(r["value"]))
+    return {tag: v for tag, (_, v) in latest.items()}
+
+
+def _firing_alerts(run_dir: str) -> list:
+    """Fold every *alerts.jsonl (top level + one dir down) into the
+    currently-firing set, newest edge wins per (rule, key, replica)."""
+    paths = sorted(glob.glob(os.path.join(run_dir, "*alerts.jsonl"))
+                   + glob.glob(os.path.join(run_dir, "*", "*alerts.jsonl")))
+    state: dict = {}
+    for p in paths:
+        for r in _read_jsonl(p):
+            key = (r.get("rule", "?"), r.get("key", ""),
+                   r.get("replica", -1))
+            prev = state.get(key)
+            if prev is None or r.get("mono", 0.0) >= prev.get("mono", 0.0):
+                state[key] = r
+    firing = [r for r in state.values() if r.get("state") == "firing"]
+    order = {"page": 0, "warn": 1, "info": 2}
+    firing.sort(key=lambda r: (order.get(r.get("severity"), 3),
+                               r.get("rule", "")))
+    return firing
+
+
+def _fmt(v, nd=0) -> str:
+    if v is None:
+        return "-"
+    return f"{v:,.{nd}f}"
+
+
+def render_run_dir(run_dir: str) -> str:
+    """One frame of the control room from a run dir's artifacts."""
+    from neuronx_distributed_tpu.obs.aggregate import (
+        discover_replica_dirs,
+        merge_scalar_records,
+    )
+
+    lines = [f"fleet_watch — {os.path.abspath(run_dir)} — "
+             + time.strftime("%H:%M:%S")]
+    replica_dirs = discover_replica_dirs(run_dir)
+    streams = []
+    top = os.path.join(run_dir, "scalars.jsonl")
+    if os.path.exists(top):
+        streams.append(_read_jsonl(top))
+    per_replica = {}
+    for label, sub in replica_dirs:
+        recs = _read_jsonl(os.path.join(sub, "scalars.jsonl"))
+        if recs:
+            streams.append(recs)
+            per_replica[label] = _latest(recs)
+    merged = _latest(merge_scalar_records(streams)) if streams else {}
+
+    # -- fleet rollup
+    hits = merged.get("kvcache/prefix_hits_total", 0.0)
+    misses = merged.get("kvcache/prefix_misses_total", 0.0)
+    rollup = [
+        ("replicas alive", _fmt(merged.get("router/replicas_alive"))),
+        ("queue depth", _fmt(merged.get("router/queue_depth",
+                                        merged.get("serving/queue_depth")))),
+        ("slots active", _fmt(merged.get("serving/slots_active"))),
+        ("finished", _fmt(merged.get("serving/finished_total"))),
+        ("failed", _fmt(merged.get("serving/failed_total"))),
+        ("shed", _fmt(merged.get("serving/shed_total"))),
+        ("tokens", _fmt(merged.get("serving/tokens_total"))),
+        ("prefix hit rate",
+         f"{hits / (hits + misses):.1%}" if hits + misses else "-"),
+        ("alerts firing", _fmt(merged.get("obs/alerts_firing"))),
+    ]
+    lines += ["", "== fleet =="]
+    lines += [f"  {name:<16} {val:>12}" for name, val in rollup]
+
+    # -- firing alerts
+    firing = _firing_alerts(run_dir)
+    lines += ["", f"== alerts firing ({len(firing)}) =="]
+    if firing:
+        lines.append(f"  {'rule':<28} {'sev':<5} {'replica':>7} "
+                     f"{'observed':>12} {'bound':>12}")
+        for r in firing:
+            lines.append(
+                f"  {r.get('rule', '?'):<28} {r.get('severity', '?'):<5} "
+                f"{r.get('replica', -1):>7} "
+                f"{_fmt(r.get('observed'), 3):>12} "
+                f"{_fmt(r.get('bound'), 3):>12}")
+    else:
+        lines.append("  (quiet)")
+
+    # -- per-replica occupancy
+    if per_replica:
+        lines += ["", "== replicas =="]
+        lines.append(f"  {'replica':<12} {'pages':>13} {'occ':>7} "
+                     f"{'active':>7} {'queue':>7} {'tokens':>9}")
+        for label in sorted(per_replica):
+            snap = per_replica[label]
+            total = snap.get("kvcache/pages_total", 0.0)
+            in_use = snap.get("kvcache/pages_in_use", 0.0)
+            occ = f"{in_use / total:.0%}" if total else "-"
+            lines.append(
+                f"  {label:<12} "
+                f"{_fmt(in_use)}/{_fmt(total):<6} {occ:>7} "
+                f"{_fmt(snap.get('serving/slots_active')):>7} "
+                f"{_fmt(snap.get('serving/queue_depth')):>7} "
+                f"{_fmt(snap.get('serving/tokens_total')):>9}")
+    return "\n".join(lines) + "\n"
+
+
+def render_url(url: str) -> str:
+    """One frame from a live MetricsServer: /healthz + the fleet scope."""
+    import urllib.error
+    import urllib.request
+
+    url = url.rstrip("/")
+    lines = [f"fleet_watch — {url} — " + time.strftime("%H:%M:%S")]
+    try:
+        body = urllib.request.urlopen(url + "/healthz", timeout=5).read()
+        code = 200
+    except urllib.error.HTTPError as e:  # 503 still carries the document
+        body, code = e.read(), e.code
+    except OSError as e:
+        return "\n".join(lines + [f"  unreachable: {e}"]) + "\n"
+    doc = json.loads(body.decode())
+    lines += ["", f"== healthz ({code}) =="]
+    for k in sorted(doc):
+        lines.append(f"  {k:<16} {doc[k]}")
+    for scope, label in (("?scope=fleet", "metrics (fleet scope)"),
+                         ("", "metrics")):
+        try:
+            text = urllib.request.urlopen(
+                url + "/metrics" + scope, timeout=5).read().decode()
+        except (OSError, urllib.error.HTTPError):
+            continue
+        wanted = ("router_replicas_alive", "router_queue_depth",
+                  "serving_queue_depth", "serving_slots_active",
+                  "serving_tokens_total", "obs_alerts_firing")
+        picked = [ln for ln in text.splitlines()
+                  if ln.split("{")[0].split(" ")[0] in wanted]
+        if picked:
+            lines += ["", f"== {label} =="] + [f"  {ln}" for ln in picked]
+            break
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--run-dir", default=None,
+                   help="run dir holding scalars/alerts artifacts "
+                        "(fleet layout: per-replica subdirectories)")
+    p.add_argument("--url", default=None,
+                   help="a live MetricsServer base URL "
+                        "(e.g. http://host:9100)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh seconds (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (no screen clearing)")
+    p.add_argument("--no-clear", action="store_true",
+                   help="append frames instead of clearing the screen")
+    args = p.parse_args(argv)
+    if not args.run_dir and not args.url:
+        p.error("pass --run-dir or --url")
+
+    def frame() -> str:
+        return (render_url(args.url) if args.url
+                else render_run_dir(args.run_dir))
+
+    if args.once:
+        sys.stdout.write(frame())
+        return 0
+    try:
+        while True:
+            out = frame()
+            if not args.no_clear:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            sys.stdout.write(out)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,13 +24,21 @@ serving stack's ``trace_events.jsonl`` spans (queue / prefill / decode /
 preempted milliseconds, failover hops, top-5 slowest requests), linked to
 their terminal ``serving_stats`` records via ``trace_id``.
 
-``--compare RUN_A RUN_B`` diffs two runs' resource ledgers
-(``compile_ledger.jsonl`` + ``memory_breakdown.json`` in each dir):
-markdown table to stdout (or ``--markdown``), JSON via ``--out``, and a
-NONZERO exit code when run B regressed — more compiles than ``(1 +
---compile-regress-threshold) * A``, new compile storms, or any
-subsystem's peak bytes past ``(1 + --mem-regress-threshold) * A``'s —
-so CI can gate on it.
+A FLEET run dir is auto-discovered: immediate subdirectories holding a
+replica's ``scalars.jsonl`` / ``serving_stats.jsonl`` merge into one
+report (per-replica counters and histogram buckets SUM, serving stats
+concatenate, a top-level ``router_stats.jsonl`` rolls into the fleet
+section), and every ``*alerts.jsonl`` (top level or per replica) builds
+the "alerts" health section — firing count, worst severity, per-rule
+firing edges and time-firing.
+
+``--compare RUN_A RUN_B`` diffs two runs' resource ledgers and alerts
+(``compile_ledger.jsonl`` + ``memory_breakdown.json`` + ``*alerts.jsonl``
+in each dir): markdown table to stdout (or ``--markdown``), JSON via
+``--out``, and a NONZERO exit code when run B regressed — more compiles
+than ``(1 + --compile-regress-threshold) * A``, new compile storms, any
+subsystem's peak bytes past ``(1 + --mem-regress-threshold) * A``'s, or
+any alert rule firing in B that never fired in A — so CI can gate on it.
 """
 
 from __future__ import annotations
@@ -78,6 +86,15 @@ def main(argv=None) -> int:
     p.add_argument("--memory-breakdown", default=None,
                    help="memory_breakdown.json path (auto-detected in "
                         "--run-dir) — builds the memory health section")
+    p.add_argument("--alerts", action="append", default=[],
+                   help="alerts.jsonl file (repeatable; *alerts.jsonl "
+                        "auto-detected in --run-dir and its replica "
+                        "subdirs) — builds the alerts section (firing "
+                        "count, worst severity, per-rule time-firing)")
+    p.add_argument("--router-stats", default=None,
+                   help="router_stats.jsonl path (auto-detected in "
+                        "--run-dir) — rolls fleet terminal records into "
+                        "the fleet section")
     p.add_argument("--compare", nargs=2, metavar=("RUN_A", "RUN_B"),
                    default=None,
                    help="compile/memory regression diff between two run "
@@ -105,7 +122,8 @@ def main(argv=None) -> int:
             mem_threshold=args.mem_regress_threshold)
         if args.out:
             doc = {k: diff[k] for k in ("a", "b", "compile", "memory",
-                                        "regressions", "regressed")}
+                                        "alerts", "regressions",
+                                        "regressed")}
             with open(args.out, "w") as f:
                 f.write(json.dumps(doc, indent=2) + "\n")
         if args.markdown:
@@ -120,7 +138,8 @@ def main(argv=None) -> int:
 
     if not (args.run_dir or args.scalar_dir or args.scalars or args.flight
             or args.hlo_audit or args.timeline or args.supervisor_events
-            or args.trace or args.compile_ledger or args.memory_breakdown):
+            or args.trace or args.compile_ledger or args.memory_breakdown
+            or args.alerts or args.router_stats):
         p.error("nothing to report on: pass --run-dir or explicit artifact paths")
 
     from neuronx_distributed_tpu.obs.report import build_report, render_markdown
@@ -145,6 +164,8 @@ def main(argv=None) -> int:
         serving_stats_path=args.serving_stats,
         compile_ledger_path=args.compile_ledger,
         memory_breakdown_path=args.memory_breakdown,
+        alerts_paths=args.alerts,
+        router_stats_path=args.router_stats,
         tail=args.tail,
     )
     validate_record("obs_report", report)  # the emitter honors its own schema

@@ -51,6 +51,14 @@ additionally drops the full artifacts per rung: a schema-checked
 ``<rung>.compile_ledger.jsonl`` and a ``<rung>.memory_breakdown.json``
 (the per-subsystem HBM accounting `tools/obs_report.py --compare` diffs
 between runs).
+
+``--alerts-out DIR`` (engine rungs: `--continuous`, `--slo`) runs every
+measured engine under the DEFAULT health-monitor rule pack
+(``obs.health.default_rules``) and drops one schema-checked
+``<rung>.alerts.jsonl`` per rung; the ``--slo`` rc additionally fails when
+a page-severity alert fires during the compliant rung — a passing bench
+must be QUIET under the production rule pack.  Wired into ``tpu_watch``
+as the ``fleet_health`` extra job.
 """
 
 from __future__ import annotations
@@ -97,6 +105,43 @@ def _export_trace(tracer, args, label: str) -> dict:
     validate_jsonl("trace_event", ev)  # the emitter honors its own schema
     return {"trace_events": os.path.abspath(ev),
             "trace_perfetto": os.path.abspath(ch)}
+
+
+def _make_health(args, label: str):
+    """A fresh health monitor under the DEFAULT rule pack when
+    ``--alerts-out`` is set (one per rung, its ``<label>.alerts.jsonl``
+    self-contained), else None — the zero-overhead default.  The bench's
+    contract is that a PASSING rung is QUIET: the default pack's bounds
+    are production-shaped, so a page-severity alert during a compliant
+    rung is itself a failure."""
+    if not getattr(args, "alerts_out", None):
+        return None
+    from neuronx_distributed_tpu.obs.health import (
+        HealthMonitor,
+        default_rules,
+    )
+
+    os.makedirs(args.alerts_out, exist_ok=True)
+    path = os.path.join(args.alerts_out, f"{label}.alerts.jsonl")
+    if os.path.exists(path):
+        os.remove(path)  # the sink appends: a rerun must not accumulate
+    return HealthMonitor(default_rules("serving"), path=path, eval_every=4)
+
+
+def _health_fields(monitor, args, label: str) -> dict:
+    """Close the rung's monitor, schema-validate its dropped
+    ``<label>.alerts.jsonl``, and report the firing evidence (total edges
+    + page-severity firing edges) for the rung's JSON line."""
+    if monitor is None:
+        return {}
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    monitor.close()
+    path = os.path.join(args.alerts_out, f"{label}.alerts.jsonl")
+    n = validate_jsonl("alert", path)  # the emitter honors its schema
+    return {"alerts": os.path.abspath(path),
+            "alert_edges": n,
+            "page_alerts": monitor.page_edges()}
 
 
 def _make_ledgers(args):
@@ -191,9 +236,10 @@ def run_continuous(args, model, vocab_size: int) -> dict:
     if os.path.exists(stats_path):
         os.remove(stats_path)
     tracer = _make_tracer(args)
+    health = _make_health(args, "continuous")
     engine = ServingEngine(model, registry=registry, stats_path=stats_path,
                            tracer=tracer, compile_ledger=led,
-                           memory_ledger=mem)
+                           memory_ledger=mem, health=health)
     engine.declare_warmup_done()  # the warm engine compiled everything
     t0 = time.monotonic()
     outputs = replay_trace(
@@ -204,6 +250,7 @@ def run_continuous(args, model, vocab_size: int) -> dict:
     engine.close()
     trace_paths = _export_trace(tracer, args, "continuous")
     ledger_fields = _ledger_fields(led, mem, args, "continuous")
+    health_fields = _health_fields(health, args, "continuous")
 
     n_stats = validate_jsonl("serving_stats", stats_path)
     assert n_stats == n, f"expected {n} serving_stats records, got {n_stats}"
@@ -242,6 +289,7 @@ def run_continuous(args, model, vocab_size: int) -> dict:
         "stats_path": os.path.abspath(stats_path),
         **trace_paths,
         **ledger_fields,
+        **health_fields,
     }
 
 
@@ -733,15 +781,17 @@ def run_slo(args, module, params, cfg, icfg) -> int:
         warm.close()
         del warm
         tracer = _make_tracer(args)
+        health = _make_health(args, f"slo_{mode}")
         engine = ServingEngine(model, registry=MetricRegistry(),
                                tracer=tracer, compile_ledger=led,
-                               memory_ledger=mem, **kw)
+                               memory_ledger=mem, health=health, **kw)
         engine.declare_warmup_done()
         arrivals, requests = trace(with_long, batch_tier=mode == "slo")
         outputs, wall, peak = _drive_workload(engine, arrivals, requests)
         engine.close()
         trace_paths = _export_trace(tracer, args, f"slo_{mode}")
         ledger_fields = _ledger_fields(led, mem, args, f"slo_{mode}")
+        health_fields = _health_fields(health, args, f"slo_{mode}")
         snap = engine.registry.snapshot()
         inter_i = [ms for o in outputs.values() if o.request_id < LONG_BASE
                    for ms in o.intertoken_ms]
@@ -769,6 +819,7 @@ def run_slo(args, module, params, cfg, icfg) -> int:
             "max_concurrent": peak,
             **trace_paths,
             **ledger_fields,
+            **health_fields,
         }
 
     base_cfg = {"config": {"batch": B, "context": C, "max_total": T,
@@ -810,6 +861,14 @@ def run_slo(args, module, params, cfg, icfg) -> int:
     if rec_slo["prefill_chunks"] <= 0:
         print("serve_bench: SLO engine dispatched no prefill chunks",
               file=sys.stderr)
+        rc = 1
+    if args.alerts_out and rec_slo.get("page_alerts", 0) > 0:
+        # the compliant rung's contract: alerts must be QUIET when the
+        # bench passes — a page-severity alert during the SLO-holding run
+        # means the default rule pack and the gate disagree about health
+        print(f"serve_bench: {rec_slo['page_alerts']} page-severity "
+              "alert(s) fired during the compliant SLO rung (see "
+              f"{rec_slo['alerts']})", file=sys.stderr)
         rc = 1
     return rc
 
@@ -1134,6 +1193,13 @@ def main() -> int:
                         "--slo): one schema-checked "
                         "<rung>.trace_events.jsonl + one Perfetto "
                         "<rung>.trace.json per measured engine")
+    p.add_argument("--alerts-out", default=None,
+                   help="directory to drop health-monitor artifacts into "
+                        "(engine rungs: --continuous and --slo): every "
+                        "measured engine runs under the default rule pack "
+                        "and drops one schema-checked <rung>.alerts.jsonl; "
+                        "the --slo rc additionally fails if a page-severity "
+                        "alert fires during the compliant rung")
     p.add_argument("--ledger-out", default=None,
                    help="directory to drop resource-ledger artifacts into "
                         "(engine rungs): one schema-checked "

@@ -177,12 +177,37 @@ def _validate_ledger_dir(ledger_dir: str) -> tuple:
     return True, counts
 
 
+def _validate_alerts_dir(alerts_dir: str) -> tuple:
+    """Post-hook for the fleet_health job: every dropped
+    ``*.alerts.jsonl`` must exist and validate against the checked-in
+    ``alert`` schema (EMPTY is valid — a quiet rung under the default
+    rule pack is the passing state; the bench rc already fails a noisy
+    compliant rung).  Returns ``(ok, detail)``."""
+    import glob
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    files = sorted(glob.glob(os.path.join(alerts_dir, "*.alerts.jsonl")))
+    if not files:
+        return False, f"no alerts artifacts in {alerts_dir}"
+    counts = {}
+    for f in files:
+        try:
+            counts[os.path.basename(f)] = validate_jsonl("alert", f)
+        except ValueError as e:
+            return False, f"{os.path.basename(f)}: {e}"
+    return True, counts
+
+
 def run_extra_jobs(results_path: str) -> None:
     """One-shot jobs that ride the first healthy window (VERDICT r3 #6)."""
     import tempfile
 
     trace_dir = tempfile.mkdtemp(prefix="tpu_watch_trace_")
     ledger_dir = tempfile.mkdtemp(prefix="tpu_watch_ledger_")
+    alerts_dir = tempfile.mkdtemp(prefix="tpu_watch_alerts_")
     jobs = [
         ("tp_allreduce", [sys.executable, os.path.join(REPO, "tools", "ici_bench.py")]),
         ("serving_latency", [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")]),
@@ -219,6 +244,13 @@ def run_extra_jobs(results_path: str) -> None:
         ("resource_ledger", [sys.executable,
                              os.path.join(REPO, "tools", "serve_bench.py"),
                              "--paged", "--ledger-out", ledger_dir]),
+        # fleet health monitor: the --slo rungs under the default rule
+        # pack — every measured engine drops a schema-valid alerts.jsonl
+        # (asserted by the post-hook) and the compliant rung's rc fails if
+        # a page-severity alert fires while the SLO gate passes
+        ("fleet_health", [sys.executable,
+                          os.path.join(REPO, "tools", "serve_bench.py"),
+                          "--slo", "--alerts-out", alerts_dir]),
         # multi-replica fleet rungs (serving/fleet/ subsystem): N-replica
         # goodput scaling, affinity-vs-random aggregate prefix-hit rate
         # (rc 1 when affinity does not beat random), zero-loss failover
@@ -297,6 +329,17 @@ def run_extra_jobs(results_path: str) -> None:
                     error = (f"ledger validation: {detail}"
                              + (f" | bench: {error}" if error else ""))
                 ok = ok and led_ok
+            if name == "fleet_health":
+                # artifact-first: every rung's alerts.jsonl must exist and
+                # be schema-valid regardless of the bench rc (a perf-gate
+                # failure still dropped alerts, and THEY certify the job)
+                al_ok, detail = _validate_alerts_dir(alerts_dir)
+                if al_ok:
+                    payload = {"alert_records": detail, **(payload or {})}
+                else:
+                    error = (f"alerts validation: {detail}"
+                             + (f" | bench: {error}" if error else ""))
+                ok = ok and al_ok
             append(results_path, {"kind": name, "ok": ok,
                                   "result": payload, "error": error})
         except subprocess.TimeoutExpired:
