@@ -98,7 +98,8 @@ def peak_flops_for(device) -> float:
 
 
 def run_measurement(platform: str, attn: str, batch: int, remat: str,
-                    loss: str = "mean") -> dict:
+                    loss: str = "mean",
+                    profile_out: "str | None" = None) -> dict:
     """Child-process body: build the model, time steps, return the result.
 
     Raises on any failure; the parent ladder decides what to try next."""
@@ -246,22 +247,31 @@ def run_measurement(platform: str, attn: str, batch: int, remat: str,
     float(jax.device_get(m["loss"]))
     prefetcher = DevicePrefetcher(lambda s: host_batch, depth=2,
                                   shardings=stage_shardings)
+    # --profile-out: capture an XLA device profile of exactly the headline
+    # (prefetch-ON) rung — the window whose number gets published
+    from contextlib import nullcontext
+
+    from neuronx_distributed_tpu.obs.tracing import device_trace
+
+    prof = device_trace(profile_out) if profile_out else nullcontext()
     try:
-        t0 = time.perf_counter()
-        blocked_s = 0.0
-        m_prev = None
-        for i in range(steps):
-            staged = prefetcher.get(i)
-            params, state, m = step(params, state, staged, jax.random.PRNGKey(i))
-            if m_prev is not None:  # pipelined: read step i-1 behind step i
-                tb = time.perf_counter()
-                float(jax.device_get(m_prev["loss"]))
-                blocked_s += time.perf_counter() - tb
-            m_prev = m
-        tb = time.perf_counter()
-        loss_val = float(jax.device_get(m["loss"]))
-        blocked_s += time.perf_counter() - tb
-        dt = time.perf_counter() - t0
+        with prof:
+            t0 = time.perf_counter()
+            blocked_s = 0.0
+            m_prev = None
+            for i in range(steps):
+                staged = prefetcher.get(i)
+                params, state, m = step(params, state, staged,
+                                        jax.random.PRNGKey(i))
+                if m_prev is not None:  # pipelined: read i-1 behind i
+                    tb = time.perf_counter()
+                    float(jax.device_get(m_prev["loss"]))
+                    blocked_s += time.perf_counter() - tb
+                m_prev = m
+            tb = time.perf_counter()
+            loss_val = float(jax.device_get(m["loss"]))
+            blocked_s += time.perf_counter() - tb
+            dt = time.perf_counter() - t0
     finally:
         prefetcher.close()
     if not math.isfinite(loss_val):
@@ -277,6 +287,17 @@ def run_measurement(platform: str, attn: str, batch: int, remat: str,
     )
     peak = peak_flops_for(devices[0])
     achieved_mfu = mfu(tokens_per_sec_per_chip, fpt, peak)
+
+    # Roofline attribution of the same rung through the shared perf layer
+    # (obs.perf): per-chip model FLOPs joined with the measured wall —
+    # mfu_model cross-checks achieved_mfu, pct_roofline is the
+    # how-far-off-the-ceiling number BENCH_*.json trends across rounds.
+    from neuronx_distributed_tpu.obs.perf import PerfAttribution, device_spec
+
+    perf = PerfAttribution(spec=device_spec(devices[0]))
+    perf.note_cost("train_step", fpt * batch * seq / n, 0.0)
+    perf.note_phase("train_step", dt * 1e3, calls=float(steps))
+    roll = perf.rollup()
 
     # Physical-plausibility gate: mfu() returns a FRACTION of chip peak; a
     # value >= 1 (tokens/s above peak_flops/flops_per_token) is impossible
@@ -315,6 +336,9 @@ def run_measurement(platform: str, attn: str, batch: int, remat: str,
         # later dispatch of the same compiled program
         "compile_cold_ms": round(compile_cold_ms, 1),
         "compile_warm_ms": round(compile_warm_ms, 1),
+        # roofline attribution (obs.perf) over the headline rung
+        "mfu_model": round(roll["mfu"], 4),
+        "pct_roofline": round(roll["pct_roofline"], 4),
     }
 
 
@@ -358,7 +382,7 @@ def child_main(args) -> int:
         return 0
     try:
         result = run_measurement(args.platform, args.attn, args.batch, args.remat,
-                                 args.loss)
+                                 args.loss, profile_out=args.profile_out)
     except Exception as e:  # noqa: BLE001 — report, parent decides
         print(f"bench attempt failed: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
@@ -396,7 +420,7 @@ def probe_tpu() -> "tuple[bool, str]":
     return ok, err
 
 
-def parent_main() -> int:
+def parent_main(profile_out: "str | None" = None) -> int:
     # Step 1: bounded TPU-backend probe — a hung or broken plugin must not
     # consume the whole time budget (round-1 failure: init raised; observed
     # alternative: init hangs indefinitely).  Exactly one probe subprocess
@@ -416,11 +440,11 @@ def parent_main() -> int:
         env = dict(os.environ)
         if platform == "cpu":
             env["JAX_PLATFORMS"] = "cpu"
-        proc = _run_child(
-            [f"--platform={platform}", f"--attn={attn}", f"--batch={batch}",
-             f"--remat={remat}", f"--loss={loss}"],
-            timeout_s, env,
-        )
+        child_args = [f"--platform={platform}", f"--attn={attn}",
+                      f"--batch={batch}", f"--remat={remat}", f"--loss={loss}"]
+        if profile_out:
+            child_args.append(f"--profile-out={profile_out}")
+        proc = _run_child(child_args, timeout_s, env)
         if proc is None:
             last_err = f"{platform}/{attn}/b{batch}: timed out after {timeout_s}s"
             print(last_err, file=sys.stderr)
@@ -491,8 +515,12 @@ def main():
     p.add_argument("--batch", type=int, default=2)
     p.add_argument("--remat", default="selective")
     p.add_argument("--loss", default="mean")
+    p.add_argument("--profile-out", default=None,
+                   help="directory for an XLA device profile of the "
+                        "headline rung (jax.profiler trace)")
     args = p.parse_args()
-    sys.exit(child_main(args) if args.run else parent_main())
+    sys.exit(child_main(args) if args.run
+             else parent_main(profile_out=args.profile_out))
 
 
 if __name__ == "__main__":

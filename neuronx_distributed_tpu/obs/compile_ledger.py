@@ -166,9 +166,17 @@ class CompileLedger:
         f = self._fams.get(family)
         if f is None:
             f = {"keys": set(), "capacity": None, "compiles": 0,
-                 "evictions": 0, "cold_ms": 0.0, "thrashed": False}
+                 "evictions": 0, "cold_ms": 0.0, "thrashed": False,
+                 "hits": 0}
             self._fams[family] = f
         return f
+
+    def family_hits(self, family: str) -> int:
+        """Steady-state cache hits recorded for one program family — the
+        call-count cross-check the perf-attribution join reads (one hit ==
+        one compiled execution that paid no compile)."""
+        f = self._fams.get(family)
+        return 0 if f is None else f["hits"]
 
     # -- recording ---------------------------------------------------------
 
@@ -224,6 +232,15 @@ class CompileLedger:
             for k in _COST_KEYS:
                 if k in rep and k not in extra:
                     extra[k] = rep[k]
+            missing = rep.get("cost_keys_missing")
+            if missing:
+                # the cost model went blind for this program — count the
+                # degradation so downstream roofline joins can tell "moves
+                # no bytes" from "unreported"
+                extra.setdefault("cost_keys_missing", int(missing))
+                if self.registry is not None:
+                    self.registry.counter(
+                        "perf/cost_model_missing_total").inc(int(missing))
             sig = _signature(compiled)
             if sig is not None:
                 extra.setdefault("signature", sig)
@@ -313,6 +330,7 @@ class CompileLedger:
 
     def cache_hit(self, family: str) -> None:
         self.cache_hits += 1
+        self._fam(family)["hits"] += 1
         if self.registry is not None:
             self.registry.counter("trace/compiled_cache_hits_total").inc()
 

@@ -33,6 +33,10 @@ from neuronx_distributed_tpu.obs.memory_ledger import (
     MEMORY_BREAKDOWN_FILE,
     read_memory_breakdown,
 )
+from neuronx_distributed_tpu.obs.perf import (
+    PERF_ATTRIBUTION_FILE,
+    summarize_perf,
+)
 from neuronx_distributed_tpu.obs.registry import read_histograms
 from neuronx_distributed_tpu.obs.tracing import (
     PHASE_NAMES,
@@ -50,7 +54,12 @@ from neuronx_distributed_tpu.obs.tracing import (
 # the run carried no health monitor), and --run-dir auto-discovers fleet
 # layouts (per-replica scalars/serving_stats subdirectories merged via
 # obs.aggregate, router_stats.jsonl rolled into the fleet section).
-OBS_REPORT_SCHEMA = "obs_report_v4"
+# v5 (perf-attribution PR): required "perf" section (per-family roofline
+# attribution from perf_attribution.jsonl — device-time, achieved vs peak
+# FLOP/s and bytes/s, compute-/memory-bound classification, MFU/MBU and
+# tokens/s-ceiling rollup; replica streams merge additively; null when
+# the run carried no perf profiler).
+OBS_REPORT_SCHEMA = "obs_report_v5"
 SUPERVISOR_EVENTS_FILE = "supervisor_events.jsonl"
 SERVING_STATS_FILE = "serving_stats.jsonl"
 ROUTER_STATS_FILE = "router_stats.jsonl"
@@ -448,17 +457,20 @@ def _summarize_memory(scalars: Dict[str, dict],
 
 def compare_resources(run_a: str, run_b: str,
                       compile_threshold: float = 0.0,
-                      mem_threshold: float = 0.05) -> dict:
-    """Run-to-run compile/memory/alert regression diff
+                      mem_threshold: float = 0.05,
+                      mfu_threshold: float = 0.05) -> dict:
+    """Run-to-run compile/memory/alert/perf regression diff
     (``tools/obs_report.py --compare RUN_A RUN_B``): reads each run dir's
-    ``compile_ledger.jsonl``, ``memory_breakdown.json`` and
-    ``*alerts.jsonl`` and flags B against A — more compiles than
-    ``(1 + compile_threshold) * A`` (or any storm in B), any subsystem's
-    peak bytes past ``(1 + mem_threshold) * A``'s, or any alert RULE that
-    fired in B without firing in A (a new alert under the same workload is
-    a health regression, threshold-free).  Returns ``{"a", "b",
-    "compile", "memory", "alerts", "regressions", "regressed",
-    "markdown"}``."""
+    ``compile_ledger.jsonl``, ``memory_breakdown.json``,
+    ``*alerts.jsonl`` and ``*perf_attribution.jsonl`` and flags B against
+    A — more compiles than ``(1 + compile_threshold) * A`` (or any storm
+    in B), any subsystem's peak bytes past ``(1 + mem_threshold) * A``'s,
+    any alert RULE that fired in B without firing in A (a new alert under
+    the same workload is a health regression, threshold-free), or B's MFU
+    sagging below ``(1 - mfu_threshold) * A``'s (same workload, less of
+    the device's peak — the perf regression the roofline profiler exists
+    to catch).  Returns ``{"a", "b", "compile", "memory", "alerts",
+    "perf", "regressions", "regressed", "markdown"}``."""
     def load(run_dir):
         cl_path = os.path.join(run_dir, COMPILE_LEDGER_FILE)
         mb_path = os.path.join(run_dir, MEMORY_BREAKDOWN_FILE)
@@ -468,10 +480,14 @@ def compare_resources(run_a: str, run_b: str,
                      if os.path.exists(mb_path) else None)
         alerts = summarize_alerts(
             sorted(glob.glob(os.path.join(run_dir, "*alerts.jsonl"))))
-        return compile_sum, breakdown, alerts
+        from neuronx_distributed_tpu.obs.aggregate import merge_perf_files
 
-    ca, ma, aa = load(run_a)
-    cb, mb, ab = load(run_b)
+        perf = summarize_perf(merge_perf_files(sorted(
+            glob.glob(os.path.join(run_dir, f"*{PERF_ATTRIBUTION_FILE}")))))
+        return compile_sum, breakdown, alerts, perf
+
+    ca, ma, aa, perf_a = load(run_a)
+    cb, mb, ab, perf_b = load(run_b)
     regressions: List[str] = []
     lines = ["# Resource regression diff", "",
              f"- A: `{run_a}`", f"- B: `{run_b}`", ""]
@@ -542,6 +558,24 @@ def compare_resources(run_a: str, run_b: str,
                 f"alerts regressed: rule {name!r} fired "
                 f"{fb[name]['fired']}x in B (severity "
                 f"{fb[name]['severity']}), never in A")
+
+    ra = (perf_a or {}).get("rollup")
+    rb = (perf_b or {}).get("rollup")
+    if perf_a is not None or perf_b is not None:
+        lines += ["## Perf (roofline rollup)", "",
+                  "| metric | A | B |", "|---|---|---|"]
+        for key in ("mfu", "mbu", "pct_roofline", "device_ms"):
+            va = ra.get(key) if ra else None
+            vb = rb.get(key) if rb else None
+            fmt = (lambda v, k=key: "n/a" if v is None else
+                   (f"{v:,.1f}" if k == "device_ms" else f"{v:.1%}"))
+            lines.append(f"| {key} | {fmt(va)} | {fmt(vb)} |")
+        lines.append("")
+    if ra and rb and ra.get("mfu") and \
+            rb["mfu"] < ra["mfu"] * (1.0 - mfu_threshold):
+        regressions.append(
+            f"mfu regressed: {ra['mfu']:.2%} -> {rb['mfu']:.2%} "
+            f"(threshold {mfu_threshold:.0%})")
     if regressions:
         lines += ["## Regressions", ""] + [f"- {r}" for r in regressions] \
             + [""]
@@ -557,6 +591,7 @@ def compare_resources(run_a: str, run_b: str,
                                 ("subsystems", "total_bytes",
                                  "peak_total_bytes")}},
         "alerts": {"a": aa, "b": ab},
+        "perf": {"a": ra, "b": rb},
         "regressions": regressions,
         "regressed": bool(regressions),
         "markdown": "\n".join(lines),
@@ -779,6 +814,7 @@ def build_report(
     memory_breakdown_path: Optional[str] = None,
     alerts_paths: Sequence[str] = (),
     router_stats_path: Optional[str] = None,
+    perf_paths: Sequence[str] = (),
     tail: int = 10,
 ) -> dict:
     """Merge the artifacts into one summary document.
@@ -797,6 +833,7 @@ def build_report(
     timeline_paths = list(timeline_paths)
     trace_paths = list(trace_paths)
     alerts_paths = list(alerts_paths)
+    perf_paths = list(perf_paths)
     serving_stats_paths = ([serving_stats_path]
                            if serving_stats_path else [])
     fleet_scalar_streams: List[List[dict]] = []
@@ -821,6 +858,10 @@ def build_report(
                     os.path.join(sub, f"*{TRACE_EVENTS_FILE}"))):
                 if q not in trace_paths:
                     trace_paths.append(q)
+            for q in sorted(glob.glob(
+                    os.path.join(sub, f"*{PERF_ATTRIBUTION_FILE}"))):
+                if q not in perf_paths:
+                    perf_paths.append(q)
         if router_stats_path is None:
             q = os.path.join(run_dir, ROUTER_STATS_FILE)
             router_stats_path = q if os.path.exists(q) else None
@@ -858,6 +899,10 @@ def build_report(
         if memory_breakdown_path is None:
             q = os.path.join(run_dir, MEMORY_BREAKDOWN_FILE)
             memory_breakdown_path = q if os.path.exists(q) else None
+        for q in sorted(glob.glob(
+                os.path.join(run_dir, f"*{PERF_ATTRIBUTION_FILE}"))):
+            if q not in perf_paths:
+                perf_paths.append(q)
 
     scalar_records: List[dict] = []
     for p in scalar_paths:
@@ -930,6 +975,11 @@ def build_report(
                  if memory_breakdown_path
                  and os.path.exists(memory_breakdown_path) else None)
     memory_section = _summarize_memory(scalars, breakdown)
+    # fleet runs: per-replica attribution streams merge additively
+    # (device-time, flops and bytes sum; the rollup is rebuilt)
+    from neuronx_distributed_tpu.obs.aggregate import merge_perf_files
+
+    perf_section = summarize_perf(merge_perf_files(perf_paths))
     report = {
         "schema": OBS_REPORT_SCHEMA,
         "generated_at": time.time(),
@@ -946,6 +996,7 @@ def build_report(
             "memory_breakdown": memory_breakdown_path,
             "alerts": alerts_paths,
             "router_stats": router_stats_path,
+            "perf": perf_paths,
             "fleet_replicas": fleet_replicas,
         },
         "scalars": scalars,
@@ -959,6 +1010,7 @@ def build_report(
         "compile": compile_section,
         "memory": memory_section,
         "alerts": alerts_section,
+        "perf": perf_section,
         "health": {
             "anomaly_count": len(anomalies),
             "host_blocked": host_blocked,
@@ -984,6 +1036,15 @@ def build_report(
                 "rules_fired": sum(
                     1 for agg in alerts_section["rules"].values()
                     if agg["fired"])}),
+            # slim perf rollup — the full per-family roofline table lives
+            # once, at the top-level "perf" section
+            "perf": (None if perf_section is None
+                     or perf_section.get("rollup") is None else {
+                         "mfu": perf_section["rollup"]["mfu"],
+                         "mbu": perf_section["rollup"]["mbu"],
+                         "pct_roofline":
+                             perf_section["rollup"]["pct_roofline"],
+                         "bound": perf_section["rollup"]["bound"]}),
             "total_collective_count": sum(
                 a.get("total_collective_count", 0) for a in audits),
             "total_collective_bytes": sum(
@@ -1102,6 +1163,15 @@ def render_markdown(report: dict) -> str:
             f"**{comp['storms']:.0f} storm(s)** after warmup, "
             f"{comp['thrash_warnings']:.0f} thrash warning(s), "
             f"{comp.get('evictions', 0):.0f} eviction(s); {hit}")
+    perf = report.get("perf")
+    if perf and perf.get("rollup"):
+        roll = perf["rollup"]
+        ceiling = (f"; tokens/s ceiling {roll['toks_per_s_ceiling']:,.0f}"
+                   if roll.get("toks_per_s_ceiling") else "")
+        lines.append(
+            f"- perf: MFU {roll['mfu']:.1%}, MBU {roll['mbu']:.1%}, "
+            f"{roll['pct_roofline']:.1%} of roofline "
+            f"({roll['bound']}-bound on {perf['device']}){ceiling}")
     memh = report.get("memory")
     if memh:
         top = ", ".join(f"{name} {nbytes / 2**20:,.1f}MiB"
@@ -1205,6 +1275,25 @@ def render_markdown(report: dict) -> str:
             lines.append(
                 f"| {name} | {f['compiles']} | {f['cold_ms']:.1f} | "
                 f"{f['distinct_keys']} | {f['evictions']} |")
+        lines.append("")
+
+    perf = report.get("perf")
+    if perf and perf.get("families"):
+        lines += [f"## Roofline attribution ({perf['device']})", "",
+                  "| family | calls | device ms | intensity | bound | "
+                  "% roofline | MFU | MBU |",
+                  "|---|---|---|---|---|---|---|---|"]
+        for name, f in sorted(perf["families"].items(),
+                              key=lambda kv: -kv[1]["device_ms"]):
+            ai = (f"{f['arithmetic_intensity']:.1f}"
+                  if f["arithmetic_intensity"] is not None else "n/a")
+            lines.append(
+                f"| {name} | {f['calls']:.0f} | {f['device_ms']:.1f} | "
+                f"{ai} | {f['bound']} | {f['pct_roofline']:.1%} | "
+                f"{f['mfu']:.1%} | {f['mbu']:.1%} |")
+        if perf.get("top_time_eaters"):
+            lines += ["", "Top time-eaters: "
+                      + ", ".join(perf["top_time_eaters"])]
         lines.append("")
 
     memr = report.get("memory")

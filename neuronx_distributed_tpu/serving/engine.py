@@ -448,6 +448,7 @@ class ServingEngine:
         compile_ledger: Any = None,
         memory_ledger: Any = None,
         health: Any = None,
+        perf: Any = None,
     ):
         attrs = ("prefill_one", "insert_slot", "decode_slots")
         if page_size is not None:
@@ -664,6 +665,26 @@ class ServingEngine:
         self._health = health
         if health is not None:
             health.attach_registry(self.registry)
+        # per-phase performance attribution (obs.perf.PerfAttribution,
+        # None = off; falls back to the Observability hub's when one is
+        # attached): device wall-time per phase family, stamped from the
+        # SAME clock reads the tracer spans use so the attribution sums to
+        # the traced wall-time exactly.  Guarded at every call site so the
+        # default path allocates nothing (the PERF_RECORDS discipline).
+        if perf is None and obs is not None:
+            perf = getattr(obs, "perf", None)
+        self._perf = perf
+        self._perf_t0: dict = {}  # rid -> prefill-phase start (engine clock)
+        self._batch_t0 = None     # (family, t0) of the in-flight round
+        if perf is not None:
+            perf.attach(registry=self.registry, ledger=compile_ledger)
+            # the _CompiledLRU first-call hook captures each program's
+            # flops/bytes onto its ledger row only when the model carries a
+            # perf layer (re-lowering is not free) — same persistence
+            # caveat as model.compile_ledger above
+            model.perf = perf
+            if draft is not None:
+                draft.perf = perf
         self.scheduler = SlotScheduler(
             self.B, self.C, self.T, max_queue=max_queue,
             page_gate=self._kv, reserve_extra=self._spec_k,
@@ -908,6 +929,10 @@ class ServingEngine:
         measured pass; no-op without a compile ledger."""
         if self.compile_ledger is not None:
             self.compile_ledger.declare_warmup_done("engine")
+        if self._perf is not None:
+            # warm-pass program executions must not inflate the cost join:
+            # phase device time only covers the measured window
+            self._perf.mark_warmup_done()
 
     def _poll_module_jits(self, led) -> None:
         """Book growth of the shared sampler jits' caches as compile events
@@ -1038,6 +1063,10 @@ class ServingEngine:
                 queue_depth=self.scheduler.queue_depth,
                 slots_active=self.scheduler.active_count,
                 terminal=len(outputs))
+        if self._perf is not None:
+            # refresh the perf/* rollup gauges on the step cadence so the
+            # health TrendRules (mfu_sag / roofline_drift) see live values
+            self._perf.update_metrics()
         if self._health is not None:
             # rule evaluation rides the engine clock (alert edges share
             # the spans'/stats' timescale under a fake-clock harness)
@@ -1077,6 +1106,10 @@ class ServingEngine:
             if self._batch_span is not None:
                 tr.end(self._batch_span, t=now, aborted=True)
                 self._batch_span = None
+                if self._perf is not None and self._batch_t0 is not None:
+                    fam, t0 = self._batch_t0
+                    self._perf.note_phase(fam, (now - t0) * 1e3)
+                self._batch_t0 = None
             for rid, rt in list(self._rt.items()):
                 tr.end(rt.pop("phase", None), t=now, aborted=True)
                 tr.end(rt.get("root"), t=now, aborted=True)
@@ -1163,6 +1196,11 @@ class ServingEngine:
             req, "prefill",
             t=req.prefill_time if req.prefill_time is not None else now,
             slot=slot)
+        if self._perf is not None:
+            # the same grant instant the span starts at — per-family sums
+            # match the traced prefill wall-time exactly
+            self._perf_t0[req.request_id] = (
+                req.prefill_time if req.prefill_time is not None else now)
         # pre-dispatch expiry: the sweep ran at step start, but a request
         # can expire between sweep and prefill — never burn a prefill (or
         # its first chunk) on a deadline that is already dead
@@ -1373,6 +1411,10 @@ class ServingEngine:
         # contiguous phases, so the waterfall sums to the request latency
         self._trace_end_phase(req, t=now)
         self._trace_begin_phase(req, "decode", t=now)
+        if self._perf is not None:
+            t0 = self._perf_t0.pop(req.request_id, None)
+            if t0 is not None:
+                self._perf.note_phase("prefill", (now - t0) * 1e3)
         if req.submit_time is not None:
             ttft_s = now - req.submit_time
             self.registry.histogram("serving/ttft_ms", MS_BUCKETS).observe(
@@ -1457,9 +1499,13 @@ class ServingEngine:
         width = n_pages * page
         ids_chunk = st.ids_row[off:off + width][None, :]
         tr = self.tracer
+        # one shared start stamp: the chunk span and its perf accounting
+        # measure the identical interval (attribution sums to the trace)
+        t0 = (self._clock() if tr is not None or self._perf is not None
+              else None)
         cspan = (tr.begin("prefill_chunk", request_id=st.req.request_id,
                           parent=self._trace_phase_of(st.req),
-                          t=self._clock(),
+                          t=t0,
                           tok_start=int(off), tok_end=int(off + width),
                           pages=n_pages)
                  if tr is not None else None)
@@ -1474,11 +1520,19 @@ class ServingEngine:
                 self._kv.tables[slot][None, :].copy(), self.caches,
                 st.valid_row[None, :].copy())
         except BaseException as e:
-            if cspan is not None:
-                tr.end(cspan, t=self._clock(), failed=type(e).__name__)
+            if t0 is not None:
+                t1 = self._clock()
+                if cspan is not None:
+                    tr.end(cspan, t=t1, failed=type(e).__name__)
+                if self._perf is not None:
+                    self._perf.note_phase("prefill_chunk", (t1 - t0) * 1e3)
             raise
-        if cspan is not None:
-            tr.end(cspan, t=self._clock())
+        if t0 is not None:
+            t1 = self._clock()
+            if cspan is not None:
+                tr.end(cspan, t=t1)
+            if self._perf is not None:
+                self._perf.note_phase("prefill_chunk", (t1 - t0) * 1e3)
         st.req.prefill_chunks += 1
         st.next_i += n_pages
         # chunk prefill stays on the gather path (it attends the per-row
@@ -1561,7 +1615,9 @@ class ServingEngine:
         for slot, req in active:
             tok_idx[slot] = len(req.generated)
         tr = self.tracer
-        bspan = (tr.begin("decode_step", t=self._clock(), step=self._steps,
+        t0 = (self._clock() if tr is not None or self._perf is not None
+              else None)
+        bspan = (tr.begin("decode_step", t=t0, step=self._steps,
                           active=len(active))
                  if tr is not None else None)
 
@@ -1617,6 +1673,8 @@ class ServingEngine:
                 outputs.append(self._emit(req, now))
         if bspan is not None:
             tr.end(bspan, t=now)
+        if self._perf is not None:
+            self._perf.note_phase("decode_step", (now - t0) * 1e3)
 
     def _collect_decode(self) -> list:
         """Collect the in-flight decode step: ONE explicit packed fetch
@@ -1673,6 +1731,10 @@ class ServingEngine:
             post.append(("token", slot, req, tok, ms, now))
         if bspan is not None:
             tr.end(bspan, t=now)
+        if self._perf is not None and self._batch_t0 is not None:
+            fam, t0 = self._batch_t0
+            self._perf.note_phase(fam, (now - t0) * 1e3)
+        self._batch_t0 = None
         return post
 
     def _dispatch_decode(self, active: list) -> None:
@@ -1688,13 +1750,17 @@ class ServingEngine:
         tok_idx = np.zeros((self.B,), np.int32)
         for slot, req in active:
             tok_idx[slot] = len(req.generated)
-        if self.tracer is not None:
+        if self.tracer is not None or self._perf is not None:
             # the batch-level decode span covers dispatch -> collect (the
             # honest in-flight device window of the pipelined engine);
-            # per-slot child spans land at collect time
-            self._batch_span = self.tracer.begin(
-                "decode_step", t=self._clock(), step=self._steps,
-                active=len(active))
+            # per-slot child spans land at collect time.  The perf layer
+            # shares the dispatch stamp so its accounting matches the span.
+            t0 = self._clock()
+            self._batch_t0 = ("decode_step", t0)
+            if self.tracer is not None:
+                self._batch_span = self.tracer.begin(
+                    "decode_step", t=t0, step=self._steps,
+                    active=len(active))
         # eager slicing of a stacked [3, B] array would bind scalar start
         # indices host-side (an implicit transfer the guard rejects), so the
         # per-step inputs stage as one explicit pytree put instead; in paged
@@ -1775,10 +1841,13 @@ class ServingEngine:
         tok_idx = np.zeros((self.B,), np.int32)
         for slot, req in active:
             tok_idx[slot] = len(req.generated)
-        if self.tracer is not None:
-            self._batch_span = self.tracer.begin(
-                "spec_round", t=self._clock(), step=self._steps,
-                active=len(active), k=k)
+        if self.tracer is not None or self._perf is not None:
+            t0 = self._clock()
+            self._batch_t0 = ("spec_round", t0)
+            if self.tracer is not None:
+                self._batch_span = self.tracer.begin(
+                    "spec_round", t=t0, step=self._steps,
+                    active=len(active), k=k)
         offs_steps = self._offsets[None, :] + np.arange(k, dtype=np.int32)[:, None]
         tidx_steps = tok_idx[None, :] + np.arange(k, dtype=np.int32)[:, None]
         staged = [self._next_tok[:, None].copy(), self._offsets.copy(),
@@ -1914,6 +1983,10 @@ class ServingEngine:
             post.append(("tokens", slot, req, toks, per_tok_ms, now))
         if bspan is not None:
             tr.end(bspan, t=now)
+        if self._perf is not None and self._batch_t0 is not None:
+            fam, t0 = self._batch_t0
+            self._perf.note_phase(fam, (now - t0) * 1e3)
+        self._batch_t0 = None
         if need_ingest:
             (ing_offs,) = self._audit.put((ingest,))
             _, self._draft_caches, self._draft_valid = \
@@ -2118,4 +2191,9 @@ class ServingEngine:
             # per-class deadline attainment feeds the SLO burn-rate
             # windows: good = finished within its deadline
             self._health.note_output(out, now)
+        if self._perf is not None:
+            # committed tokens feed the serving tokens/s-ceiling rollup;
+            # drop any prefill stamp a failed admission left behind
+            self._perf.note_tokens(len(out.token_ids))
+            self._perf_t0.pop(req.request_id, None)
         return out

@@ -58,9 +58,20 @@ def cost_report(compiled: Any, collectives: bool = False) -> Dict[str, Any]:
     ca = compiled.cost_analysis() or {}
     if isinstance(ca, (list, tuple)):  # jax < 0.5 returns [per-program dict]
         ca = ca[0] if ca else {}
+    # newer jax backends omit keys entirely instead of reporting 0 — a
+    # missing key silently dropped here used to surface downstream as NaN
+    # arithmetic intensities in the perf-attribution join.  Default to 0.0
+    # and COUNT the degradation so consumers can tell "program moves no
+    # bytes" from "the cost model went blind".
+    missing = 0
     for key in ("flops", "bytes accessed", "transcendentals"):
         if key in ca:
             out[key.replace(" ", "_")] = float(ca[key])
+        else:
+            out[key.replace(" ", "_")] = 0.0
+            missing += 1
+    if missing:
+        out["cost_keys_missing"] = missing
     ma = memory_analysis(compiled)
     if ma is not None:
         out.update(ma)

@@ -32,6 +32,21 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _pct_roofline(flops: float, bytes_accessed: float, seconds: float) -> float:
+    """Fraction of the device roofline a measured kernel time achieves:
+    lower-bound time (compute- or bandwidth-limited, whichever dominates)
+    over observed time.  Uses the same DeviceSpec table / CPU calibration
+    as the perf-attribution layer, so autotune sweeps and serving
+    attribution quote comparable numbers."""
+    import jax
+
+    from neuronx_distributed_tpu.obs.perf import device_spec
+
+    spec = device_spec(jax.devices()[0])
+    lower = max(flops / spec.peak_flops, bytes_accessed / spec.hbm_bytes_per_s)
+    return round(lower / seconds, 4) if seconds > 0 else 0.0
+
+
 def _time_fn(f, steps, *xs):
     import statistics
     import time as _time
@@ -92,6 +107,14 @@ def run_paged(args) -> int:
     def divisors(n, cands):
         return [c for c in cands if c <= n and n % c == 0]
 
+    # decode attention cost at the swept shape (identical for every
+    # candidate — only the achieved time varies): QK^T + PV over the full
+    # chain per query row, and the kernel must stream every mapped page
+    kv_bytes = 1 if quant == "int8" else q.dtype.itemsize
+    dec_flops = 2 * 2 * B * S * NQ * T * D
+    dec_bytes = (B * PP * page * NKV * D * 2 * kv_bytes
+                 + B * S * NQ * D * 2 * q.dtype.itemsize)
+
     bps = divisors(PP, [1, 2, 4, 8, 16])
     results = []
     key = [page, PP, NKV, D, quant]
@@ -108,7 +131,8 @@ def run_paged(args) -> int:
                 print(json.dumps(rec), flush=True)
                 continue
             rec = {"shape_key": key, "block_pages": bp, "split_k": sk,
-                   "decode_ms": round(t * 1e3, 3)}
+                   "decode_ms": round(t * 1e3, 3),
+                   "pct_roofline": _pct_roofline(dec_flops, dec_bytes, t)}
             results.append(rec)
             print(json.dumps(rec), flush=True)
 
@@ -123,6 +147,7 @@ def run_paged(args) -> int:
                 "split_k": best["split_k"],
             },
             "decode_ms": best["decode_ms"],
+            "pct_roofline": best["pct_roofline"],
             "device": jax.devices()[0].device_kind,
         }), flush=True)
     return 0 if ok else 1
@@ -179,6 +204,8 @@ def main() -> int:
     v = jax.random.normal(jax.random.PRNGKey(2), (B, HKV, S, D), dtype)
     # causal attention FLOPs: 2 matmuls x 2 flops, half the square
     flops = 2 * 2 * B * HQ * S * S * D / 2
+    # streamed bytes: q in + o out (HQ) and k + v in (HKV)
+    fbytes = (B * HQ * S * D * 2 + B * HKV * S * D * 2) * q.dtype.itemsize
 
     blocks = [int(b) for b in args.blocks.split(",")]
     results = []
@@ -201,6 +228,7 @@ def main() -> int:
             "fwd_ms": round(t_fwd * 1e3, 3),
             "fwd_bwd_ms": round(t_bwd * 1e3, 3),
             "fwd_tflops": round(flops / t_fwd / 1e12, 2),
+            "pct_roofline": _pct_roofline(flops, fbytes, t_fwd),
         }
         results.append(rec)
         print(json.dumps(rec), flush=True)

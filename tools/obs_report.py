@@ -37,8 +37,10 @@ firing edges and time-firing.
 in each dir): markdown table to stdout (or ``--markdown``), JSON via
 ``--out``, and a NONZERO exit code when run B regressed — more compiles
 than ``(1 + --compile-regress-threshold) * A``, new compile storms, any
-subsystem's peak bytes past ``(1 + --mem-regress-threshold) * A``'s, or
-any alert rule firing in B that never fired in A — so CI can gate on it.
+subsystem's peak bytes past ``(1 + --mem-regress-threshold) * A``'s, any
+alert rule firing in B that never fired in A, or B's perf-attribution
+rollup MFU sagging below ``(1 - --mfu-regress-threshold) * A``'s — so CI
+can gate on it.
 """
 
 from __future__ import annotations
@@ -91,6 +93,12 @@ def main(argv=None) -> int:
                         "auto-detected in --run-dir and its replica "
                         "subdirs) — builds the alerts section (firing "
                         "count, worst severity, per-rule time-firing)")
+    p.add_argument("--perf", action="append", default=[],
+                   help="perf_attribution.jsonl file (repeatable; "
+                        "*perf_attribution.jsonl auto-detected in --run-dir "
+                        "and its replica subdirs) — builds the per-family "
+                        "roofline attribution section (device time, MFU/MBU, "
+                        "compute-/memory-bound, tokens/s ceiling)")
     p.add_argument("--router-stats", default=None,
                    help="router_stats.jsonl path (auto-detected in "
                         "--run-dir) — rolls fleet terminal records into "
@@ -107,6 +115,11 @@ def main(argv=None) -> int:
     p.add_argument("--mem-regress-threshold", type=float, default=0.05,
                    help="--compare: allowed fractional growth in any "
                         "subsystem's peak bytes before rc 1 (default 5%%)")
+    p.add_argument("--mfu-regress-threshold", type=float, default=0.05,
+                   help="--compare: allowed fractional DROP in run B's "
+                        "rollup MFU below A's before rc 1 (default 5%%; "
+                        "only applies when both runs carry perf "
+                        "attribution)")
     p.add_argument("--tail", type=int, default=10,
                    help="flight-record tail length in the summary")
     p.add_argument("--out", default=None, help="write JSON here (default stdout)")
@@ -119,10 +132,11 @@ def main(argv=None) -> int:
         diff = compare_resources(
             args.compare[0], args.compare[1],
             compile_threshold=args.compile_regress_threshold,
-            mem_threshold=args.mem_regress_threshold)
+            mem_threshold=args.mem_regress_threshold,
+            mfu_threshold=args.mfu_regress_threshold)
         if args.out:
             doc = {k: diff[k] for k in ("a", "b", "compile", "memory",
-                                        "alerts", "regressions",
+                                        "alerts", "perf", "regressions",
                                         "regressed")}
             with open(args.out, "w") as f:
                 f.write(json.dumps(doc, indent=2) + "\n")
@@ -139,7 +153,7 @@ def main(argv=None) -> int:
     if not (args.run_dir or args.scalar_dir or args.scalars or args.flight
             or args.hlo_audit or args.timeline or args.supervisor_events
             or args.trace or args.compile_ledger or args.memory_breakdown
-            or args.alerts or args.router_stats):
+            or args.alerts or args.perf or args.router_stats):
         p.error("nothing to report on: pass --run-dir or explicit artifact paths")
 
     from neuronx_distributed_tpu.obs.report import build_report, render_markdown
@@ -166,6 +180,7 @@ def main(argv=None) -> int:
         memory_breakdown_path=args.memory_breakdown,
         alerts_paths=args.alerts,
         router_stats_path=args.router_stats,
+        perf_paths=args.perf,
         tail=args.tail,
     )
     validate_record("obs_report", report)  # the emitter honors its own schema

@@ -188,6 +188,58 @@ def _ledger_fields(led, mem, args, label: str) -> dict:
     return out
 
 
+def _make_perf(args, label: str):
+    """A fresh roofline perf-attribution layer when ``--profile-out`` is
+    set (one ``<label>.perf_attribution.jsonl`` per rung; the measured
+    engine attaches its registry + compile ledger and stamps per-phase
+    device time), else None — the zero-allocation default."""
+    if not getattr(args, "profile_out", None):
+        return None
+    from neuronx_distributed_tpu.obs.perf import PerfAttribution
+
+    os.makedirs(args.profile_out, exist_ok=True)
+    return PerfAttribution(path=os.path.join(
+        args.profile_out, f"{label}.perf_attribution.jsonl"))
+
+
+def _perf_fields(perf, args, label: str) -> dict:
+    """The rung's roofline evidence: dump + schema-check the
+    ``<label>.perf_attribution.jsonl`` artifact and surface the rollup —
+    ``mfu_model`` / ``pct_roofline`` per rung, plus the tokens/s ceiling
+    when the rung committed tokens."""
+    if perf is None:
+        return {}
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    path = perf.dump()
+    out = {}
+    roll = perf.rollup()
+    if roll is not None:
+        out["mfu_model"] = round(roll["mfu"], 6)
+        out["pct_roofline"] = round(roll["pct_roofline"], 6)
+        out["perf_bound"] = roll["bound"]
+        if roll.get("toks_per_s_ceiling"):
+            out["toks_per_s_ceiling"] = round(roll["toks_per_s_ceiling"], 2)
+    if path:
+        validate_jsonl("perf_attribution", path)  # emitter honors schema
+        out["perf_attribution"] = os.path.abspath(path)
+    return out
+
+
+def _profile_ctx(args, label: str):
+    """An XLA device-profile capture (``jax.profiler`` via
+    ``obs.tracing.device_trace``) over the measured window when
+    ``--profile-out`` is set — one ``<DIR>/<label>`` trace dir per rung —
+    else a no-op context."""
+    from contextlib import nullcontext
+
+    if not getattr(args, "profile_out", None):
+        return nullcontext()
+    from neuronx_distributed_tpu.obs.tracing import device_trace
+
+    return device_trace(os.path.join(args.profile_out, label))
+
+
 def run_continuous(args, model, vocab_size: int) -> dict:
     """Replay a Poisson arrival trace through ServingEngine; compare against
     lockstep static batches of the same prompts."""
@@ -219,6 +271,14 @@ def run_continuous(args, model, vocab_size: int) -> dict:
     # cache metrics land in the snapshot we report
     registry = MetricRegistry()
     led, mem = _make_ledgers(args)
+    perf = _make_perf(args, "continuous")
+    if perf is not None:
+        # the warm pass owns the first (compiling) calls: with model.perf
+        # set, the compiled-fn cache books flops/bytes cost extras into
+        # the shared ledger rows the perf layer joins against.  The warm
+        # engine itself carries NO perf= — warmup device time must not
+        # pollute the measured attribution.
+        model.perf = perf
     warm = ServingEngine(model, registry=registry, stats_path=None,
                          compile_ledger=led)
     warm.submit(Request(request_id=-1, prompt_ids=prompts[0],
@@ -239,18 +299,20 @@ def run_continuous(args, model, vocab_size: int) -> dict:
     health = _make_health(args, "continuous")
     engine = ServingEngine(model, registry=registry, stats_path=stats_path,
                            tracer=tracer, compile_ledger=led,
-                           memory_ledger=mem, health=health)
+                           memory_ledger=mem, health=health, perf=perf)
     engine.declare_warmup_done()  # the warm engine compiled everything
-    t0 = time.monotonic()
-    outputs = replay_trace(
-        engine, arrivals,
-        [Request(request_id=i, prompt_ids=prompts[i],
-                 max_new_tokens=args.max_new_tokens) for i in range(n)])
-    t_cont = time.monotonic() - t0
+    with _profile_ctx(args, "continuous"):
+        t0 = time.monotonic()
+        outputs = replay_trace(
+            engine, arrivals,
+            [Request(request_id=i, prompt_ids=prompts[i],
+                     max_new_tokens=args.max_new_tokens) for i in range(n)])
+        t_cont = time.monotonic() - t0
     engine.close()
     trace_paths = _export_trace(tracer, args, "continuous")
     ledger_fields = _ledger_fields(led, mem, args, "continuous")
     health_fields = _health_fields(health, args, "continuous")
+    perf_fields = _perf_fields(perf, args, "continuous")
 
     n_stats = validate_jsonl("serving_stats", stats_path)
     assert n_stats == n, f"expected {n} serving_stats records, got {n_stats}"
@@ -290,6 +352,7 @@ def run_continuous(args, model, vocab_size: int) -> dict:
         **trace_paths,
         **ledger_fields,
         **health_fields,
+        **perf_fields,
     }
 
 
@@ -366,10 +429,17 @@ def run_paged(args, module, params, cfg, icfg) -> int:
                 for i in range(n)]
 
     def measure(model, paged):
+        label = "paged" if paged else "contiguous"
         kw = dict(page_size=page, num_pages=budget_pages) if paged else {}
         # warm every compiled phase on a throwaway engine (same model ⇒
         # shared compiled-fn caches) so compile time never pollutes TTFT
         led, mem = _make_ledgers(args)
+        perf = _make_perf(args, label)
+        if perf is not None:
+            # warm-pass first calls book flops/bytes cost extras into the
+            # shared ledger; the warm engine carries no perf= so warmup
+            # device time stays out of the measured attribution
+            model.perf = perf
         warm = ServingEngine(model, registry=MetricRegistry(),
                              compile_ledger=led, **kw)
         warm.submit(Request(request_id=-1,
@@ -380,9 +450,12 @@ def run_paged(args, module, params, cfg, icfg) -> int:
         warm.close()
         del warm  # its device KV must not double the measured HBM footprint
         engine = ServingEngine(model, registry=MetricRegistry(),
-                               compile_ledger=led, memory_ledger=mem, **kw)
+                               compile_ledger=led, memory_ledger=mem,
+                               perf=perf, **kw)
         engine.declare_warmup_done()
-        outputs, wall, peak = _drive_workload(engine, arrivals, requests())
+        with _profile_ctx(args, label):
+            outputs, wall, peak = _drive_workload(engine, arrivals,
+                                                  requests())
         snap = engine.registry.snapshot()
         total_tokens = sum(len(o.token_ids) for o in outputs.values())
         ttfts = [o.ttft_ms for o in outputs.values() if o.ttft_ms is not None]
@@ -410,8 +483,8 @@ def run_paged(args, module, params, cfg, icfg) -> int:
             rec["prefills_skipped"] = snap.get(
                 "kvcache/prefill_skipped_total", 0.0)
             rec["evictions"] = snap.get("kvcache/evictions_total", 0.0)
-        rec.update(_ledger_fields(led, mem, args,
-                                  "paged" if paged else "contiguous"))
+        rec.update(_ledger_fields(led, mem, args, label))
+        rec.update(_perf_fields(perf, args, label))
         return rec
 
     base = {"config": {"batch": B, "context": C, "max_total": T,
@@ -1207,6 +1280,16 @@ def main() -> int:
                         "<rung>.memory_breakdown.json per measured engine; "
                         "every rung also reports "
                         "compiles_during_measurement regardless")
+    p.add_argument("--profile-out", default=None,
+                   help="directory to drop roofline perf-attribution "
+                        "artifacts into (engine rungs: --continuous and "
+                        "--paged): one schema-checked "
+                        "<rung>.perf_attribution.jsonl per measured "
+                        "engine (per-phase device time joined with "
+                        "compiled flops/bytes -> mfu_model/pct_roofline "
+                        "on the rung's JSON line) plus an XLA device "
+                        "profile of the measured window under "
+                        "<DIR>/<rung>")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 

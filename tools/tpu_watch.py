@@ -201,6 +201,34 @@ def _validate_alerts_dir(alerts_dir: str) -> tuple:
     return True, counts
 
 
+def _validate_perf_dir(perf_dir: str) -> tuple:
+    """Post-hook for the perf_attribution job: every dropped
+    ``*.perf_attribution.jsonl`` must validate against the checked-in
+    ``perf_attribution`` schema and be non-empty (a measured rung always
+    accounts at least one phase family plus the ``_total`` rollup).
+    Returns ``(ok, detail)``."""
+    import glob
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    files = sorted(glob.glob(
+        os.path.join(perf_dir, "*.perf_attribution.jsonl")))
+    if not files:
+        return False, f"no perf_attribution artifacts in {perf_dir}"
+    counts = {}
+    for f in files:
+        try:
+            n = validate_jsonl("perf_attribution", f)
+        except ValueError as e:
+            return False, f"{os.path.basename(f)}: {e}"
+        if n == 0:
+            return False, f"{os.path.basename(f)}: empty attribution"
+        counts[os.path.basename(f)] = n
+    return True, counts
+
+
 def run_extra_jobs(results_path: str) -> None:
     """One-shot jobs that ride the first healthy window (VERDICT r3 #6)."""
     import tempfile
@@ -208,6 +236,7 @@ def run_extra_jobs(results_path: str) -> None:
     trace_dir = tempfile.mkdtemp(prefix="tpu_watch_trace_")
     ledger_dir = tempfile.mkdtemp(prefix="tpu_watch_ledger_")
     alerts_dir = tempfile.mkdtemp(prefix="tpu_watch_alerts_")
+    perf_dir = tempfile.mkdtemp(prefix="tpu_watch_perf_")
     jobs = [
         ("tp_allreduce", [sys.executable, os.path.join(REPO, "tools", "ici_bench.py")]),
         ("serving_latency", [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")]),
@@ -251,6 +280,14 @@ def run_extra_jobs(results_path: str) -> None:
         ("fleet_health", [sys.executable,
                           os.path.join(REPO, "tools", "serve_bench.py"),
                           "--slo", "--alerts-out", alerts_dir]),
+        # per-phase roofline attribution: the paged rung with the perf
+        # profiler + device trace attached — each rung must report a
+        # nonzero mfu_model / pct_roofline and drop a schema-valid
+        # perf_attribution.jsonl (asserted by the post-hook, rc-independent
+        # like serving_trace: a perf-gate rc 1 still dropped attribution)
+        ("perf_attribution", [sys.executable,
+                              os.path.join(REPO, "tools", "serve_bench.py"),
+                              "--paged", "--profile-out", perf_dir]),
         # multi-replica fleet rungs (serving/fleet/ subsystem): N-replica
         # goodput scaling, affinity-vs-random aggregate prefix-hit rate
         # (rc 1 when affinity does not beat random), zero-loss failover
@@ -340,6 +377,16 @@ def run_extra_jobs(results_path: str) -> None:
                     error = (f"alerts validation: {detail}"
                              + (f" | bench: {error}" if error else ""))
                 ok = ok and al_ok
+            if name == "perf_attribution":
+                # artifact-first: the attribution files certify the job
+                # whatever the bench gate said
+                pf_ok, detail = _validate_perf_dir(perf_dir)
+                if pf_ok:
+                    payload = {"perf_records": detail, **(payload or {})}
+                else:
+                    error = (f"perf validation: {detail}"
+                             + (f" | bench: {error}" if error else ""))
+                ok = ok and pf_ok
             append(results_path, {"kind": name, "ok": ok,
                                   "result": payload, "error": error})
         except subprocess.TimeoutExpired:
