@@ -14,7 +14,11 @@ prefix sharing (Zheng et al., 2024) mapped onto static-shape JAX/pjit:
   eviction of refcount-0 chains;
 - :mod:`.pool` — :class:`PagePool`: the preallocated
   ``[num_pages, page_size, kv_heads, head_dim]`` device arrays per layer
-  (kv over tp, page axis a global unsharded pool) plus sizing arithmetic.
+  (kv over tp, page axis a global unsharded pool) plus sizing arithmetic;
+- :mod:`.transfer` — :func:`export_chain` / :func:`import_chain`: move a
+  committed page chain between pools (fp and int8 layouts) with
+  transactional failure semantics — the disaggregated fleet's KV
+  migration and fleet-global prefix-cache primitive.
 
 The serving integration lives one layer up:
 ``serving.paged.PagedKVManager`` glues these onto the engine's slot table,
@@ -39,15 +43,29 @@ from neuronx_distributed_tpu.kvcache.prefix import (
     is_padding_key,
     page_keys,
 )
+from neuronx_distributed_tpu.kvcache.transfer import (
+    PAGES_EXPORTED_TOTAL,
+    PAGES_IMPORTED_TOTAL,
+    ChainExport,
+    TransferError,
+    export_chain,
+    import_chain,
+)
 
 __all__ = [
     "BlockAllocator",
+    "ChainExport",
     "GATHER_BYTES_TOTAL",
     "NULL_PAGE",
     "PAD",
+    "PAGES_EXPORTED_TOTAL",
+    "PAGES_IMPORTED_TOTAL",
     "PagePool",
     "PoolExhausted",
     "PrefixIndex",
+    "TransferError",
+    "export_chain",
+    "import_chain",
     "init_page_pool_caches",
     "is_padding_key",
     "page_keys",

@@ -198,6 +198,44 @@ class PrefixIndex:
         payload = node.payload if len(pages) == len(keys) else None
         return pages, payload
 
+    def peek(self, keys: Sequence[PageKey]) -> Tuple[List[int], Any]:
+        """:meth:`lookup` without side effects: the longest matching chain's
+        pages and (on an exact full match) its terminal payload, taking NO
+        allocator references and leaving LRU clocks untouched.  For
+        presence probes — the fleet-transfer import path peeks before
+        deciding how much of a chain it still needs to move."""
+        node = self._root
+        pages: List[int] = []
+        for key in keys:
+            child = node.children.get(key)
+            if child is None:
+                break
+            pages.append(child.page)
+            node = child
+        payload = node.payload if len(pages) == len(keys) else None
+        return pages, payload
+
+    def find_fingerprint(self, fp: int):
+        """Resolve a chain fingerprint back to the chain it names: the
+        ``(keys, pages, payload)`` of the root-to-node chain whose rolling
+        fingerprint equals ``fp``, or None when the index holds no such
+        chain.  The export side of the fleet-global prefix directory —
+        a directory hit carries only the 64-bit fingerprint, and the
+        holding replica reconstructs the chain to serialize from it.  No
+        references are taken (pair with :func:`~.transfer.export_chain`,
+        which reads under the index's own reference)."""
+        stack = [(self._root, ROOT_FINGERPRINT, [], [])]
+        while stack:
+            node, nfp, keys, pages = stack.pop()
+            for child in node.children.values():
+                cfp = chain_fingerprint(nfp, child.key)
+                ckeys = keys + [child.key]
+                cpages = pages + [child.page]
+                if cfp == fp:
+                    return list(ckeys), list(cpages), child.payload
+                stack.append((child, cfp, ckeys, cpages))
+        return None
+
     def insert(self, keys: Sequence[PageKey], pages: Sequence[int],
                payload: Any = None) -> None:
         """Register a chain (one page id per key; NULL for padding pages).

@@ -1,0 +1,232 @@
+"""KV page-chain transfer between page pools (disaggregated serving).
+
+The primitive that makes prefill/decode disaggregation work (DistServe,
+Zhong et al. 2024; Splitwise, Patel et al. 2024; Mooncake's KVCache-centric
+transfer): serialize a committed prompt page chain out of one replica's
+pool and admit it into a sibling's pool as a prefix chain that is
+TOKEN-IDENTICAL to what local prefill would have produced.
+
+Two halves, mirroring the pool's host/device split:
+
+- :func:`export_chain` — gather the chain's real pages off the device into
+  a host-side :class:`ChainExport`.  Works for both pool layouts (the fp
+  ``(k, v)`` pair and the int8 six-tuple) by exploiting the pool's one
+  structural invariant: EVERY leaf has a leading ``num_pages`` axis, so
+  one fancy-index gather per leaf moves a page's KV and its per-page
+  quantization params alike.  Padding pages (NULL-backed) carry no
+  content and ship as structure only.
+- :func:`import_chain` — admit an export into a destination pool: reuse
+  whatever leading chain the destination's :class:`~.prefix.PrefixIndex`
+  already holds, atomically allocate pages for the rest, scatter the
+  exported rows in (one batched ``.at[pages].set(rows)`` per leaf), and
+  register the full chain in the destination index with the export's
+  terminal payload.  The destination ends in exactly the state a local
+  prefill + ``finish_insert`` would have left: the index owns one
+  reference per page.
+
+Failure semantics match the allocator's atomic-alloc discipline (the PR-5
+chaos contract): the ``kvcache/page_import`` fault point sits between
+allocation and commit, and ANY failure releases every page and reference
+taken before re-raising — a killed migration leaks nothing on either side.
+
+Serialization is host numpy — the export is process-portable by
+construction (a cross-host fleet would frame ``ChainExport`` over its
+transport; in-process fleets hand it over directly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from neuronx_distributed_tpu.kvcache.allocator import NULL_PAGE, BlockAllocator
+from neuronx_distributed_tpu.kvcache.prefix import (
+    PageKey,
+    PrefixIndex,
+    prefix_fingerprints,
+)
+from neuronx_distributed_tpu.resilience.faults import fault_point
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+PAGES_EXPORTED_TOTAL = "kvcache/pages_exported_total"
+PAGES_IMPORTED_TOTAL = "kvcache/pages_imported_total"
+
+
+class TransferError(RuntimeError):
+    """The export cannot be admitted into this pool — incompatible layouts
+    (page size, layer count, quantization, head geometry) or a corrupt
+    chain.  Raised BEFORE any destination state changes."""
+
+
+@dataclass
+class ChainExport:
+    """One committed page chain, serialized to the host.
+
+    ``keys``/``pages`` cover the FULL chain root-down (padding pages ride
+    as NULL, same as a block table); ``leaves`` holds, per layer, one host
+    array per pool leaf with the chain's real (non-NULL) pages stacked
+    along the leading axis in chain order — ``leaves[l][j][i]`` is layer
+    ``l``, leaf ``j``, ``i``-th real page of the chain.
+    """
+
+    keys: List[PageKey]
+    pages: List[int]                 # SOURCE page ids (diagnostic only)
+    layout: str                      # "fp" | "int8"
+    page_size: int
+    num_layers: int
+    leaves: List[Tuple[np.ndarray, ...]]
+    payload: Optional[np.ndarray] = None
+    fingerprint: int = 0
+    source: Any = None               # exporting replica id (diagnostic)
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def n_pages(self) -> int:
+        """Real (non-NULL) pages in the chain — what import must allocate
+        on a cold destination."""
+        return sum(1 for p in self.pages if p != NULL_PAGE)
+
+    @property
+    def nbytes(self) -> int:
+        """Serialized KV payload size (leaves + terminal payload) — the
+        migration span's byte attribute."""
+        n = sum(leaf.nbytes for layer in self.leaves for leaf in layer)
+        if self.payload is not None:
+            n += self.payload.nbytes
+        return n
+
+
+def _layout_of(caches: Sequence[Tuple]) -> str:
+    if not caches:
+        raise TransferError("empty page pool (no layers)")
+    width = len(caches[0])
+    if width == 2:
+        return "fp"
+    if width == 6:
+        return "int8"
+    raise TransferError(f"unknown pool layout: {width} leaves per layer")
+
+
+def export_chain(caches: Sequence[Tuple], keys: Sequence[PageKey],
+                 pages: Sequence[int], page_size: int,
+                 payload: Any = None, registry: Any = None,
+                 source: Any = None) -> ChainExport:
+    """Serialize the chain ``(keys, pages)`` out of a live page pool.
+
+    The caller must hold the pages live for the duration of the call (a
+    slot's references or the index's own — both the migration and the
+    fleet-prefix paths do).  ``payload`` is the chain's terminal prefill
+    logits (device or host); it ships as host numpy so the importer's
+    full-hit path can hand it straight to the engine.
+    """
+    if len(keys) != len(pages):
+        raise TransferError(f"{len(keys)} keys vs {len(pages)} pages")
+    layout = _layout_of(caches)
+    real = np.asarray([int(p) for p in pages if p != NULL_PAGE], np.int32)
+    leaves: List[Tuple[np.ndarray, ...]] = []
+    for layer in caches:
+        leaves.append(tuple(np.asarray(leaf[real]) for leaf in layer))
+    fps = prefix_fingerprints(list(keys))
+    export = ChainExport(
+        keys=list(keys), pages=[int(p) for p in pages], layout=layout,
+        page_size=page_size, num_layers=len(caches), leaves=leaves,
+        payload=None if payload is None else np.asarray(payload),
+        fingerprint=fps[-1] if fps else 0, source=source)
+    if registry is not None:
+        registry.counter(PAGES_EXPORTED_TOTAL).inc(export.n_pages)
+    return export
+
+
+def _check_compat(caches: Sequence[Tuple], export: ChainExport) -> None:
+    """Role-compatible pools may differ in CAPACITY (page count) but never
+    in page geometry — a row scattered into the wrong shape would be
+    silent corruption, so every mismatch is a loud :class:`TransferError`
+    before any destination state changes."""
+    if _layout_of(caches) != export.layout:
+        raise TransferError(
+            f"layout mismatch: pool is {_layout_of(caches)!r}, "
+            f"export is {export.layout!r}")
+    if len(caches) != export.num_layers:
+        raise TransferError(
+            f"layer mismatch: pool has {len(caches)}, "
+            f"export has {export.num_layers}")
+    for l, (layer, rows) in enumerate(zip(caches, export.leaves)):
+        for leaf, row in zip(layer, rows):
+            if tuple(leaf.shape[1:]) != tuple(row.shape[1:]):
+                raise TransferError(
+                    f"page geometry mismatch at layer {l}: pool leaf "
+                    f"{tuple(leaf.shape[1:])} vs export row "
+                    f"{tuple(row.shape[1:])}")
+            if str(leaf.dtype) != str(row.dtype):
+                raise TransferError(
+                    f"dtype mismatch at layer {l}: pool {leaf.dtype} vs "
+                    f"export {row.dtype}")
+
+
+def import_chain(caches, index: PrefixIndex, export: ChainExport,
+                 registry: Any = None):
+    """Admit ``export`` into a destination pool as a registered prefix
+    chain.  Returns the updated caches pytree (functional — the caller
+    swaps its live pytree, same convention as the compiled phase fns).
+
+    Transactional: reuses the destination's already-cached leading chain,
+    atomically allocates the missing tail (LRU-evicting index-only chains
+    when the free list is short), scatters the exported rows, registers
+    the full chain in ``index``, and on ANY failure — including the
+    ``kvcache/page_import`` chaos fault point between allocation and
+    commit — releases every page and reference taken before re-raising.
+    On success the index owns exactly one reference per real page, the
+    same terminal state as a local prefill's ``finish_insert``.
+    """
+    import jax.numpy as jnp
+
+    _check_compat(caches, export)
+    alloc: BlockAllocator = index.alloc
+    matched, _ = index.lookup(export.keys)   # refs we now hold
+    taken = [p for p in matched if p != NULL_PAGE]
+    fresh: List[int] = []
+    try:
+        # the tail the destination is missing; padding keys ride NULL
+        tail = list(range(len(matched), len(export.keys)))
+        need = [i for i in tail if export.pages[i] != NULL_PAGE]
+        short = len(need) - alloc.free_count
+        if short > 0:
+            index.evict(short)
+        fresh = alloc.alloc(len(need))
+        taken += fresh
+        # chaos hook: a kill between allocation and commit must leak
+        # nothing on either side (tests/test_disagg.py)
+        fault_point("kvcache/page_import", pages=len(need),
+                    fingerprint=export.fingerprint)
+        if need:
+            # chain position -> row index in the export's stacked leaves
+            row_of = {i: j for j, i in enumerate(
+                i for i, p in enumerate(export.pages) if p != NULL_PAGE)}
+            sel = np.asarray([row_of[i] for i in need], np.int64)
+            dst = jnp.asarray(np.asarray(fresh, np.int32))
+            new_caches = []
+            for layer, rows in zip(caches, export.leaves):
+                new_caches.append(tuple(
+                    leaf.at[dst].set(jnp.asarray(row[sel]))
+                    for leaf, row in zip(layer, rows)))
+            caches = new_caches
+        full = list(matched)
+        it = iter(fresh)
+        for i in tail:
+            full.append(NULL_PAGE if export.pages[i] == NULL_PAGE
+                        else next(it))
+        index.insert(export.keys, full, payload=export.payload)
+    except BaseException:
+        for p in taken:
+            alloc.free(p)
+        raise
+    # the index retained its own references; drop ours (lookup refs on the
+    # matched prefix, allocation refs on the fresh tail)
+    alloc.free_tail(taken)
+    if registry is not None:
+        registry.counter(PAGES_IMPORTED_TOTAL).inc(len(fresh))
+    return caches

@@ -455,6 +455,10 @@ def summarize_router_stats(path: str) -> Optional[dict]:
         return None
     by_state: Dict[str, int] = {}
     requeued = 0
+    migrated = 0
+    migrations = 0
+    roles: Dict[str, int] = {}
+    replica_roles: Dict[int, str] = {}
     replicas: set = set()
     n = 0
     with open(path) as f:
@@ -468,6 +472,15 @@ def summarize_router_stats(path: str) -> Optional[dict]:
                 by_state.get(rec.get("state", "?"), 0) + 1
             if rec.get("requeues", 0) > 0:
                 requeued += 1
+            # v2 disagg evidence (absent in v1 records: zeros/empty)
+            if rec.get("migrations", 0) > 0:
+                migrated += 1
+                migrations += int(rec["migrations"])
+            role = rec.get("role")
+            if role is not None:
+                roles[role] = roles.get(role, 0) + 1
+                if rec.get("replica", -1) >= 0:
+                    replica_roles[rec["replica"]] = role
             if rec.get("replica", -1) >= 0:
                 replicas.add(rec["replica"])
     if not n:
@@ -477,4 +490,12 @@ def summarize_router_stats(path: str) -> Optional[dict]:
         "by_state": dict(sorted(by_state.items())),
         "requeued": requeued,
         "replicas_seen": sorted(replicas),
+        # disagg rollup: requests that took >=1 KV-migration hop, total
+        # hops, terminal-role mix, and the per-replica role map (empty on
+        # v1 streams and plain fleets)
+        "migrated": migrated,
+        "migrations": migrations,
+        "roles": dict(sorted(roles.items())),
+        "replica_roles": {str(k): v
+                          for k, v in sorted(replica_roles.items())},
     }

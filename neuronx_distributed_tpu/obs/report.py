@@ -721,9 +721,13 @@ def summarize_trace(trace_paths: Sequence[str],
 
     requests: List[dict] = []
     agg_phases = {name: 0.0 for name in PHASE_NAMES}
+    agg_migrate = 0.0
     for rid, group in by_req.items():
         phases = {name: 0.0 for name in PHASE_NAMES}
         hops = 0
+        migrate_ms = 0.0
+        migrations = 0
+        migrate_pages = 0
         replicas = set()
         state = None
         roots = 0
@@ -740,8 +744,15 @@ def summarize_trace(trace_paths: Sequence[str],
                     state = attrs["state"]
             elif s["name"] == "route/requeue":
                 hops = max(hops, int(attrs.get("hop", 0)))
+            elif s["name"] == "route/migrate":
+                # disagg hop: KV export/import wall time (aborted fills
+                # count too — they cost the same router time)
+                migrate_ms += dur
+                migrations += 1
+                migrate_pages += int(attrs.get("pages", 0))
         for name, ms in phases.items():
             agg_phases[name] += ms
+        agg_migrate += migrate_ms
         total = sum(phases.values())
         entry = {
             "request_id": rid,
@@ -751,6 +762,9 @@ def summarize_trace(trace_paths: Sequence[str],
             "prefill_ms": round(phases["prefill"], 3),
             "decode_ms": round(phases["decode"], 3),
             "preempted_ms": round(phases["preempted"], 3),
+            "migrate_ms": round(migrate_ms, 3),
+            "migrations": migrations,
+            "migrate_pages": migrate_pages,
             "hops": hops,
             "replicas": sorted(replicas - {-1}) or [-1],
             "spans": len(group),
@@ -765,11 +779,15 @@ def summarize_trace(trace_paths: Sequence[str],
         requests.append(entry)
 
     requests.sort(key=lambda e: -e["total_ms"])
+    by_phase = {k: round(v, 3) for k, v in agg_phases.items()}
+    # migrate rides beside the four lifetime phases (it overlaps none of
+    # them: the hop happens between withdrawal and re-submission)
+    by_phase["migrate"] = round(agg_migrate, 3)
     return {
         "files": len([p for p in trace_paths if os.path.exists(p)]),
         "spans": len(spans),
         "requests": len(requests),
-        "by_phase_ms": {k: round(v, 3) for k, v in agg_phases.items()},
+        "by_phase_ms": by_phase,
         "slowest": requests[:top],
     }
 
@@ -1317,8 +1335,8 @@ def render_markdown(report: dict) -> str:
         if trace["slowest"]:
             lines += ["Slowest requests (per-request waterfall):", "",
                       "| request | state | total ms | queue | prefill | "
-                      "decode | preempted | hops | replicas |",
-                      "|---|---|---|---|---|---|---|---|---|"]
+                      "decode | preempted | migrate | hops | replicas |",
+                      "|---|---|---|---|---|---|---|---|---|---|"]
             for e in trace["slowest"]:
                 check = (f" (stats {e['stats_total_ms']:.1f})"
                          if e.get("stats_total_ms") is not None else "")
@@ -1326,7 +1344,8 @@ def render_markdown(report: dict) -> str:
                     f"| {e['request_id']} | {e['state'] or '?'} | "
                     f"{e['total_ms']:.1f}{check} | {e['queue_ms']:.1f} | "
                     f"{e['prefill_ms']:.1f} | {e['decode_ms']:.1f} | "
-                    f"{e['preempted_ms']:.1f} | {e['hops']} | "
+                    f"{e['preempted_ms']:.1f} | "
+                    f"{e.get('migrate_ms', 0.0):.1f} | {e['hops']} | "
                     f"{','.join(str(r) for r in e['replicas'])} |")
             lines.append("")
 

@@ -96,11 +96,17 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     # means it survived a failover), how many leading prompt pages the
     # affinity shadow matched at dispatch, and the routing policy in force.
     # replica is -1 for requests that never reached an engine (router-held
-    # cancellation / total capacity loss).
+    # cancellation / total capacity loss).  v2 (disagg PR) adds the
+    # disaggregation evidence: migrations counts KV-page migration hops
+    # (export/import moves between replica pools — distinct from requeues,
+    # which re-prefill), role is the steering role of the replica that
+    # finished the request ("prefill"/"decode"/"mixed"; null for
+    # router-held terminals).
     "router_stats": {
         "schema": str, "time": _NUM, "request_id": int, "client_id": int,
         "replica": int, "state": str, "finish_reason": (str, type(None)),
-        "dispatches": int, "requeues": int, "affinity_pages": int,
+        "dispatches": int, "requeues": int, "migrations": int,
+        "role": (str, type(None)), "affinity_pages": int,
         "new_tokens": int, "policy": str,
     },
     # one line of supervisor_events.jsonl (resilience.supervisor.Supervisor)
@@ -224,6 +230,14 @@ REGISTRY_METRICS: Dict[str, str] = {
     # contiguous [B, T] K/V views from the page pool — stays ZERO when the
     # block-table-native kernel (ops.paged_attention) serves decode
     "kvcache/gather_bytes_total": "counter",
+    # KV chain transfer (kvcache.transfer, disagg PR): pages serialized
+    # out of / admitted into page pools by migration and fleet-prefix
+    # fills; the fleet_prefix counters split directory consultations by
+    # whether a sibling's chain could be imported instead of re-prefilled
+    "kvcache/pages_exported_total": "counter",
+    "kvcache/pages_imported_total": "counter",
+    "kvcache/fleet_prefix_hits_total": "counter",
+    "kvcache/fleet_prefix_misses_total": "counter",
     # int8 KV pages (kvcache.quant): pages written through a
     # quantize-on-write path (prefill page writes + decode requant writes)
     "kvcache/quant_pages_total": "counter",
@@ -271,6 +285,9 @@ REGISTRY_METRICS: Dict[str, str] = {
     "router/retired_total": "counter",
     "router/affinity_hits_total": "counter",
     "router/affinity_misses_total": "counter",
+    # disagg (serving.fleet.disagg.DisaggRouter): KV-page migration hops
+    # from prefill-role to decode-capable replicas
+    "router/migrations_total": "counter",
     "router/replicas_alive": "gauge",
     "router/queue_depth": "gauge",
     "router/inflight": "gauge",

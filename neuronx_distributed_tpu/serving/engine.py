@@ -66,9 +66,15 @@ from neuronx_distributed_tpu.serving.request import (
     RequestOutput,
     RequestState,
 )
-from neuronx_distributed_tpu.kvcache.allocator import PoolExhausted
+from neuronx_distributed_tpu.kvcache.allocator import NULL_PAGE, PoolExhausted
 from neuronx_distributed_tpu.kvcache.pool import GATHER_BYTES_TOTAL
 from neuronx_distributed_tpu.kvcache.quant import QUANT_PAGES_TOTAL
+from neuronx_distributed_tpu.kvcache.transfer import (
+    ChainExport,
+    TransferError,
+    export_chain,
+    import_chain,
+)
 from neuronx_distributed_tpu.serving.paged import PagedKVManager
 from neuronx_distributed_tpu.serving.scheduler import (
     DEFAULT_MAX_BATCH_WAIT_S,
@@ -912,6 +918,79 @@ class ServingEngine:
     def cancel(self, request_id: int) -> bool:
         return self.scheduler.cancel(request_id)
 
+    # -- disaggregation surface (fleet migration / fleet prefix cache) -----
+
+    def withdraw(self, request_id: int) -> Request:
+        """Pull a live request out of this engine WITHOUT a terminal
+        output — the disaggregated fleet's migration hop.  Slot, page and
+        adapter state are released exactly as a preemption park would be,
+        but nothing is requeued and no stats record is written: the
+        request continues on a sibling replica.  Its committed prompt
+        chain survives through the prefix index's own references (the
+        prefill's ``finish_insert`` registered it) — which is precisely
+        the chain the migration exports.  Raises ``KeyError`` for ids
+        this engine does not hold."""
+        now = self._clock()
+        # end the open compute phase BEFORE the scheduler forgets the
+        # request (queued withdrawals have no phase; their queue span is
+        # sealed by the scheduler itself)
+        if self.scheduler.slot_of(request_id) is not None:
+            rt = self._rt.get(request_id)
+            if rt is not None and self.tracer is not None:
+                self.tracer.end(rt.pop("phase", None), t=now, migrated=True)
+        req, slot = self.scheduler.withdraw(request_id, now=now)
+        if slot is not None:
+            self._chunking.pop(slot, None)
+            self._offsets[slot] = self.T  # park: the slot writes nothing
+            self._last_tok_time[slot] = None
+            if self._kv is not None:
+                self._kv.release_slot(slot)
+            self._release_adapter(slot)
+        if self._kv is not None:
+            # a parked victim being migrated drops its local resume pin:
+            # the destination resumes from the imported chain instead
+            self._kv.release_resume(req)
+        if self.tracer is not None:
+            rt = self._rt.pop(request_id, None)
+            if rt is not None:
+                self.tracer.end(rt.get("root"), t=now, migrated=True,
+                                new_tokens=len(req.generated))
+        if self._perf is not None:
+            self._perf_t0.pop(request_id, None)
+        return req
+
+    def export_prefix(self, fingerprint: int) -> Optional[ChainExport]:
+        """Serialize the committed chain whose terminal fingerprint is
+        ``fingerprint`` out of this engine's prefix index — the donor half
+        of both KV migration and the fleet-global prefix cache.  Returns
+        None when the index does not hold the chain (evicted since the
+        directory last synced, or prefix caching off)."""
+        if self._kv is None or self._kv.index is None:
+            return None
+        hit = self._kv.index.find_fingerprint(fingerprint)
+        if hit is None:
+            return None
+        keys, pages, payload = hit
+        return export_chain(self.caches, keys, pages,
+                            page_size=self._kv.page_size, payload=payload,
+                            registry=self.registry)
+
+    def import_prefix(self, export: ChainExport) -> int:
+        """Admit an exported chain into this engine's pool + prefix index
+        — the receiver half.  Transactional (see
+        :func:`~..kvcache.transfer.import_chain`: any failure, including a
+        chaos kill at ``kvcache/page_import``, leaks nothing).  Returns
+        the number of pages actually copied in (0 = already fully cached
+        here)."""
+        if self._kv is None or self._kv.index is None:
+            raise TransferError(
+                "engine has no prefix index; cannot import a chain")
+        matched, _ = self._kv.index.peek(export.keys)
+        already = sum(1 for p in matched if p != NULL_PAGE)
+        self.caches = import_chain(self.caches, self._kv.index, export,
+                                   registry=self.registry)
+        return export.n_pages - already
+
     @property
     def has_work(self) -> bool:
         # an in-flight async decode is work: its results still need one
@@ -1265,6 +1344,10 @@ class ServingEngine:
                     e, slot)
                 outputs.append(self._emit(req, now))
                 raise
+            # the slot's lookup references now cover the resumable chain a
+            # preemption park pinned (if any) — drop the park's pin so the
+            # accounting returns to the one-holder-per-chain norm
+            self._kv.release_resume(req)
             # from here the slot owns the pin: every terminal path releases
             # it through _release_adapter
             if self._adapters is not None:
@@ -1406,7 +1489,6 @@ class ServingEngine:
             self._kv.finish_insert(slot, logits)
         tok = int(first[0][0])
         req.transition(RequestState.DECODE)
-        req.first_token_time = now
         # prefill ends and decode begins at the SAME first-token instant —
         # contiguous phases, so the waterfall sums to the request latency
         self._trace_end_phase(req, t=now)
@@ -1415,15 +1497,22 @@ class ServingEngine:
             t0 = self._perf_t0.pop(req.request_id, None)
             if t0 is not None:
                 self._perf.note_phase("prefill", (now - t0) * 1e3)
-        if req.submit_time is not None:
-            ttft_s = now - req.submit_time
-            self.registry.histogram("serving/ttft_ms", MS_BUCKETS).observe(
-                ttft_s * 1e3)
-            self.registry.histogram(
-                f"serving/ttft_ms_{req.priority}", MS_BUCKETS).observe(
-                    ttft_s * 1e3)
-            # feed the deadline-feasibility estimator real service times
-            self.scheduler.note_first_token(ttft_s)
+        # TTFT is a property of the REQUEST, not of this replica's
+        # prefill: a migrated clone arrives with the source's first-token
+        # instant already stamped (the user streamed their first token
+        # there), so the re-prefill neither re-stamps nor re-observes it.
+        # Preemption still re-stamps — reset_for_requeue nulls the field.
+        if req.first_token_time is None:
+            req.first_token_time = now
+            if req.submit_time is not None:
+                ttft_s = now - req.submit_time
+                self.registry.histogram(
+                    "serving/ttft_ms", MS_BUCKETS).observe(ttft_s * 1e3)
+                self.registry.histogram(
+                    f"serving/ttft_ms_{req.priority}", MS_BUCKETS).observe(
+                        ttft_s * 1e3)
+                # feed the deadline-feasibility estimator real service times
+                self.scheduler.note_first_token(ttft_s)
         self._append_token(slot, req, tok, now)
         if not req.done:
             self._offsets[slot] = self.C
@@ -1565,10 +1654,18 @@ class ServingEngine:
             self._trace_end_phase(req, t=now, preempted=True)
             self.scheduler.requeue(req, now=now)  # frees slot, resets req
             req.parked_at = now
-            self._chunking.pop(slot, None)
+            st = self._chunking.pop(slot, None)
             self._offsets[slot] = self.T  # park
             self._last_tok_time[slot] = None
             if self._kv is not None:
+                # pin the victim's COMMITTED leading chain before the
+                # slot's references drop: the re-grant then matches it in
+                # the prefix index and re-prefills only the uncommitted
+                # tail (a DECODE victim skips prefill entirely).  A
+                # mid-chunk victim's committed depth is its chunk progress.
+                self._kv.park_resume(
+                    slot, req,
+                    fresh_done=st.next_i if st is not None else None)
                 self._kv.release_slot(slot)
             self._release_adapter(slot)
             self.registry.counter("serving/preemptions_total").inc()
@@ -2136,6 +2233,11 @@ class ServingEngine:
             # its re-grant): the open park still counts as preempted time
             req.preempted_ms += max(now - req.parked_at, 0.0) * 1e3
             req.parked_at = None
+        if self._kv is not None:
+            # terminal while holding a resume pin (swept/cancelled parked
+            # victim): the pin drops here, the one choke point every
+            # terminal path funnels through — zero page leak
+            self._kv.release_resume(req)
         tr = self.tracer
         if tr is not None:
             rt = self._rt.pop(req.request_id, None)

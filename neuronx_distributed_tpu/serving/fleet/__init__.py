@@ -15,7 +15,11 @@ the admission layer over the pool:
 - :mod:`.router` — :class:`FleetRouter`: globally-unique request ids
   (namespace-folded into the per-request rng streams), policy dispatch,
   zero-loss failover (crash -> drain -> requeue on siblings -> warm
-  restart), ``router/*`` metrics and ``router_stats.jsonl``.
+  restart), ``router/*`` metrics and ``router_stats.jsonl``;
+- :mod:`.disagg` — :class:`DisaggRouter`: prefill/decode replica roles,
+  post-prefill KV-page migration over ``kvcache.transfer``, and a
+  fleet-global prefix directory so a popular prompt is prefilled once
+  fleet-wide.
 
 Drive a fleet exactly like an engine: it has ``submit`` / ``step`` /
 ``has_work``, so :func:`~..serving.driver.replay` (and everything built on
@@ -23,6 +27,13 @@ it — ``serve_bench``, ``fleet_bench``, ``runner.py serve --replicas N``)
 takes either.
 """
 
+from neuronx_distributed_tpu.serving.fleet.disagg import (
+    ROLE_DECODE,
+    ROLE_MIXED,
+    ROLE_PREFILL,
+    DisaggRouter,
+    FleetPrefixDirectory,
+)
 from neuronx_distributed_tpu.serving.fleet.replica import (
     Replica,
     ReplicaState,
@@ -40,19 +51,26 @@ from neuronx_distributed_tpu.serving.fleet.routing import (
     PrefixAffinityPolicy,
     RandomPolicy,
     ReplicaShadow,
+    RoleAwarePolicy,
     RoundRobinPolicy,
     RoutingPolicy,
     make_policy,
 )
 
 __all__ = [
+    "DisaggRouter",
+    "FleetPrefixDirectory",
     "FleetRouter",
     "FleetUnavailableError",
     "RequestIdAllocator",
     "ROUTER_STATS_SCHEMA",
     "Replica",
     "ReplicaState",
+    "ROLE_DECODE",
+    "ROLE_MIXED",
+    "ROLE_PREFILL",
     "RoutingPolicy",
+    "RoleAwarePolicy",
     "RoundRobinPolicy",
     "RandomPolicy",
     "LeastLoadedPolicy",

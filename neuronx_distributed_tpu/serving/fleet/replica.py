@@ -57,10 +57,17 @@ class Replica:
 
     def __init__(self, replica_id: int,
                  engine_factory: Callable[[], Any], *,
+                 role: str = "mixed",
                  max_restarts: int = 3, backoff_base_s: float = 0.5,
                  backoff_max_s: float = 30.0,
                  clock: Callable[[], float] = time.monotonic):
         self.replica_id = int(replica_id)
+        # disaggregated-fleet role ("prefill" / "decode" / "mixed"): a
+        # STEERING label, not a capability — any replica can run either
+        # phase; the role tells the router where interactive TTFT traffic
+        # should land and where finished prefills should migrate.  Survives
+        # restarts (lifecycle state, not engine state).
+        self.role = str(role)
         self._factory = engine_factory
         self._clock = clock
         self.backoff = RestartBackoff(max_restarts, base_s=backoff_base_s,
@@ -117,6 +124,7 @@ class Replica:
         eng = self.engine
         view = {
             "replica_id": self.replica_id,
+            "role": self.role,
             "queue_depth": 0, "active": 0, "slots": 1,
             "pages_free": None, "host_blocked_ms_mean": None,
         }

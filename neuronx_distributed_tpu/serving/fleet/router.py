@@ -68,7 +68,7 @@ from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
-ROUTER_STATS_SCHEMA = "router_stats/1"
+ROUTER_STATS_SCHEMA = "router_stats/2"
 
 
 class FleetUnavailableError(RuntimeError):
@@ -106,8 +106,8 @@ class _Tracked:
     the affinity evidence for ``router_stats``."""
 
     __slots__ = ("global_id", "client_id", "template", "fps", "replica_id",
-                 "dispatches", "requeues", "affinity_pages", "submit_time",
-                 "done", "cancelled", "clone", "adapter_id")
+                 "dispatches", "requeues", "migrations", "affinity_pages",
+                 "submit_time", "done", "cancelled", "clone", "adapter_id")
 
     def __init__(self, global_id: int, client_id: int, template: Request,
                  fps: List[int], submit_time: float):
@@ -119,6 +119,7 @@ class _Tracked:
         self.replica_id: Optional[int] = None
         self.dispatches = 0
         self.requeues = 0
+        self.migrations = 0  # disagg KV-migration hops (router_stats v2)
         self.affinity_pages = 0
         self.submit_time = submit_time
         self.done = False
@@ -206,13 +207,7 @@ class FleetRouter:
         desc = replicas[0].describe()
         self._ctx = desc["context_len"]
         self._page = desc["page_size"]
-        for r in replicas[1:]:
-            if r.describe() != desc:
-                raise ValueError(
-                    f"heterogeneous fleet: replica {r.replica_id} serves "
-                    f"{r.describe()}, replica {replicas[0].replica_id} "
-                    f"{desc} — prefix hashing and requeue both assume one "
-                    "compiled envelope")
+        self._check_envelopes(replicas, desc)
 
         reg = self.registry
         for c in ("dispatched", "requeued", "failovers", "restarts",
@@ -222,6 +217,29 @@ class FleetRouter:
                   "affinity_hit_rate", "fleet_prefix_hit_rate"):
             reg.gauge(f"router/{g}")
         self._export_gauges()
+
+    def _check_envelopes(self, replicas: Sequence[Replica],
+                         desc: dict) -> None:
+        """Refuse a fleet whose replicas serve different compiled
+        envelopes: prefix hashing and failover requeue both assume a
+        request admissible on one replica is admissible on any sibling.
+        The disaggregated router overrides this with a ROLE-COMPATIBLE
+        relaxation (capacity keys may differ between prefill- and
+        decode-heavy replicas; geometry never does)."""
+        for r in replicas[1:]:
+            if r.describe() != desc:
+                raise ValueError(
+                    f"heterogeneous fleet: replica {r.replica_id} serves "
+                    f"{r.describe()}, replica {replicas[0].replica_id} "
+                    f"{desc} — prefix hashing and requeue both assume one "
+                    "compiled envelope")
+
+    def _replica_role(self, rid: Optional[int]) -> Optional[str]:
+        """The steering role of a replica id ("mixed" for plain fleets;
+        None for unknown/router-held) — the ``router_stats`` v2 field."""
+        replica = self.replicas.get(rid) if rid is not None else None
+        return getattr(replica, "role", "mixed") if replica is not None \
+            else None
 
     # -- request surface ---------------------------------------------------
 
@@ -529,9 +547,13 @@ class FleetRouter:
         # load views cost a metrics scan per replica; rotation/random
         # policies never read them
         views = (self._views(candidates) if self.policy.needs_views else {})
+        kw = {"adapter_id": rec.adapter_id}
+        if self.policy.needs_priority:
+            # only role-steering policies receive the class — keeps every
+            # pre-existing policy's `choose` signature valid
+            kw["priority"] = getattr(request, "priority", "interactive")
         decision: Decision = self.policy.choose(
-            candidates, views, self.shadows, rec.fps,
-            adapter_id=rec.adapter_id)
+            candidates, views, self.shadows, rec.fps, **kw)
         order = [decision.replica_id] + [
             rid for rid in candidates if rid != decision.replica_id]
         for i, rid in enumerate(order):
@@ -739,6 +761,11 @@ class FleetRouter:
             "finish_reason": out.finish_reason,
             "dispatches": rec.dispatches,
             "requeues": rec.requeues,
+            # v2: disagg evidence — KV-migration hops this request took
+            # and the steering role of the replica that finished it
+            # ("mixed" on plain fleets, null for router-held terminals)
+            "migrations": rec.migrations,
+            "role": self._replica_role(rec.replica_id),
             "affinity_pages": rec.affinity_pages,
             "new_tokens": len(out.token_ids),
             "policy": self.policy.name,

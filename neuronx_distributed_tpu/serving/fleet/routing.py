@@ -98,6 +98,11 @@ class RoutingPolicy:
     # (pure rotation/random) opt out and receive {} / []
     needs_views = True
     needs_fps = True
+    # policies that steer by the request's PRIORITY CLASS (the
+    # disaggregated fleet's role-aware dispatch) opt in and receive a
+    # `priority` kwarg; the default keeps every existing policy's
+    # `choose` signature valid
+    needs_priority = False
 
     def choose(self, candidates: List[int], views: Dict[int, dict],
                shadows: Dict[int, ReplicaShadow],
@@ -188,9 +193,42 @@ class PrefixAffinityPolicy(RoutingPolicy):
                         affinity_pages=best)
 
 
+class RoleAwarePolicy(RoutingPolicy):
+    """Disaggregated dispatch: steer by replica ROLE (the ``role`` field
+    in the load views — "prefill" / "decode" / "mixed") before anything
+    else.  Interactive traffic prefers prefill-capable replicas (TTFT is
+    gated on prefill queueing, the DistServe/Splitwise observation);
+    batch traffic prefers decode-capable ones, keeping prefill capacity
+    free for the latency-sensitive class.  Within the role-preferred
+    pool the choice is exactly :class:`PrefixAffinityPolicy` — longest
+    shadow chain, then adapter residency, then least load — so the
+    disaggregated fleet keeps the cache-aware win.  When no replica of
+    the wanted role is alive the pool falls back to everyone (roles are
+    steering labels, not capabilities)."""
+
+    name = "role_aware"
+    needs_priority = True
+
+    def choose(self, candidates, views, shadows, fps,
+               adapter_id: int = 0,
+               priority: str = "interactive") -> Decision:
+        want = "prefill" if priority == "interactive" else "decode"
+        preferred = [r for r in candidates
+                     if views.get(r, {}).get("role", "mixed")
+                     in (want, "mixed")]
+        pool = preferred or candidates
+        depths = {r: shadows[r].match_depth(fps) for r in pool} if fps else {}
+        best = max(depths.values(), default=0)
+        tied = (pool if best == 0
+                else [r for r in pool if depths[r] == best])
+        tied = PrefixAffinityPolicy._adapter_tiebreak(tied, views, adapter_id)
+        return Decision(min(tied, key=lambda r: load_score(views[r])),
+                        affinity_pages=best)
+
+
 POLICIES = {
     p.name: p for p in (RoundRobinPolicy, RandomPolicy, LeastLoadedPolicy,
-                        PrefixAffinityPolicy)
+                        PrefixAffinityPolicy, RoleAwarePolicy)
 }
 
 

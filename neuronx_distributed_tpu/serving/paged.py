@@ -27,7 +27,7 @@ construction and sharing needs no copy-on-write on this path.
 from __future__ import annotations
 
 import math
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -93,6 +93,9 @@ class PagedKVManager:
         self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
         self._slot_fresh: List[List[tuple]] = [[] for _ in range(num_slots)]
         self._slot_keys: List[Optional[list]] = [None] * num_slots
+        # parked preemption victims holding resume pins (insertion = park
+        # order, so last-resort reclaim drops the oldest park first)
+        self._resume: Dict[int, Request] = {}
         if registry is not None:
             registry.gauge(PAGES_TOTAL).set(self.alloc.capacity)
             registry.gauge(PAGES_IN_USE)
@@ -118,12 +121,33 @@ class PagedKVManager:
             (req.max_new_tokens + self.spec_overshoot) / self.page_size)
 
     def pages_free(self) -> int:
-        """Pages an admission could use right now: the free list plus what
-        LRU eviction of unpinned cached chains would reclaim."""
+        """Pages an admission could use right now: the free list, plus what
+        LRU eviction of unpinned cached chains would reclaim, plus what
+        dropping parked victims' resume pins (and then evicting the
+        un-pinned chains) would — pinned chains ARE reclaimable, just at
+        the cost of a victim's re-prefill, so admission must never
+        deadlock behind them."""
         free = self.alloc.free_count
         if self.index is not None:
             free += self.index.evictable_pages()
-        return free
+        return free + self._resume_reclaimable()
+
+    def _resume_reclaimable(self) -> int:
+        """Pages that releasing every parked resume pin would make
+        evictable: those whose ONLY holders are the index plus resume pins
+        (refcount == 1 + pin multiplicity).  A page an active slot also
+        references carries an extra reference and is excluded — engine
+        chains reference whole prefixes, so the count is an achievable
+        lower bound, never an overcount."""
+        if not self._resume:
+            return 0
+        pins: Dict[int, int] = {}
+        for req in self._resume.values():
+            for p in req.resume_pages:
+                if p != NULL_PAGE:
+                    pins[p] = pins.get(p, 0) + 1
+        return sum(1 for p, k in pins.items()
+                   if self.alloc.refcount(p) == 1 + k)
 
     def pages_capacity(self) -> int:
         return self.alloc.capacity
@@ -236,6 +260,65 @@ class PagedKVManager:
         self.tables[slot] = NULL_PAGE
         self.tables_dirty = True
 
+    # -- preemption-aware resume -------------------------------------------
+
+    def park_resume(self, slot: int, req: Request,
+                    fresh_done: Optional[int] = None) -> None:
+        """Pin the slot's COMMITTED leading page chain on the (about to be
+        requeued) victim, so the re-grant's prefix lookup matches it and
+        re-prefills only the uncommitted tail.
+
+        Call BEFORE :meth:`release_slot` (the slot's references are what
+        keep the pages alive while the pin is taken).  ``fresh_done`` is
+        how many of the slot's fresh prompt pages hold real KV: None for a
+        DECODE victim (prefill completed — the whole context chain is
+        committed), else the chunk loop's progress counter (only the
+        padding/matched prefix plus that many fresh pages are committed).
+
+        The chain is registered in the prefix index (a mid-chunk victim's
+        partial chain was never ``finish_insert``-ed) and each non-NULL
+        page takes one extra request-held reference — refcount >= 2 makes
+        the chain evict-proof while parked.  ``release_resume`` drops the
+        pin exactly once: at the re-grant, at any terminal path, or as
+        :meth:`_ensure_free`'s last-resort reclaim under pool pressure
+        (the victim then simply re-prefills from scratch)."""
+        if self.index is None or req.resume_keys is not None:
+            return
+        keys = self._slot_keys[slot]
+        if keys is None:
+            return
+        fresh = self._slot_fresh[slot]
+        if fresh_done is None or not fresh:
+            depth = self.ctx_pages
+        else:
+            depth = fresh[0][0] + min(int(fresh_done), len(fresh))
+        if depth <= 0:
+            return
+        ckeys = list(keys[:depth])
+        pages = [int(p) for p in self.tables[slot][:depth]]
+        # register first (a DECODE victim's chain is already indexed — the
+        # re-insert is a touch; a mid-chunk victim's partial chain is new
+        # and the index takes its own references), then pin
+        self.index.insert(ckeys, pages)
+        for p in pages:
+            self.alloc.retain(p)  # no-op on NULL padding holes
+        req.resume_pages = pages
+        req.resume_keys = ckeys
+        self._resume[req.request_id] = req
+
+    def release_resume(self, req: Request) -> None:
+        """Drop a parked victim's resume pin (idempotent — the re-grant,
+        every terminal path, and the pool-pressure reclaim can all call
+        it; only the first does anything).  The chain stays in the prefix
+        index under the index's own references, subject to normal LRU
+        eviction from here on."""
+        if req.resume_keys is None and not req.resume_pages:
+            return
+        self.alloc.free_tail(req.resume_pages)
+        req.resume_pages = []
+        req.resume_keys = None
+        self._resume.pop(req.request_id, None)
+
     def prefix_fingerprints(self):
         """Chain fingerprints of every prompt chain the live prefix index
         holds (empty set without a prefix cache) — the fleet router's
@@ -247,13 +330,22 @@ class PagedKVManager:
     # -- internals ---------------------------------------------------------
 
     def _ensure_free(self, n: int) -> None:
-        """Make room for an allocation of ``n`` by evicting LRU unpinned
-        cached chains — the admission gate already verified
-        free + evictable covers the worst case, so a miss here is a bug the
-        allocator's :class:`PoolExhausted` will surface loudly."""
+        """Make room for an allocation of ``n``: evict LRU unpinned cached
+        chains first, then — last resort — drop parked victims' resume
+        pins (oldest park first; those victims re-prefill from scratch,
+        correctness untouched) and evict the un-pinned chains.  The
+        admission gate already verified free + evictable + pin-reclaimable
+        covers the worst case, so a miss here is a bug the allocator's
+        :class:`PoolExhausted` will surface loudly."""
         short = n - self.alloc.free_count
         if short > 0 and self.index is not None:
-            self.index.evict(short)
+            short -= self.index.evict(short)
+        if short > 0 and self._resume and self.index is not None:
+            for rid in list(self._resume):
+                self.release_resume(self._resume[rid])
+                short -= self.index.evict(short)
+                if short <= 0:
+                    break
 
     def export_gauges(self) -> None:
         if self.registry is None:
@@ -278,3 +370,12 @@ class PagedKVManager:
             assert held <= set(self._slot_pages[slot]), (
                 f"slot {slot} table points at pages it holds no reference "
                 f"on: {sorted(held - set(self._slot_pages[slot]))}")
+        for rid, req in self._resume.items():
+            assert req.resume_keys is not None, (
+                f"parked request {rid} tracked without a resume chain")
+            for p in req.resume_pages:
+                if p != NULL_PAGE:
+                    # the pin's own reference plus the index's
+                    assert self.alloc.refcount(p) >= 2, (
+                        f"parked request {rid} pins page {p} with refcount "
+                        f"{self.alloc.refcount(p)}")

@@ -151,6 +151,15 @@ class Request:
     preempted_ms: float = 0.0
     parked_at: Optional[float] = None
     trace_id: Optional[int] = None
+    # preemption-aware resume (engine-owned): the COMMITTED page chain a
+    # preempted victim keeps pinned while parked — extra allocator
+    # references on `resume_pages` (NULL holes excluded) plus the matching
+    # page keys.  The re-grant's prefix lookup matches this chain, so only
+    # the uncommitted tail re-prefills; every terminal path (and the
+    # re-grant itself) releases the pin exactly once via the kv manager's
+    # `release_resume`.  Survives `reset_for_requeue` by design.
+    resume_pages: List[int] = dataclasses.field(default_factory=list)
+    resume_keys: Optional[list] = None
 
     def __post_init__(self):
         self.prompt_ids = [int(t) for t in self.prompt_ids]
@@ -198,7 +207,11 @@ class Request:
         re-prefills it from the prompt and, because the rng stream is keyed
         only on ``(rng, request_id, token_index)``, regenerates the same
         tokens.  ``submit_time`` (and so the absolute deadline) is
-        preserved; ``preemptions`` counts the round-trip."""
+        preserved; ``preemptions`` counts the round-trip.  The resumable
+        chain (``resume_pages``/``resume_keys``, pinned by the kv
+        manager's ``park_resume`` just before this call) also survives:
+        it is what lets the re-grant skip re-prefilling committed
+        pages."""
         self.transition(RequestState.QUEUED)
         self.generated.clear()
         self.intertoken_ms.clear()

@@ -561,6 +561,34 @@ class SlotScheduler:
         self._cancel_requested.discard(request.request_id)
         return slot
 
+    def withdraw(self, request_id: int, now: Optional[float] = None
+                 ) -> Tuple[Request, Optional[int]]:
+        """Remove a NON-terminal request from this scheduler without a
+        terminal transition — the disaggregated router's migration hop:
+        the request continues on a sibling replica, so locally it simply
+        ceases to exist.  Active requests free their slot; queued ones
+        leave their class queue (open wait span sealed).  Returns
+        ``(request, slot)`` with ``slot`` None for a queued withdrawal;
+        raises ``KeyError`` for ids this scheduler does not hold."""
+        now = time.monotonic() if now is None else now
+        req = self._by_id.pop(request_id, None)
+        if req is None:
+            raise KeyError(f"request {request_id} is not scheduled here")
+        slot = self._slot_of.pop(request_id, None)
+        if slot is not None:
+            self._slots[slot] = None
+        else:
+            queue = self._queues[req.priority]
+            key = self._keys[request_id]
+            queue.remove(key + (req,))
+        self._keys.pop(request_id, None)
+        self._cancel_requested.discard(request_id)
+        if self.tracer is not None:
+            span = self._qspans.pop(request_id, None)
+            if span is not None:
+                self.tracer.end(span, t=now, withdrawn=True)
+        return req, slot
+
     def trace_abort(self, now: Optional[float] = None) -> None:
         """Seal every still-open wait span (engine teardown / replica
         death): an aborted span in the ring beats an open span lost with

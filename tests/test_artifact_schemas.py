@@ -233,19 +233,28 @@ def test_router_stats_schema_and_fleet_report_line(tmp_path):
         {"schema": ROUTER_STATS_SCHEMA, "time": 1.0, "request_id": 1 << 32,
          "client_id": 0, "replica": 2, "state": "finished",
          "finish_reason": "length", "dispatches": 2, "requeues": 1,
+         "migrations": 0, "role": "mixed",
          "affinity_pages": 3, "new_tokens": 8, "policy": "prefix_affinity"},
-        # a router-held cancellation: never reached an engine
+        # a router-held cancellation: never reached an engine (role null)
         {"schema": ROUTER_STATS_SCHEMA, "time": 2.0,
          "request_id": (1 << 32) | 1, "client_id": 1, "replica": -1,
          "state": "cancelled", "finish_reason": "cancelled", "dispatches": 0,
-         "requeues": 0, "affinity_pages": 0, "new_tokens": 0,
+         "requeues": 0, "migrations": 0, "role": None,
+         "affinity_pages": 0, "new_tokens": 0,
          "policy": "prefix_affinity"},
+        # a disaggregated request: prefilled on a prefill-role replica,
+        # migrated once, finished on decode capacity (v2 fields live)
+        {"schema": ROUTER_STATS_SCHEMA, "time": 3.0,
+         "request_id": (1 << 32) | 2, "client_id": 2, "replica": 1,
+         "state": "finished", "finish_reason": "stop", "dispatches": 2,
+         "requeues": 0, "migrations": 1, "role": "decode",
+         "affinity_pages": 2, "new_tokens": 4, "policy": "role_aware"},
     ]
     path = tmp_path / "router_stats.jsonl"
     with open(path, "w") as f:
         for r in recs:
             f.write(json.dumps(r) + "\n")
-    assert validate_jsonl("router_stats", str(path)) == 2
+    assert validate_jsonl("router_stats", str(path)) == 3
     with pytest.raises(ValueError, match="missing required field"):
         validate_record("router_stats", {"schema": ROUTER_STATS_SCHEMA})
     with pytest.raises(ValueError, match="expected"):

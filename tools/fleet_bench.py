@@ -25,6 +25,19 @@ engine owns its KV state):
   requeued in-flight work, and the schema-checked ``router_stats.jsonl``
   agrees record-for-record.
 
+``--disagg`` switches to the disaggregated-fleet acceptance rung (the
+``serving_disagg`` tpu_watch job): a bimodal interactive/batch trace
+through a role-split :class:`DisaggRouter` (prefill + decode replicas)
+vs a homogeneous ``prefix_affinity`` fleet at EQUAL replica count.  Four
+gates, all required: (1) the role-split fleet's interactive TTFT p99
+beats the homogeneous fleet's; (2) KV-page migration happened and every
+output is token-identical across the arms; (3) a preempted request
+resumes WITHOUT re-prefilling its committed pages
+(``kvcache/prefill_skipped_total``) and leaks nothing; (4) a chaos kill
+at the ``kvcache/page_import`` fault point mid-migration still yields
+exactly one finished, token-identical output per request with zero page
+leaks on either side.
+
 Run by ``tools/tpu_watch.py`` as the ``serving_fleet`` extra job;
 ``--tiny`` smoke-tests the harness on CPU (the same rungs, smaller model).
 """
@@ -320,6 +333,241 @@ def run_failover(args, model, vocab_size, engine_kw) -> dict:
     return rec
 
 
+# -- disaggregated-fleet rung -------------------------------------------------
+
+def _build_disagg(model, n_replicas, seed, **engine_kw):
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.serving import Replica, ServingEngine
+    from neuronx_distributed_tpu.serving.fleet import DisaggRouter
+
+    def factory():
+        return ServingEngine(model, registry=MetricRegistry(), **engine_kw)
+
+    n_prefill = max(1, n_replicas // 2)
+    roles = (["prefill"] * n_prefill
+             + ["decode"] * (n_replicas - n_prefill))
+    return DisaggRouter(
+        [Replica(i, factory, backoff_base_s=0.01, role=roles[i])
+         for i in range(n_replicas)], seed=seed)
+
+
+def _bimodal_trace(args, vocab_size, C):
+    """The trace disaggregation exists for: batch full-context long-decode
+    requests plus interactive short-prompt short-decode requests arriving
+    into the already-busy fleet.  Returns a builder (requests are rekeyed
+    on submit, so each arm needs a fresh set)."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.serving import Request
+
+    rs = np.random.RandomState(args.seed + 3)
+    n_batch = args.num_requests // 2
+    n_inter = args.num_requests - n_batch
+    short = max(C // 2 // args.page_size * args.page_size, args.page_size)
+    batch_p = [rs.randint(1, vocab_size, size=C).tolist()
+               for _ in range(n_batch)]
+    inter_p = [rs.randint(1, vocab_size, size=short).tolist()
+               for _ in range(n_inter)]
+
+    def build():
+        batch = [Request(request_id=i, prompt_ids=p,
+                         max_new_tokens=args.max_new_tokens,
+                         priority="batch")
+                 for i, p in enumerate(batch_p)]
+        inter = [Request(request_id=n_batch + i, prompt_ids=p,
+                         max_new_tokens=min(3, args.max_new_tokens),
+                         priority="interactive")
+                 for i, p in enumerate(inter_p)]
+        return batch, inter
+
+    return build, n_batch
+
+
+def _drive_bimodal(router, batch, inter, warm_steps=2):
+    """Submit the batch load, let it occupy the fleet, then stream the
+    interactive arrivals one fleet-step apart (a burst past the prefill
+    capacity would measure queueing in BOTH arms, not placement); returns
+    ``{client_id: output}``."""
+    outs = {}
+
+    def tick():
+        for o in router.step():
+            outs[router.client_id(o.request_id)] = o
+
+    for r in batch:
+        router.submit(r)
+    for _ in range(warm_steps):
+        tick()
+    for r in inter:
+        router.submit(r)
+        tick()
+    for _ in range(20000):
+        tick()
+        if not router.has_work:
+            break
+    return outs
+
+
+def _arm_fields(outs, n_batch):
+    import numpy as np
+
+    ttfts = [o.ttft_ms for cid, o in outs.items()
+             if cid >= n_batch and o.ttft_ms is not None]
+    return {
+        "finished": sum(1 for o in outs.values() if o.state == "finished"),
+        "interactive_ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 2),
+        "interactive_ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 2),
+    }
+
+
+def _resume_probe(args, model, vocab_size, engine_kw) -> dict:
+    """Gate 3: slot-pressure preemption on one engine with a roomy page
+    pool — the victim's committed chain survives the park, so re-admission
+    must SKIP the prefill pass and leak nothing."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.serving import Request, ServingEngine
+
+    kw = dict(engine_kw)
+    kw["num_pages"] = 2 * engine_kw["num_pages"]   # never page-blocked
+    eng = ServingEngine(model, registry=MetricRegistry(), **kw)
+    rs = np.random.RandomState(args.seed + 4)
+    C = model.config.context_len
+    n_slots = args.batch_size
+    for i in range(n_slots):
+        eng.submit(Request(
+            request_id=i, prompt_ids=rs.randint(1, vocab_size,
+                                                size=C).tolist(),
+            max_new_tokens=args.max_new_tokens, priority="batch"))
+    outs = []
+    outs += eng.step()
+    outs += eng.step()                    # batch decodes hold every slot
+    eng.submit(Request(
+        request_id=99,
+        prompt_ids=rs.randint(1, vocab_size, size=C // 2).tolist(),
+        max_new_tokens=2, priority="interactive"))
+    for _ in range(20000):
+        outs += eng.step()
+        if not eng.has_work:
+            break
+    snap = eng.registry.snapshot()
+    try:
+        eng._kv.assert_invariants()
+        leak_free = True
+    except AssertionError:
+        leak_free = False
+    eng.close()
+    return {
+        "finished": sum(1 for o in outs if o.state == "finished"),
+        "submitted": n_slots + 1,
+        "preemptions": snap.get("serving/preemptions_total", 0.0),
+        "prefill_skipped": snap.get("kvcache/prefill_skipped_total", 0.0),
+        "leak_free": leak_free,
+    }
+
+
+def run_disagg(args, model, vocab_size, engine_kw) -> dict:
+    from neuronx_distributed_tpu.resilience.faults import clear_plan, install_plan
+
+    if args.replicas < 2:
+        raise SystemExit("--disagg needs --replicas >= 2 (at least one "
+                         "prefill and one decode replica)")
+    C = model.config.context_len
+    build, n_batch = _bimodal_trace(args, vocab_size, C)
+
+    # arm A: homogeneous fleet, cache-aware policy — today's best baseline
+    router = _build_fleet(model, args.replicas, "prefix_affinity",
+                          args.seed, **engine_kw)
+    batch, inter = build()
+    outs_a = _drive_bimodal(router, batch, inter)
+    router.assert_invariants()
+    arm_a = _arm_fields(outs_a, n_batch)
+    router.close()
+
+    # arm B: the SAME chip count split into prefill/decode roles
+    router = _build_disagg(model, args.replicas, args.seed, **engine_kw)
+    batch, inter = build()
+    outs_b = _drive_bimodal(router, batch, inter)
+    router.assert_invariants()
+    arm_b = _arm_fields(outs_b, n_batch)
+    snap_b = router.registry.snapshot()
+    arm_b["migrations"] = snap_b.get("router/migrations_total", 0.0)
+    arm_b["fleet_prefix_hits"] = snap_b.get(
+        "kvcache/fleet_prefix_hits_total", 0.0)
+    arm_b["roles"] = {str(k): v for k, v in router.roles().items()}
+    leak_free_b = True
+    for r in router.replicas.values():
+        try:
+            r.engine._kv.assert_invariants()
+        except AssertionError:
+            leak_free_b = False
+    router.close()
+
+    # gate 2: greedy outputs must be identical wherever — and however
+    # often — a request was placed, migrated, or preempted
+    identical = (set(outs_a) == set(outs_b) and all(
+        list(outs_a[cid].token_ids) == list(outs_b[cid].token_ids)
+        for cid in outs_a))
+
+    resume = _resume_probe(args, model, vocab_size, engine_kw)
+
+    # gate 4: a one-shot kill between page allocation and index commit
+    # mid-migration — the transactional abort must keep the run perfect
+    install_plan({"faults": [{"point": "kvcache/page_import",
+                              "action": "exception", "count": 1,
+                              "message": "fleet_bench: injected import "
+                                         "kill"}]})
+    try:
+        router = _build_disagg(model, args.replicas, args.seed, **engine_kw)
+        batch, inter = build()
+        outs_c = _drive_bimodal(router, batch, inter)
+        router.assert_invariants()
+        chaos_leak_free = True
+        for r in router.replicas.values():
+            try:
+                r.engine._kv.assert_invariants()
+            except AssertionError:
+                chaos_leak_free = False
+        router.close()
+    finally:
+        clear_plan()
+    chaos = {
+        "finished": sum(1 for o in outs_c.values()
+                        if o.state == "finished"),
+        "outputs": len(outs_c),
+        "identical": (set(outs_c) == set(outs_a) and all(
+            list(outs_c[cid].token_ids) == list(outs_a[cid].token_ids)
+            for cid in outs_c)),
+        "leak_free": chaos_leak_free,
+    }
+
+    n = args.num_requests
+    gates = {
+        "ttft": (arm_b["interactive_ttft_p99_ms"]
+                 < arm_a["interactive_ttft_p99_ms"]
+                 and arm_a["finished"] == arm_b["finished"] == n),
+        "migration_identical": (identical and arm_b["migrations"] >= 1.0
+                                and leak_free_b),
+        "resume_skips_prefill": (
+            resume["finished"] == resume["submitted"]
+            and resume["preemptions"] >= 1.0
+            and resume["prefill_skipped"] >= 1.0
+            and resume["leak_free"]),
+        "chaos_exactly_once": (chaos["finished"] == chaos["outputs"] == n
+                               and chaos["identical"]
+                               and chaos["leak_free"]),
+    }
+    return {
+        "metric": "serving_disagg", "rung": "disagg",
+        "num_requests": n,
+        "homogeneous": arm_a, "disagg": arm_b,
+        "resume": resume, "chaos": chaos,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--tiny", action="store_true", help="CPU smoke config")
@@ -350,6 +598,12 @@ def main() -> int:
                         "<rung>.alerts.jsonl; the failover rung "
                         "additionally requires the replica_down alert to "
                         "fire at the kill and resolve at the warm restart")
+    p.add_argument("--disagg", action="store_true",
+                   help="run the disaggregated-fleet rung instead of the "
+                        "scale/affinity/failover trio: role-split vs "
+                        "homogeneous TTFT p99 at equal chips, migration "
+                        "token-parity, preemption-resume prefill skip, "
+                        "and the chaos kill mid-migration (all rc-gated)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -433,7 +687,9 @@ def main() -> int:
                        "max_new": args.max_new_tokens,
                        "page_size": args.page_size}}
     rc = 0
-    for rung in (run_scale, run_affinity, run_failover):
+    rungs = ((run_disagg,) if args.disagg
+             else (run_scale, run_affinity, run_failover))
+    for rung in rungs:
         rec = rung(args, model, cfg.vocab_size, engine_kw)
         print(json.dumps({**rec, **base}))
         if not rec["ok"]:

@@ -104,6 +104,7 @@ def render_run_dir(run_dir: str) -> str:
     from neuronx_distributed_tpu.obs.aggregate import (
         discover_replica_dirs,
         merge_scalar_records,
+        summarize_router_stats,
     )
 
     lines = [f"fleet_watch — {os.path.abspath(run_dir)} — "
@@ -121,9 +122,17 @@ def render_run_dir(run_dir: str) -> str:
             per_replica[label] = _latest(recs)
     merged = _latest(merge_scalar_records(streams)) if streams else {}
 
+    # router_stats rollup (v2 carries the disagg evidence: per-replica
+    # roles and KV-migration hops); tolerant of absence and of v1 streams
+    rstats = summarize_router_stats(
+        os.path.join(run_dir, "router_stats.jsonl")) or {}
+    replica_roles = rstats.get("replica_roles", {})
+
     # -- fleet rollup
     hits = merged.get("kvcache/prefix_hits_total", 0.0)
     misses = merged.get("kvcache/prefix_misses_total", 0.0)
+    fp_hits = merged.get("kvcache/fleet_prefix_hits_total", 0.0)
+    fp_misses = merged.get("kvcache/fleet_prefix_misses_total", 0.0)
     rollup = [
         ("replicas alive", _fmt(merged.get("router/replicas_alive"))),
         ("queue depth", _fmt(merged.get("router/queue_depth",
@@ -139,6 +148,21 @@ def render_run_dir(run_dir: str) -> str:
     ]
     lines += ["", "== fleet =="]
     lines += [f"  {name:<16} {val:>12}" for name, val in rollup]
+
+    # -- disagg health line: only rendered when the fleet IS disaggregated
+    # (role-labelled terminals, migrations, or fleet-prefix traffic)
+    migrations = merged.get("router/migrations_total", 0.0)
+    roles = rstats.get("roles", {})
+    specialized = any(r in ("prefill", "decode") for r in roles)
+    if specialized or migrations or fp_hits or fp_misses:
+        role_mix = " ".join(f"{k}:{int(v)}" for k, v in roles.items()) \
+            or "-"
+        fp_rate = (f"{fp_hits / (fp_hits + fp_misses):.0%}"
+                   if fp_hits + fp_misses else "-")
+        lines.append(
+            f"  {'disagg':<16} roles {role_mix}; "
+            f"{_fmt(migrations)} migration(s); fleet-prefix "
+            f"{_fmt(fp_hits)}/{_fmt(fp_misses)} hit/miss ({fp_rate})")
 
     # -- firing alerts
     firing = _firing_alerts(run_dir)
@@ -158,15 +182,19 @@ def render_run_dir(run_dir: str) -> str:
     # -- per-replica occupancy
     if per_replica:
         lines += ["", "== replicas =="]
-        lines.append(f"  {'replica':<12} {'pages':>13} {'occ':>7} "
-                     f"{'active':>7} {'queue':>7} {'tokens':>9}")
+        lines.append(f"  {'replica':<12} {'role':<8} {'pages':>13} "
+                     f"{'occ':>7} {'active':>7} {'queue':>7} {'tokens':>9}")
         for label in sorted(per_replica):
             snap = per_replica[label]
             total = snap.get("kvcache/pages_total", 0.0)
             in_use = snap.get("kvcache/pages_in_use", 0.0)
             occ = f"{in_use / total:.0%}" if total else "-"
+            # router_stats keys roles by replica id; dir labels look like
+            # "replica0" — match on the numeric suffix when present
+            rid = "".join(ch for ch in label if ch.isdigit())
+            role = replica_roles.get(rid) or "-"
             lines.append(
-                f"  {label:<12} "
+                f"  {label:<12} {role:<8} "
                 f"{_fmt(in_use)}/{_fmt(total):<6} {occ:>7} "
                 f"{_fmt(snap.get('serving/slots_active')):>7} "
                 f"{_fmt(snap.get('serving/queue_depth')):>7} "

@@ -294,6 +294,13 @@ def run_extra_jobs(results_path: str) -> None:
         # under an injected replica kill
         ("serving_fleet", [sys.executable,
                            os.path.join(REPO, "tools", "fleet_bench.py")]),
+        # disaggregated fleet (serving/fleet/disagg/): role-split vs
+        # homogeneous interactive TTFT p99 at equal chips on a bimodal
+        # trace, KV-migration token-parity, preemption-resume prefill
+        # skip, and the chaos kill mid-migration — all rc-gated
+        ("serving_disagg", [sys.executable,
+                            os.path.join(REPO, "tools", "fleet_bench.py"),
+                            "--disagg"]),
         # multi-tenant serving (tenancy/ subsystem): >= 8 LoRA adapters
         # co-batched at near-baseline inter-token p99 (rc-gated)
         ("serving_lora", [sys.executable,
