@@ -283,6 +283,39 @@ class FleetHealth:
             "replica_down", False, key=str(replica_id), severity="page",
             now=now, replica_id=replica_id)
 
+    def replica_retired(self, replica_id: int, cause: str = "",
+                        now: Optional[float] = None, *,
+                        severity: str = "page") -> None:
+        """A replica left rotation PERMANENTLY — crash budget spent, or a
+        deliberate scale-in drain (pass ``severity="warn"``: nothing
+        crashed, nobody should be paged).  Resolves the stale
+        ``replica_down`` (the restart the pager was waiting on will never
+        come) and fires the terminal ``replica_retired`` edge in its
+        place, so autopilot and the pager can tell "warm restart coming"
+        from "needs replacement".  The condition stays firing until
+        :meth:`replica_replaced` reports a replacement joined."""
+        dead = self.replica_monitors.pop(replica_id, None)
+        if dead is not None:
+            self._retired_edges.extend(dead.edges)
+        self.fleet.set_condition(
+            "replica_down", False, key=str(replica_id), severity="page",
+            now=now, replica_id=replica_id)
+        self.fleet.set_condition(
+            "replica_retired", True, key=str(replica_id),
+            severity=severity, now=now, replica_id=replica_id, cause=cause)
+
+    def replica_replaced(self, replica_id: int, by: int,
+                         now: Optional[float] = None) -> None:
+        """Autoscale replaced a retired replica: resolve its terminal
+        ``replica_retired`` (and any stale ``replica_down``) with the
+        replacement's id on the edge."""
+        self.fleet.set_condition(
+            "replica_down", False, key=str(replica_id), severity="page",
+            now=now, replica_id=replica_id, replaced_by=by)
+        self.fleet.set_condition(
+            "replica_retired", False, key=str(replica_id), severity="page",
+            now=now, replica_id=replica_id, replaced_by=by)
+
     def step(self, router: Any, now: Optional[float] = None) -> None:
         """One fleet-iteration tick: every ``eval_every``-th call
         evaluates each live replica's monitor over its engine snapshot,

@@ -49,13 +49,20 @@ class MetricsServer:
     ``health_fn`` returns the liveness dict; ``monitor`` (an object with
     ``healthz()`` — a health monitor or fleet health) folds rule state
     into the same document, and the response is 503 unless BOTH agree ok.
-    ``port=0`` binds an ephemeral port (read :attr:`port` after
-    construction — the test harness pattern)."""
+    ``autopilot`` (an object with ``healthz_fields()`` — a fleet
+    :class:`~..serving.fleet.autopilot.Autopilot`) nests its controller
+    state under ``"autopilot"`` (mode, last action, actions-in-window vs
+    budget) — observability only, it never flips readiness: a paused or
+    budget-exhausted autopilot is an operator concern, not a reason to
+    pull the fleet out of the load balancer.  ``port=0`` binds an
+    ephemeral port (read :attr:`port` after construction — the test
+    harness pattern)."""
 
     def __init__(self, registry=None, *,
                  text_fn: Optional[Callable[[], str]] = None,
                  health_fn: Optional[Callable[[], dict]] = None,
                  monitor=None,
+                 autopilot=None,
                  scopes: Optional[Dict[str, Callable[[], str]]] = None,
                  port: int = 0, host: str = "0.0.0.0"):
         if registry is None and text_fn is None:
@@ -64,6 +71,7 @@ class MetricsServer:
                          else registry.prometheus_text)
         self._scopes = dict(scopes) if scopes else {}
         self._monitor = monitor
+        self._autopilot = autopilot
         self._health_fn = health_fn if health_fn is not None else (
             lambda: {"ok": True})
         outer = self
@@ -102,6 +110,9 @@ class MetricsServer:
                             doc = {**doc, **hz,
                                    "ok": bool(doc.get("ok", True))
                                    and bool(hz.get("ok", True))}
+                        if outer._autopilot is not None:
+                            doc["autopilot"] = \
+                                outer._autopilot.healthz_fields()
                     except Exception as e:
                         doc = {"ok": False, "error": str(e)}
                     code = 200 if doc.get("ok") else 503

@@ -59,10 +59,17 @@ from neuronx_distributed_tpu.obs.tracing import (
 # FLOP/s and bytes/s, compute-/memory-bound classification, MFU/MBU and
 # tokens/s-ceiling rollup; replica streams merge additively; null when
 # the run carried no perf profiler).
-OBS_REPORT_SCHEMA = "obs_report_v5"
+# v6 (fleet-autopilot PR): required "autopilot" section
+# (autopilot_actions.jsonl rollup — action table, per-action and
+# per-trigger counts, action rate over the covered mono span; null when
+# the run carried no autopilot), and --compare gates on run B's action
+# rate regressing past A's (a controller that has to act more often
+# under the same workload is flapping or fighting a real regression).
+OBS_REPORT_SCHEMA = "obs_report_v6"
 SUPERVISOR_EVENTS_FILE = "supervisor_events.jsonl"
 SERVING_STATS_FILE = "serving_stats.jsonl"
 ROUTER_STATS_FILE = "router_stats.jsonl"
+AUTOPILOT_ACTIONS_FILE = "autopilot_actions.jsonl"
 
 
 def _read_scalar_file(path: str) -> List[dict]:
@@ -458,19 +465,26 @@ def _summarize_memory(scalars: Dict[str, dict],
 def compare_resources(run_a: str, run_b: str,
                       compile_threshold: float = 0.0,
                       mem_threshold: float = 0.05,
-                      mfu_threshold: float = 0.05) -> dict:
-    """Run-to-run compile/memory/alert/perf regression diff
+                      mfu_threshold: float = 0.05,
+                      autopilot_threshold: float = 0.5) -> dict:
+    """Run-to-run compile/memory/alert/perf/autopilot regression diff
     (``tools/obs_report.py --compare RUN_A RUN_B``): reads each run dir's
     ``compile_ledger.jsonl``, ``memory_breakdown.json``,
-    ``*alerts.jsonl`` and ``*perf_attribution.jsonl`` and flags B against
-    A — more compiles than ``(1 + compile_threshold) * A`` (or any storm
-    in B), any subsystem's peak bytes past ``(1 + mem_threshold) * A``'s,
-    any alert RULE that fired in B without firing in A (a new alert under
-    the same workload is a health regression, threshold-free), or B's MFU
-    sagging below ``(1 - mfu_threshold) * A``'s (same workload, less of
-    the device's peak — the perf regression the roofline profiler exists
-    to catch).  Returns ``{"a", "b", "compile", "memory", "alerts",
-    "perf", "regressions", "regressed", "markdown"}``."""
+    ``*alerts.jsonl``, ``*perf_attribution.jsonl`` and
+    ``*autopilot_actions.jsonl`` and flags B against A — more compiles
+    than ``(1 + compile_threshold) * A`` (or any storm in B), any
+    subsystem's peak bytes past ``(1 + mem_threshold) * A``'s, any alert
+    RULE that fired in B without firing in A (a new alert under the same
+    workload is a health regression, threshold-free), B's MFU sagging
+    below ``(1 - mfu_threshold) * A``'s (same workload, less of the
+    device's peak — the perf regression the roofline profiler exists to
+    catch), or B's autopilot action rate past
+    ``(1 + autopilot_threshold) * A``'s (a controller that has to act
+    more often under the same workload is flapping, or fighting a real
+    regression upstream of it; actions appearing in B when A's autopilot
+    never acted regress threshold-free).  Returns ``{"a", "b",
+    "compile", "memory", "alerts", "perf", "autopilot", "regressions",
+    "regressed", "markdown"}``."""
     def load(run_dir):
         cl_path = os.path.join(run_dir, COMPILE_LEDGER_FILE)
         mb_path = os.path.join(run_dir, MEMORY_BREAKDOWN_FILE)
@@ -484,10 +498,12 @@ def compare_resources(run_a: str, run_b: str,
 
         perf = summarize_perf(merge_perf_files(sorted(
             glob.glob(os.path.join(run_dir, f"*{PERF_ATTRIBUTION_FILE}")))))
-        return compile_sum, breakdown, alerts, perf
+        autopilot = summarize_autopilot(sorted(glob.glob(
+            os.path.join(run_dir, f"*{AUTOPILOT_ACTIONS_FILE}"))))
+        return compile_sum, breakdown, alerts, perf, autopilot
 
-    ca, ma, aa, perf_a = load(run_a)
-    cb, mb, ab, perf_b = load(run_b)
+    ca, ma, aa, perf_a, ap_a = load(run_a)
+    cb, mb, ab, perf_b, ap_b = load(run_b)
     regressions: List[str] = []
     lines = ["# Resource regression diff", "",
              f"- A: `{run_a}`", f"- B: `{run_b}`", ""]
@@ -576,6 +592,38 @@ def compare_resources(run_a: str, run_b: str,
         regressions.append(
             f"mfu regressed: {ra['mfu']:.2%} -> {rb['mfu']:.2%} "
             f"(threshold {mfu_threshold:.0%})")
+
+    if ap_a is not None or ap_b is not None:
+        lines += ["## Autopilot (remediation actions)", "",
+                  "| metric | A | B |", "|---|---|---|"]
+        for key in ("actions", "span_s", "rate_per_s"):
+            va = ap_a.get(key) if ap_a else None
+            vb = ap_b.get(key) if ap_b else None
+            fmt = lambda v: "n/a" if v is None else (
+                f"{v:.4g}" if isinstance(v, float) else str(v))
+            lines.append(f"| {key} | {fmt(va)} | {fmt(vb)} |")
+        lines.append("")
+    if ap_a is not None and ap_b is not None:
+        na, nb = ap_a["actions"], ap_b["actions"]
+        rate_a, rate_b = ap_a["rate_per_s"], ap_b["rate_per_s"]
+        if na == 0 and nb > 0:
+            # A's autopilot watched the same workload and never had to
+            # act — any action in B is a regression, threshold-free
+            regressions.append(
+                f"autopilot regressed: {nb} action(s) in B, none in A")
+        elif rate_a is not None and rate_b is not None and \
+                rate_b > rate_a * (1.0 + autopilot_threshold):
+            regressions.append(
+                f"autopilot regressed: action rate {rate_a:.4g}/s -> "
+                f"{rate_b:.4g}/s (threshold {autopilot_threshold:.0%})")
+        elif (rate_a is None or rate_b is None) and na > 0 and \
+                nb > na * (1.0 + autopilot_threshold):
+            # too few actions on one side to form a rate — fall back to
+            # gating on the raw count
+            regressions.append(
+                f"autopilot regressed: {na} -> {nb} action(s) "
+                f"(threshold {autopilot_threshold:.0%})")
+
     if regressions:
         lines += ["## Regressions", ""] + [f"- {r}" for r in regressions] \
             + [""]
@@ -592,6 +640,7 @@ def compare_resources(run_a: str, run_b: str,
                                  "peak_total_bytes")}},
         "alerts": {"a": aa, "b": ab},
         "perf": {"a": ra, "b": rb},
+        "autopilot": {"a": ap_a, "b": ap_b},
         "regressions": regressions,
         "regressed": bool(regressions),
         "markdown": "\n".join(lines),
@@ -668,6 +717,69 @@ def _sev_rank(severity: str) -> int:
     from neuronx_distributed_tpu.obs.health import _SEV_ORDER
 
     return _SEV_ORDER.get(severity, 0)
+
+
+def summarize_autopilot(paths: Sequence[str],
+                        tail: int = 20) -> Optional[dict]:
+    """The "autopilot" section: roll every ``autopilot_actions.jsonl``
+    stream into per-action and per-trigger counts, the action rate over
+    the covered monotonic span, and the last ``tail`` actions as table
+    rows.  Returns None when no action files exist (the report key is
+    null, not {}) — an existing-but-quiet file reports zero actions (an
+    autopilot that never had to act is the healthy outcome, and distinct
+    from no autopilot at all)."""
+    records: List[dict] = []
+    files = 0
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        files += 1
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    records.append(json.loads(line))
+    if not files:
+        return None
+    records.sort(key=lambda r: r.get("mono", 0.0))
+    by_action: Dict[str, int] = {}
+    triggers: Dict[str, dict] = {}
+    modes: Dict[str, int] = {}
+    for r in records:
+        action = r.get("action", "?")
+        by_action[action] = by_action.get(action, 0) + 1
+        modes[r.get("mode", "?")] = modes.get(r.get("mode", "?"), 0) + 1
+        trig = triggers.setdefault(r.get("trigger", "?"), {
+            "actions": 0, "by_action": {}, "replicas": set()})
+        trig["actions"] += 1
+        trig["by_action"][action] = trig["by_action"].get(action, 0) + 1
+        rid = r.get("replica", -1)
+        if rid >= 0:
+            trig["replicas"].add(rid)
+    for trig in triggers.values():
+        trig["replicas"] = sorted(trig["replicas"])
+        trig["by_action"] = dict(sorted(trig["by_action"].items()))
+    span_s = (records[-1].get("mono", 0.0) - records[0].get("mono", 0.0)
+              if len(records) >= 2 else 0.0)
+    rate = (len(records) / span_s) if span_s > 0 else None
+    slim = [{"mono": r.get("mono", 0.0),
+             "action": r.get("action", "?"),
+             "trigger": r.get("trigger", "?"),
+             "replica": r.get("replica", -1),
+             "mode": r.get("mode", "?"),
+             "budget_remaining": r.get("budget_remaining", -1),
+             "detail": r.get("detail", {})} for r in records]
+    return {
+        "files": files,
+        "actions": len(records),
+        "by_action": dict(sorted(by_action.items())),
+        "triggers": dict(sorted(triggers.items())),
+        "modes": dict(sorted(modes.items())),
+        "span_s": round(span_s, 6),
+        "rate_per_s": rate,
+        "last": slim[-1] if slim else None,
+        "tail": slim[-tail:],
+    }
 
 
 def read_serving_stats(path: str) -> List[dict]:
@@ -833,6 +945,7 @@ def build_report(
     alerts_paths: Sequence[str] = (),
     router_stats_path: Optional[str] = None,
     perf_paths: Sequence[str] = (),
+    autopilot_paths: Sequence[str] = (),
     tail: int = 10,
 ) -> dict:
     """Merge the artifacts into one summary document.
@@ -852,6 +965,7 @@ def build_report(
     trace_paths = list(trace_paths)
     alerts_paths = list(alerts_paths)
     perf_paths = list(perf_paths)
+    autopilot_paths = list(autopilot_paths)
     serving_stats_paths = ([serving_stats_path]
                            if serving_stats_path else [])
     fleet_scalar_streams: List[List[dict]] = []
@@ -886,6 +1000,10 @@ def build_report(
         for q in sorted(glob.glob(os.path.join(run_dir, "*alerts.jsonl"))):
             if q not in alerts_paths:
                 alerts_paths.append(q)
+        for q in sorted(glob.glob(
+                os.path.join(run_dir, f"*{AUTOPILOT_ACTIONS_FILE}"))):
+            if q not in autopilot_paths:
+                autopilot_paths.append(q)
         p = os.path.join(run_dir, SCALARS_FILE)
         if os.path.exists(p) and p not in scalar_paths:
             scalar_paths.append(p)
@@ -973,6 +1091,7 @@ def build_report(
                          and os.path.exists(serving_stats_paths[0]) else [])
     trace = summarize_trace(trace_paths, stats_records)
     alerts_section = summarize_alerts(alerts_paths)
+    autopilot_section = summarize_autopilot(autopilot_paths)
     if router_stats_path:
         from neuronx_distributed_tpu.obs.aggregate import (
             summarize_router_stats,
@@ -1015,6 +1134,7 @@ def build_report(
             "alerts": alerts_paths,
             "router_stats": router_stats_path,
             "perf": perf_paths,
+            "autopilot": autopilot_paths,
             "fleet_replicas": fleet_replicas,
         },
         "scalars": scalars,
@@ -1028,6 +1148,7 @@ def build_report(
         "compile": compile_section,
         "memory": memory_section,
         "alerts": alerts_section,
+        "autopilot": autopilot_section,
         "perf": perf_section,
         "health": {
             "anomaly_count": len(anomalies),
@@ -1054,6 +1175,13 @@ def build_report(
                 "rules_fired": sum(
                     1 for agg in alerts_section["rules"].values()
                     if agg["fired"])}),
+            # slim autopilot rollup — the full action table lives once,
+            # at the top-level "autopilot" section
+            "autopilot": (None if autopilot_section is None else {
+                "actions": autopilot_section["actions"],
+                "rate_per_s": autopilot_section["rate_per_s"],
+                "last_action": (autopilot_section["last"]["action"]
+                                if autopilot_section["last"] else None)}),
             # slim perf rollup — the full per-family roofline table lives
             # once, at the top-level "perf" section
             "perf": (None if perf_section is None
@@ -1085,6 +1213,16 @@ def render_markdown(report: dict) -> str:
             f"- alerts: **{alerts['firing']} firing** (worst severity "
             f"{worst}); {fired} firing edge(s) across "
             f"{len(alerts['rules'])} rule(s)")
+    ap = report.get("autopilot")
+    if ap:
+        rate = (f"{ap['rate_per_s'] * 60.0:.2f}/min"
+                if ap["rate_per_s"] is not None else "n/a")
+        last = (f"; last `{ap['last']['action']}` on "
+                f"`{ap['last']['trigger']}`" if ap["last"] else "")
+        lines.append(
+            f"- autopilot: **{ap['actions']} action(s)** across "
+            f"{len(ap['triggers'])} trigger(s) "
+            f"(rate {rate} over {ap['span_s']:.1f}s){last}")
     lines.append(f"- anomalies: **{h['anomaly_count']}**")
     lines.append(f"- supervisor restarts: **{h.get('restarts', 0)}**")
     lines.append(f"- collectives across audited programs: "
@@ -1267,6 +1405,30 @@ def render_markdown(report: dict) -> str:
                 f"{agg['resolved']} | {agg['firing']} | "
                 f"{agg['time_firing_s']:.3f} |")
         lines.append("")
+
+    ap = report.get("autopilot")
+    if ap and ap["actions"]:
+        lines += ["## Autopilot actions", "",
+                  "| mono | action | trigger | replica | mode | "
+                  "budget left |",
+                  "|---|---|---|---|---|---|"]
+        for r in ap["tail"]:
+            lines.append(
+                f"| {r['mono']:.3f} | {r['action']} | {r['trigger']} | "
+                f"{r['replica'] if r['replica'] >= 0 else '-'} | "
+                f"{r['mode']} | {r['budget_remaining']} |")
+        lines += ["", "Per-trigger rollup:", "",
+                  "| trigger | actions | by action | replicas |",
+                  "|---|---|---|---|"]
+        for name, trig in ap["triggers"].items():
+            by = ", ".join(f"{k} {v}" for k, v in trig["by_action"].items())
+            reps = ",".join(str(r) for r in trig["replicas"]) or "-"
+            lines.append(
+                f"| {name} | {trig['actions']} | {by} | {reps} |")
+        lines.append("")
+    elif ap:
+        lines += ["## Autopilot actions", "",
+                  "Autopilot was on and never had to act.", ""]
 
     if report["anomalies"]:
         lines += ["## Anomalies", ""]

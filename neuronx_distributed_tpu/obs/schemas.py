@@ -144,6 +144,24 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "observed": (int, float, type(None)),
         "bound": (int, float, type(None)), "replica": int,
     },
+    # one line of autopilot_actions.jsonl (serving.fleet.autopilot
+    # .Autopilot) — one record per remediation ACTION the controller took
+    # (evaluations that act on nothing emit nothing).  action is the kind
+    # ("scale_out" | "scale_in" | "restart" | "tighten" | "relax" |
+    # "rebalance"), trigger the alert rule (or synthetic trigger: "idle",
+    # "queue_mix", "burn_resolved") that drove it, edge the triggering
+    # alert's firing view (null for synthetic triggers), replica the
+    # acted-on replica (-1 for fleet-wide actions like admission
+    # tightening), mode the controller mode at emission ("auto" always,
+    # today — page_only emits nothing), detail free-form action payload
+    # (new fleet size, shed scale, target role, ...), budget_remaining
+    # the global action budget left in the rolling window AFTER this
+    # action — the flap-bound audit trail.
+    "autopilot_action": {
+        "schema": str, "time": _NUM, "mono": _NUM, "action": str,
+        "trigger": str, "mode": str, "replica": int, "detail": dict,
+        "edge": (dict, type(None)), "budget_remaining": int,
+    },
     # memory_breakdown.json (obs.memory_ledger.MemoryLedger.dump) — the
     # per-subsystem device-byte breakdown, dumped on demand and on
     # RESOURCE_EXHAUSTED (reason "oom:<ExcType>"); "top" names the biggest
@@ -180,7 +198,10 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     # per-rule edge counts and time-firing; null when the run carried no
     # health monitor); v5 (perf attribution PR) adds the required "perf"
     # section (perf_attribution.jsonl rollup: per-family roofline table +
-    # MFU/tokens-ceiling rollup; null when the run carried no perf layer)
+    # MFU/tokens-ceiling rollup; null when the run carried no perf layer);
+    # v6 (autopilot PR) adds the required "autopilot" section
+    # (autopilot_actions.jsonl rollup: action table, per-trigger/per-kind
+    # counts, action rate; null when the run carried no autopilot)
     "obs_report": {
         "schema": str, "generated_at": _NUM, "scalars": dict,
         "histograms": dict, "flight": (dict, type(None)),
@@ -188,6 +209,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "supervisor": (dict, type(None)), "trace": (dict, type(None)),
         "compile": (dict, type(None)), "memory": (dict, type(None)),
         "alerts": (dict, type(None)), "perf": (dict, type(None)),
+        "autopilot": (dict, type(None)),
     },
 }
 
@@ -283,6 +305,10 @@ REGISTRY_METRICS: Dict[str, str] = {
     "router/failovers_total": "counter",
     "router/restarts_total": "counter",
     "router/retired_total": "counter",
+    # graceful drains initiated (autopilot PR): scale-in, proactive
+    # restart rotation and role rebalances all begin with a drain — the
+    # requeue-free path, unlike failovers above
+    "router/drains_total": "counter",
     "router/affinity_hits_total": "counter",
     "router/affinity_misses_total": "counter",
     # disagg (serving.fleet.disagg.DisaggRouter): KV-page migration hops
@@ -334,6 +360,18 @@ REGISTRY_METRICS: Dict[str, str] = {
     # external pager scrapes alongside /healthz
     "obs/alerts_firing": "gauge",
     "obs/alerts_total": "counter",
+    # fleet autopilot (serving.fleet.autopilot.Autopilot): remediation
+    # actions by kind (drains counts every drain-initiating action —
+    # scale-in, proactive restart, rebalance), plus the mode gauge
+    # (1 = auto, 0 = page_only — the kill-switch position, scrapeable)
+    "autopilot/actions_total": "counter",
+    "autopilot/scale_outs_total": "counter",
+    "autopilot/scale_ins_total": "counter",
+    "autopilot/drains_total": "counter",
+    "autopilot/restarts_total": "counter",
+    "autopilot/admission_tightenings_total": "counter",
+    "autopilot/rebalances_total": "counter",
+    "autopilot/mode": "gauge",
     # perf attribution (obs.perf.PerfAttribution): per-family device
     # wall-time histograms on the hot path, the milli-scaled rollup gauges
     # (mfu_milli = MFU fraction x 1e3 — gauge floats, and the health

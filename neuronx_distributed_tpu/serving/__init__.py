@@ -89,8 +89,10 @@ from neuronx_distributed_tpu.serving.scheduler import (
     DEFAULT_MAX_BATCH_WAIT_S,
     AdmissionError,
     BackpressureError,
+    RateLimited,
     SLOInfeasible,
     SlotScheduler,
+    TokenBucket,
 )
 
 __all__ = [
@@ -108,8 +110,10 @@ __all__ = [
     "SamplingParams",
     "AdmissionError",
     "BackpressureError",
+    "RateLimited",
     "SLOInfeasible",
     "DEFAULT_MAX_BATCH_WAIT_S",
     "SlotScheduler",
+    "TokenBucket",
     "replay_trace",
 ]

@@ -19,7 +19,13 @@ the admission layer over the pool:
 - :mod:`.disagg` — :class:`DisaggRouter`: prefill/decode replica roles,
   post-prefill KV-page migration over ``kvcache.transfer``, and a
   fleet-global prefix directory so a popular prompt is prefilled once
-  fleet-wide.
+  fleet-wide;
+- :mod:`.autopilot` — :class:`Autopilot`: alert-driven remediation over
+  ``FleetHealth`` + the router — autoscale (scale out on sustained burn,
+  graceful drain/scale in on idle), proactive drain-and-restart,
+  burn-driven admission tightening and role rebalancing, every action a
+  schema-checked ``autopilot_actions.jsonl`` record, flap-bounded by
+  hysteresis + cooldowns + a global action budget.
 
 Drive a fleet exactly like an engine: it has ``submit`` / ``step`` /
 ``has_work``, so :func:`~..serving.driver.replay` (and everything built on
@@ -27,6 +33,11 @@ it — ``serve_bench``, ``fleet_bench``, ``runner.py serve --replicas N``)
 takes either.
 """
 
+from neuronx_distributed_tpu.serving.fleet.autopilot import (
+    AUTOPILOT_ACTION_SCHEMA,
+    Autopilot,
+    AutopilotConfig,
+)
 from neuronx_distributed_tpu.serving.fleet.disagg import (
     ROLE_DECODE,
     ROLE_MIXED,
@@ -58,6 +69,9 @@ from neuronx_distributed_tpu.serving.fleet.routing import (
 )
 
 __all__ = [
+    "Autopilot",
+    "AutopilotConfig",
+    "AUTOPILOT_ACTION_SCHEMA",
     "DisaggRouter",
     "FleetPrefixDirectory",
     "FleetRouter",

@@ -117,6 +117,20 @@ class DisaggRouter(FleetRouter):
         return {rid: getattr(r, "role", ROLE_MIXED)
                 for rid, r in self.replicas.items()}
 
+    def add_replica(self, replica: Replica) -> None:
+        """Autoscale join with the disagg extras: the role label must be
+        valid, and the new index (empty) enters the fleet prefix
+        directory so fills can credit it immediately."""
+        validate_role(getattr(replica, "role", ROLE_MIXED))
+        super().add_replica(replica)
+        self.directory.resync(replica.replica_id,
+                              replica.prefix_fingerprints())
+
+    def _forget_replica(self, rid: int) -> None:
+        """A retired or rebuilt replica's pool (and index) is gone: drop
+        every directory claim it held."""
+        self.directory.forget_replica(rid)
+
     # -- fleet loop hooks --------------------------------------------------
 
     def step(self):
@@ -214,8 +228,11 @@ class DisaggRouter(FleetRouter):
         sources = [rid for rid, r in self.replicas.items()
                    if r.alive and getattr(r, "role", ROLE_MIXED)
                    == ROLE_PREFILL]
+        # destinations must be dispatchable: migrating INTO a draining
+        # replica would refill the very work the drain is waiting out
         dests = [rid for rid, r in self.replicas.items()
-                 if r.alive and getattr(r, "role", ROLE_MIXED)
+                 if self._dispatchable(rid)
+                 and getattr(r, "role", ROLE_MIXED)
                  in (ROLE_DECODE, ROLE_MIXED)]
         if not sources or not dests:
             return

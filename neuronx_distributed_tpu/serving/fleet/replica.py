@@ -240,6 +240,64 @@ class Replica:
                 self.backoff.max_restarts, delay)
         return delay
 
+    def retire(self, cause: str = "drained") -> None:
+        """Take a LIVE replica permanently out of rotation WITHOUT
+        consuming restart budget — the graceful scale-in path (autopilot
+        drain).  Unlike :meth:`mark_dead`, nothing crashed: the router has
+        already drained every in-flight request, so closing the engine
+        releases its pool with zero work lost."""
+        if self.state is not ReplicaState.LIVE:
+            raise ValueError(
+                f"replica {self.replica_id} is {self.state.value}; only a "
+                "live replica can be retired gracefully")
+        self.last_cause = cause
+        if self.engine is not None:
+            close = getattr(self.engine, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # teardown must not mask the retirement
+                    pass
+            self.engine = None
+        self.state = ReplicaState.RETIRED
+        self._restart_at = None
+        logger.info("fleet: replica %d retired gracefully (cause %s)",
+                    self.replica_id, cause)
+
+    def rebuild(self) -> bool:
+        """Tear down and rebuild the engine of a LIVE, drained replica
+        WITHOUT a crash or a budget tick — the autopilot's proactive
+        drain-and-restart rotation (a deliberate warm restart: clears
+        compiled-fn churn and pool fragmentation the way PR-7's crash
+        restart does, minus the crash).  Returns True on re-entry; a
+        factory failure counts as a crash (the replica goes DEAD on the
+        normal backoff schedule)."""
+        if self.state is not ReplicaState.LIVE:
+            raise ValueError(
+                f"replica {self.replica_id} is {self.state.value}; only a "
+                "live replica can be rebuilt proactively")
+        old = self.engine
+        self.engine = None
+        if old is not None:
+            close = getattr(old, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+        try:
+            self.engine = self._factory()
+        except Exception as e:
+            logger.error("fleet: replica %d proactive rebuild failed: %s",
+                         self.replica_id, e)
+            # treat like a crash: budget tick + backoff (or retirement)
+            self.state = ReplicaState.LIVE  # mark_dead expects a live engine
+            self.mark_dead(f"rebuild_failed:{type(e).__name__}")
+            return False
+        logger.info("fleet: replica %d rebuilt proactively (warm, empty "
+                    "caches)", self.replica_id)
+        return True
+
     def try_restart(self, now: Optional[float] = None) -> bool:
         """Rebuild a DEAD replica once its backoff expires; returns True on
         re-entry into rotation.  A factory failure counts as another crash

@@ -207,11 +207,18 @@ class FleetRouter:
         desc = replicas[0].describe()
         self._ctx = desc["context_len"]
         self._page = desc["page_size"]
+        self._desc = desc  # reference envelope for autoscale add_replica
         self._check_envelopes(replicas, desc)
+        # graceful drains in progress: rid -> completion plan.  A draining
+        # replica stays LIVE and keeps stepping (in-flight work finishes in
+        # place — zero requeues, zero re-prefills) but takes no NEW
+        # dispatches; once empty, the plan runs (retire / warm rebuild /
+        # re-role).
+        self._draining: Dict[int, dict] = {}
 
         reg = self.registry
         for c in ("dispatched", "requeued", "failovers", "restarts",
-                  "retired", "affinity_hits", "affinity_misses"):
+                  "retired", "drains", "affinity_hits", "affinity_misses"):
             reg.counter(f"router/{c}_total")
         for g in ("replicas_alive", "queue_depth", "inflight",
                   "affinity_hit_rate", "fleet_prefix_hit_rate"):
@@ -307,6 +314,134 @@ class FleetRouter:
         return bool(self._pending) or bool(self._emit_next) or any(
             r.has_work for r in self.replicas.values())
 
+    # -- graceful drain / autoscale (the autopilot surface) ----------------
+
+    def _dispatchable(self, rid: int) -> bool:
+        """Whether a replica may take NEW work: alive and not draining.
+        Draining replicas keep stepping their in-flight requests — they
+        just stop accumulating more."""
+        return self.replicas[rid].alive and rid not in self._draining
+
+    def draining(self) -> Dict[int, str]:
+        """Live view of drains in progress: rid -> completion plan name."""
+        return {rid: plan["then"] for rid, plan in self._draining.items()}
+
+    def drain(self, replica_id: int, *, then: str = "retire",
+              role: Optional[str] = None, cause: str = "") -> None:
+        """Gracefully drain one replica: stop dispatching new work to it,
+        let every in-flight request finish IN PLACE (this is NOT the
+        crash-failover path — nothing is requeued, nothing re-prefills),
+        then run the completion plan:
+
+        - ``then="retire"``: scale-in — retire the replica WITHOUT spending
+          restart budget and release its pool (refused when it is the last
+          dispatchable replica: that would be deliberate capacity suicide).
+        - ``then="restart"``: proactive warm rotation — rebuild the engine
+          (clears compiled-fn churn / pool fragmentation) and rejoin.
+        - ``then="re_role"``: disaggregation rebalance — flip the steering
+          ``role`` (requires ``role=``) and rejoin with pages intact.
+        """
+        if then not in ("retire", "restart", "re_role"):
+            raise ValueError(f"unknown drain plan {then!r}")
+        if then == "re_role" and role is None:
+            raise ValueError("drain(then='re_role') requires role=")
+        replica = self.replicas.get(replica_id)
+        if replica is None:
+            raise ValueError(f"unknown replica {replica_id}")
+        if not replica.alive:
+            raise ValueError(
+                f"replica {replica_id} is {replica.state.value}; only a "
+                "live replica can be drained")
+        if replica_id in self._draining:
+            raise ValueError(f"replica {replica_id} is already draining")
+        if then == "retire" and not any(
+                self._dispatchable(rid) for rid in self.replicas
+                if rid != replica_id):
+            raise ValueError(
+                f"refusing to drain-retire replica {replica_id}: it is the "
+                "last dispatchable replica (scale-in below one is capacity "
+                "suicide)")
+        self._draining[replica_id] = {
+            "then": then, "role": role, "cause": cause or then,
+            "since": self._clock()}
+        self.registry.counter("router/drains_total").inc()
+        if self.tracer is not None:
+            self.tracer.instant("route/drain", request_id=-1,
+                                replica=replica_id, plan=then)
+        logger.info("fleet: draining replica %d (plan %s%s)", replica_id,
+                    then, f" -> {role}" if role else "")
+
+    def add_replica(self, replica: Replica) -> None:
+        """Admit a NEW replica into rotation (autoscale scale-out).  The
+        envelope must pass the same homogeneity check construction applies
+        (the disaggregated router's override relaxes capacity per role,
+        never geometry) — a replica that can't serve what its siblings
+        admitted is refused before it can strand a failover requeue."""
+        rid = replica.replica_id
+        if rid in self.replicas:
+            raise ValueError(f"replica id {rid} already in the fleet")
+        if not replica.alive:
+            raise ValueError(
+                f"replica {rid} is {replica.state.value}; only a live "
+                "replica can join the fleet")
+        anchor = next(iter(self.replicas.values()))
+        self._check_envelopes([anchor, replica], self._desc)
+        self.replicas[rid] = replica
+        shadow = ReplicaShadow()
+        shadow.resync(replica.prefix_fingerprints())
+        self.shadows[rid] = shadow
+        self._export_gauges(full=True)
+        logger.info("fleet: replica %d joined rotation (role %s)", rid,
+                    getattr(replica, "role", "mixed"))
+
+    def _forget_replica(self, rid: int) -> None:
+        """Hook for subclass state keyed by replica id (the disagg router
+        forgets the replica's fleet-prefix-directory claims)."""
+
+    def _complete_drains(self, now: float) -> List[RequestOutput]:
+        """Run the completion plan of every draining replica that emptied
+        out this step.  Returns synthetic outputs (none today; the list
+        keeps the call shape uniform with the failover paths)."""
+        for rid in [r for r in self._draining if not self.replicas[r].has_work]:
+            plan = self._draining.pop(rid)
+            replica = self.replicas[rid]
+            if not replica.alive:
+                continue  # crashed while draining: failover already took over
+            then = plan["then"]
+            if then == "retire":
+                replica.retire(plan["cause"])
+                self.registry.counter("router/retired_total").inc()
+                self.shadows[rid].clear()
+                self._forget_replica(rid)
+                if self._health is not None:
+                    # deliberate scale-in: terminal replica_retired edge at
+                    # WARN (nothing crashed; nobody should be paged)
+                    self._health.replica_retired(
+                        rid, plan["cause"], now, severity="warn")
+            elif then == "restart":
+                self._forget_replica(rid)
+                if replica.rebuild():
+                    self.registry.counter("router/restarts_total").inc()
+                    self.shadows[rid].resync(replica.prefix_fingerprints())
+                else:
+                    # the factory raised: the rebuild consumed a crash-budget
+                    # tick inside Replica.rebuild -> mark_dead, so surface it
+                    # exactly like a crash death
+                    self.shadows[rid].clear()
+                    if self._health is not None:
+                        self._health.replica_down(
+                            rid, replica.last_cause or "rebuild_failed", now)
+                    if replica.state is ReplicaState.RETIRED:
+                        self.registry.counter("router/retired_total").inc()
+                        if self._health is not None:
+                            self._health.replica_retired(
+                                rid, replica.last_cause or "rebuild_failed",
+                                now)
+            else:  # re_role
+                replica.role = plan["role"]
+            self._export_gauges(full=True)
+        return []
+
     @property
     def inflight(self) -> int:
         """Accepted requests without a terminal output yet (O(1): the
@@ -343,6 +478,12 @@ class FleetRouter:
                 # DEAD -> RETIRED happened inside try_restart, so count it
                 # here — _failover only sees crash-time retirements
                 self.registry.counter("router/retired_total").inc()
+                if self._health is not None:
+                    # terminal edge: "needs replacement", not "warm restart
+                    # coming" — and the stale replica_down stops paging
+                    self._health.replica_retired(
+                        replica.replica_id,
+                        replica.last_cause or "restart_budget_spent", now)
 
         self._drain_pending()
 
@@ -361,6 +502,9 @@ class FleetRouter:
                 if rec is not None and not rec.done:
                     self._finish(rec, out)
                 outputs.append(out)
+
+        if self._draining:
+            self._complete_drains(now)
 
         if all(r.state is ReplicaState.RETIRED
                for r in self.replicas.values()):
@@ -538,7 +682,10 @@ class FleetRouter:
         dspan = (tr.begin("route/dispatch", request_id=rec.global_id,
                           hop=rec.requeues)
                  if tr is not None else None)
-        candidates = [rid for rid, r in self.replicas.items() if r.alive]
+        # (inlined _dispatchable: the dispatch hot path must not pay a
+        # bound-method allocation per replica when nothing is draining)
+        candidates = [rid for rid, r in self.replicas.items()
+                      if r.alive and rid not in self._draining]
         if not candidates:
             if dspan is not None:
                 tr.end(dspan, parked=True, replica=-1)
@@ -670,9 +817,17 @@ class FleetRouter:
             self._health.replica_down(replica.replica_id, cause, now)
         orphans = [rec for rec in self._tracked.values()
                    if not rec.done and rec.replica_id == replica.replica_id]
+        # a crash outranks a graceful drain in progress: the failover path
+        # (requeue + restart schedule) takes over and the plan is dropped
+        self._draining.pop(replica.replica_id, None)
         replica.mark_dead(f"step_crash:{type(exc).__name__}", now)
         if replica.state is ReplicaState.RETIRED:
             self.registry.counter("router/retired_total").inc()
+            if self._health is not None:
+                self._health.replica_retired(
+                    replica.replica_id,
+                    replica.last_cause or f"step_crash:{type(exc).__name__}",
+                    now)
         self.shadows[replica.replica_id].clear()
         requeued = 0
         for rec in orphans:

@@ -81,6 +81,43 @@ class SLOInfeasible(BackpressureError):
     "your deadline is already dead here"."""
 
 
+class RateLimited(BackpressureError):
+    """The submitting tenant is over its token-bucket rate limit —
+    transient like the parent (retry after the bucket refills) but
+    distinct, so clients can tell "engine overloaded" from "YOU are over
+    budget".  Installed dynamically (the autopilot tightens per-tenant
+    limits off the burn rate and relaxes them on resolve) rather than as
+    a static knob."""
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/second refill toward a
+    ``burst`` ceiling; :meth:`consume` takes tokens or answers no.  Time
+    is caller-supplied (monotonic seconds), so the scheduler's injectable
+    clock keeps it deterministic under test."""
+
+    __slots__ = ("rate", "burst", "tokens", "_last")
+
+    def __init__(self, rate: float, burst: float):
+        if rate <= 0 or burst <= 0:
+            raise ValueError(
+                f"rate and burst must be > 0, got rate={rate} burst={burst}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)  # starts full: a quiet tenant owes nothing
+        self._last: Optional[float] = None
+
+    def consume(self, n: float, now: float) -> bool:
+        if self._last is not None and now > self._last:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
 class SlotScheduler:
     """Fixed-``B`` slot table + per-priority-class EDF queues.
 
@@ -169,6 +206,56 @@ class SlotScheduler:
         # not None`, so the off path allocates nothing.
         self.tracer = tracer
         self._qspans: Dict[int, object] = {}  # rid -> open queue/park span
+        # dynamic admission (autopilot surface; both allocation-free when
+        # untouched): a multiplier on the feasibility estimate — >1 sheds
+        # earlier under burn, 1.0 is the static behavior exactly — and
+        # per-tenant token buckets keyed by adapter_id (None = no limits;
+        # a default template mints a bucket lazily per tenant seen)
+        self.load_shed_scale = 1.0
+        self._tenant_buckets: Dict[int, TokenBucket] = {}
+        self._tenant_default: Optional[Tuple[float, float]] = None
+
+    # -- dynamic admission (autopilot knobs) -------------------------------
+
+    def set_load_shed_scale(self, scale: float) -> None:
+        """Scale the deadline-feasibility estimate (``shed_infeasible``
+        mode): ``scale > 1`` sheds earlier — the dynamic load-shed the
+        autopilot drives off the burn rate instead of a static margin.
+        ``1.0`` restores the exact static behavior."""
+        if not (scale >= 1.0):
+            raise ValueError(f"load_shed_scale must be >= 1.0, got {scale}")
+        self.load_shed_scale = float(scale)
+
+    def set_tenant_limit(self, adapter_id: int, rate: float,
+                         burst: float) -> None:
+        """Install (or retune) one tenant's token-bucket rate limit
+        (requests/second, burst ceiling).  Retuning preserves the bucket's
+        current fill so a tightening never hands out a fresh burst."""
+        bucket = self._tenant_buckets.get(adapter_id)
+        if bucket is None:
+            self._tenant_buckets[adapter_id] = TokenBucket(rate, burst)
+        else:
+            bucket.rate = float(rate)
+            bucket.burst = float(burst)
+            bucket.tokens = min(bucket.tokens, bucket.burst)
+
+    def set_default_tenant_limit(self, rate: Optional[float],
+                                 burst: Optional[float] = None) -> None:
+        """Template applied lazily to every tenant without an explicit
+        bucket (the autopilot's fleet-wide tightening).  ``None`` clears
+        the template; existing buckets are untouched."""
+        if rate is None:
+            self._tenant_default = None
+        else:
+            self._tenant_default = (float(rate),
+                                    float(burst if burst is not None
+                                          else rate))
+
+    def clear_tenant_limits(self) -> None:
+        """Drop every per-tenant bucket and the default template — the
+        autopilot's relax-on-resolve path."""
+        self._tenant_buckets.clear()
+        self._tenant_default = None
 
     # -- introspection -----------------------------------------------------
 
@@ -331,6 +418,17 @@ class SlotScheduler:
                 raise AdmissionError(
                     f"request {request.request_id}: needs {need} KV pages "
                     f"> pool capacity {cap}; it can never be admitted")
+        if self._tenant_buckets or self._tenant_default is not None:
+            tenant = getattr(request, "adapter_id", 0)
+            bucket = self._tenant_buckets.get(tenant)
+            if bucket is None and self._tenant_default is not None:
+                bucket = self._tenant_buckets[tenant] = TokenBucket(
+                    *self._tenant_default)
+            if bucket is not None and not bucket.consume(1.0, now):
+                raise RateLimited(
+                    f"request {request.request_id}: tenant {tenant} over "
+                    f"its rate limit ({bucket.rate:.3g}/s, burst "
+                    f"{bucket.burst:.3g}); retry after the bucket refills")
         if self.shed_infeasible and request.deadline_s is not None:
             # a requeued clone may arrive with its ORIGINAL submit_time (the
             # fleet's absolute-deadline discipline): feasibility judges the
@@ -339,7 +437,7 @@ class SlotScheduler:
                       if request.submit_time is not None else now)
             remaining = request.deadline_s - max(now - submit, 0.0)
             est = ((self._wait_ewma[request.priority] or 0.0)
-                   + (self._ttft_ewma or 0.0))
+                   + (self._ttft_ewma or 0.0)) * self.load_shed_scale
             if remaining <= 0 or (est > 0 and remaining < est):
                 raise SLOInfeasible(
                     f"request {request.request_id}: deadline budget "

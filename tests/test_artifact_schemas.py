@@ -33,7 +33,7 @@ def test_schema_list_is_complete():
             "serving_stats", "supervisor_event",
             "router_stats", "trace_event",
             "compile_ledger", "memory_breakdown", "alert",
-            "perf_attribution"} <= set(SCHEMAS)
+            "perf_attribution", "autopilot_action"} <= set(SCHEMAS)
 
 
 def test_committed_tpu_watch_results_validate():
@@ -558,3 +558,76 @@ def test_trace_events_schema(tmp_path):
         validate_record("trace_event", bad)
     with pytest.raises(ValueError, match="expected"):
         validate_record("trace_event", dict(recs[0], attrs=None))
+
+
+def test_autopilot_action_schema_report_and_compare_gate(tmp_path):
+    """autopilot_actions.jsonl smoke: the controller's live emitter path
+    is covered in tests/test_autopilot.py; here the checked-in schema,
+    the autopilot/* registry declarations, the report's autopilot
+    section, and the --compare action-rate regression gate are pinned
+    from hand-built artifacts."""
+    from neuronx_distributed_tpu.obs.schemas import REGISTRY_METRICS
+
+    assert "autopilot_action" in SCHEMAS
+    assert {"autopilot/actions_total", "autopilot/scale_outs_total",
+            "autopilot/scale_ins_total", "autopilot/drains_total",
+            "autopilot/restarts_total",
+            "autopilot/admission_tightenings_total",
+            "autopilot/rebalances_total",
+            "autopilot/mode"} <= set(REGISTRY_METRICS)
+
+    def rec(mono, action, trigger, replica=-1):
+        return {"schema": "autopilot_action/1", "time": 100.0 + mono,
+                "mono": mono, "action": action, "trigger": trigger,
+                "mode": "auto", "replica": replica, "detail": {},
+                "edge": None, "budget_remaining": 7}
+
+    a_dir = tmp_path / "a"
+    b_dir = tmp_path / "b"
+    for d in (a_dir, b_dir):
+        d.mkdir()
+        (d / "autopilot_actions.jsonl").write_text("")
+    rows = [rec(0.0, "scale_out", "slo_burn_fast_interactive", replica=2),
+            rec(5.0, "tighten", "slo_burn_fast_interactive"),
+            rec(60.0, "relax", "burn_resolved")]
+    path = str(b_dir / "autopilot_actions.jsonl")
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert validate_jsonl("autopilot_action", path) == 3
+    with pytest.raises(ValueError, match="missing required field"):
+        bad = dict(rows[0])
+        del bad["budget_remaining"]
+        validate_record("autopilot_action", bad)
+    with pytest.raises(ValueError, match="expected"):
+        validate_record("autopilot_action", dict(rows[0], detail=None))
+
+    from neuronx_distributed_tpu.obs.report import (
+        build_report,
+        compare_resources,
+        render_markdown,
+    )
+
+    report = build_report(run_dir=str(b_dir))
+    validate_record("obs_report", report)
+    ap = report["autopilot"]
+    assert ap["actions"] == 3
+    assert ap["by_action"] == {"scale_out": 1, "tighten": 1, "relax": 1}
+    assert ap["triggers"]["slo_burn_fast_interactive"]["actions"] == 2
+    assert ap["span_s"] == 60.0 and ap["rate_per_s"] == pytest.approx(0.05)
+    assert report["health"]["autopilot"]["actions"] == 3
+    md = render_markdown(report)
+    assert "## Autopilot actions" in md and "- autopilot:" in md
+
+    # an autopilot that never acted still reports (empty ledger != off)
+    quiet = build_report(run_dir=str(a_dir))
+    validate_record("obs_report", quiet)
+    assert quiet["autopilot"]["actions"] == 0
+    assert "never had to act" in render_markdown(quiet)
+
+    # compare gate: actions in B when A's controller never acted is a
+    # threshold-free regression; a run against itself is clean
+    diff = compare_resources(str(a_dir), str(b_dir))
+    assert diff["regressed"]
+    assert any("autopilot" in r for r in diff["regressions"])
+    assert not compare_resources(str(b_dir), str(b_dir))["regressed"]

@@ -1,0 +1,710 @@
+"""Fleet autopilot: alert-driven remediation (PR 18).
+
+Covers the controller end to end with injected clocks and the fleet
+fakes from ``test_fleet``:
+
+- flap bounds: the action-rate budget provably caps a flapping trigger
+  (vs a naive degenerate config that acts every flap), per-kind
+  cooldowns, fire/resolve hysteresis;
+- graceful drain semantics: scale-in / drain-restart finish in-flight
+  work IN PLACE (zero requeues, zero re-prefills — this is NOT the
+  crash-failover path) and emit the warn-severity ``replica_retired``
+  edge instead of a page;
+- scale-out: replica-factory spawn through ``add_replica``'s envelope
+  homogeneity check, stale retired-replica alerts resolved as
+  "replaced by", envelope mismatch degrading to admission tightening;
+- dynamic admission: load-shed scale + per-tenant token buckets
+  tightened on burn and relaxed stepwise on resolve;
+- the kill-switch (``page_only``) landing within one evaluation cadence
+  and un-shedding on the way out;
+- the allocation-free-when-off discipline (``ACTIONS_EVALUATED``);
+- the schema-checked ``autopilot_actions.jsonl`` audit ledger.
+"""
+
+import json
+
+import pytest
+
+from neuronx_distributed_tpu.obs.aggregate import FleetHealth
+from neuronx_distributed_tpu.obs.schemas import validate_jsonl, validate_record
+from neuronx_distributed_tpu.serving.fleet import (
+    AUTOPILOT_ACTION_SCHEMA,
+    Autopilot,
+    AutopilotConfig,
+    FleetRouter,
+    Replica,
+    ReplicaState,
+)
+from neuronx_distributed_tpu.serving.fleet import autopilot as autopilot_mod
+from neuronx_distributed_tpu.serving.scheduler import (
+    BackpressureError,
+    RateLimited,
+    SlotScheduler,
+    TokenBucket,
+)
+
+from test_fleet import _FakeEngine, _req
+
+pytestmark = pytest.mark.autopilot
+
+
+# -- fakes -------------------------------------------------------------------
+
+class _FakeSched:
+    """The autopilot-facing slice of SlotScheduler: dynamic-admission
+    knobs + per-class queue depths (settable, for the rebalance tests)."""
+
+    def __init__(self):
+        self.load_shed_scale = 1.0
+        self.default_limit = None
+        self.cleared = 0
+        self.qi = 0
+        self.qb = 0
+
+    def set_load_shed_scale(self, scale):
+        self.load_shed_scale = scale
+
+    def set_default_tenant_limit(self, rate, burst=None):
+        self.default_limit = (rate, burst)
+
+    def clear_tenant_limits(self):
+        self.cleared += 1
+        self.default_limit = None
+
+    def queue_depth_of(self, priority):
+        return self.qi if priority == "interactive" else self.qb
+
+    @property
+    def queue_depth(self):
+        return self.qi + self.qb
+
+    @property
+    def active_count(self):
+        return 0
+
+
+class _SchedEngine(_FakeEngine):
+    def __init__(self, work=2, capacity=None):
+        super().__init__(work=work, capacity=capacity)
+        self.scheduler = _FakeSched()
+
+
+class _FakeHealth:
+    """Scriptable alert source: `rules` is whatever firing() should
+    claim; replica lifecycle hooks record their calls."""
+
+    def __init__(self):
+        self.rules = []
+        self.replaced = []
+        self.retired = []
+        self.downs = []
+
+    def attach_router(self, router):
+        pass
+
+    def firing(self):
+        return list(self.rules)
+
+    def note_output(self, out, now=None):
+        pass
+
+    def step(self, router, now=None):
+        pass
+
+    def replica_down(self, rid, cause="", now=None):
+        self.downs.append((rid, cause))
+
+    def replica_up(self, rid, now=None):
+        pass
+
+    def replica_retired(self, rid, cause="", now=None, severity="page"):
+        self.retired.append((rid, cause, severity))
+
+    def replica_replaced(self, old, by, now=None):
+        self.replaced.append((old, by))
+
+
+def _edge(rule="slo_burn_fast_interactive", **kw):
+    base = {"rule": rule, "key": "", "severity": "page", "window": 300.0,
+            "observed": 20.0, "bound": 14.4, "since": 0.0}
+    base.update(kw)
+    return base
+
+
+def _fleet(n=2, factory=_SchedEngine, **kw):
+    return FleetRouter([Replica(i, factory, backoff_base_s=0.0)
+                        for i in range(n)], policy="round_robin", **kw)
+
+
+def _pilot(router=None, health=None, *, t=None, **cfg_kw):
+    """Autopilot over a fake-engine fleet with an injected clock list
+    ``t`` (advance with ``t[0] += ...``); eval_every=1 so every step()
+    is an evaluation."""
+    t = [0.0] if t is None else t
+    router = router if router is not None else _fleet()
+    health = health if health is not None else _FakeHealth()
+    cfg_kw.setdefault("eval_every", 1)
+    cfg_kw.setdefault("fire_after", 1)
+    cfg_kw.setdefault("resolve_after", 1)
+    # fake fleets sit idle: keep the scale-in trigger out of tests that
+    # are not about it (they opt back in with an explicit idle_after)
+    cfg_kw.setdefault("idle_after", 10 ** 6)
+    ap = Autopilot(router, health, config=AutopilotConfig(**cfg_kw),
+                   clock=lambda: t[0], wall=lambda: t[0])
+    return ap, router, health, t
+
+
+# -- config / construction ---------------------------------------------------
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="mode"):
+        AutopilotConfig(mode="yolo")
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutopilotConfig(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutopilotConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="action_budget"):
+        AutopilotConfig(action_budget=0)
+    with pytest.raises(ValueError, match="shed_scale_step"):
+        AutopilotConfig(shed_scale_step=1.0)
+
+
+def test_registry_metrics_predeclared():
+    ap, router, _, _ = _pilot()
+    snap = router.registry.snapshot()
+    for name in ("autopilot/actions_total", "autopilot/scale_outs_total",
+                 "autopilot/drains_total", "autopilot/restarts_total"):
+        assert name in snap
+    assert snap["autopilot/mode"] == 1.0
+
+
+# -- flap bounds -------------------------------------------------------------
+
+def _run_flap(evals, **cfg_kw):
+    """Drive a burn alert that flaps every evaluation (on, off, on, ...)
+    at 1 Hz; returns (autopilot, emitted action records)."""
+    ap, router, health, t = _pilot(**cfg_kw)
+    emitted = []
+    for i in range(evals):
+        health.rules = [_edge()] if i % 2 == 0 else []
+        emitted += ap.step(now=t[0])
+        t[0] += 1.0
+    return ap, emitted
+
+
+def test_flapping_trigger_is_budget_bounded_vs_naive():
+    """The acceptance bar: under an adversarial flapping alert the
+    bounded controller emits at most `action_budget` actions (and counts
+    what it suppressed), while a degenerate no-cooldown/huge-budget
+    config acts on every flap."""
+    zero_cd = {k: 0.0 for k in autopilot_mod.DEFAULT_COOLDOWNS_S}
+    naive, naive_actions = _run_flap(
+        60, cooldown_s=dict(zero_cd), action_budget=10 ** 6,
+        budget_window_s=10 ** 6)
+    bounded, bounded_actions = _run_flap(
+        60, cooldown_s=dict(zero_cd), action_budget=4,
+        budget_window_s=10 ** 6)
+    # naive flaps right along with the trigger: tighten/relax every eval
+    assert len(naive_actions) >= 30
+    assert naive.suppressed == 0
+    # bounded: the global budget is the provable cap, and the denial is
+    # visible (suppressed), not silent
+    assert len(bounded_actions) == 4
+    assert bounded.suppressed > 0
+    assert bounded.budget_remaining(59.0) == 0
+    assert len(naive_actions) > len(bounded_actions)
+
+
+def test_budget_is_a_rolling_window():
+    """budget_window_s=10, budget=2: over a 60 s flap no 10 s span of
+    the ledger holds more than 2 actions — and the budget refills as the
+    window slides (more than 2 actions total)."""
+    zero_cd = {k: 0.0 for k in autopilot_mod.DEFAULT_COOLDOWNS_S}
+    _, actions = _run_flap(60, cooldown_s=dict(zero_cd), action_budget=2,
+                           budget_window_s=10.0)
+    times = [a["mono"] for a in actions]
+    assert len(times) > 2  # refilled after the window slid
+    for i, t0 in enumerate(times):
+        in_window = [x for x in times[i:] if x - t0 <= 10.0]
+        assert len(in_window) <= 2, f"budget violated in window at {t0}"
+
+
+def test_per_kind_cooldown_spaces_repeat_actions():
+    """A constantly-firing burn re-tightens only once per cooldown."""
+    ap, router, health, t = _pilot(
+        cooldown_s={"tighten": 10.0, "relax": 10.0},
+        shed_scale_max=1024.0, action_budget=10 ** 6)
+    health.rules = [_edge()]
+    actions = []
+    for _ in range(21):  # t = 0..20 at 1 Hz
+        actions += ap.step(now=t[0])
+        t[0] += 1.0
+    assert [a["action"] for a in actions] == ["tighten"] * 3  # t=0,10,20
+    assert [a["mono"] for a in actions] == [0.0, 10.0, 20.0]
+
+
+def test_hysteresis_fire_after_consecutive_evaluations():
+    """fire_after=3: two evaluations of burn do nothing; the third acts.
+    A gap resets the streak."""
+    ap, router, health, t = _pilot(fire_after=3)
+    health.rules = [_edge()]
+    assert ap.step(now=t[0]) == []
+    t[0] += 1.0
+    assert ap.step(now=t[0]) == []
+    health.rules = []  # blip clears -> streak resets
+    t[0] += 1.0
+    assert ap.step(now=t[0]) == []
+    health.rules = [_edge()]
+    for _ in range(2):
+        t[0] += 1.0
+        assert ap.step(now=t[0]) == []
+    t[0] += 1.0
+    acted = ap.step(now=t[0])
+    assert [a["action"] for a in acted] == ["tighten"]
+
+
+# -- graceful drain vs crash failover ----------------------------------------
+
+def test_graceful_drain_finishes_in_place_zero_requeues(tmp_path):
+    """drain(then='retire'): in-flight work finishes ON the draining
+    replica (zero requeues, zero re-prefills), new work routes around
+    it, and retirement emits a WARN replica_retired edge — the opposite
+    of the crash-failover path on every axis."""
+    health = FleetHealth(path=str(tmp_path / "alerts.jsonl"), rules=[],
+                         replica_rules=lambda: [], eval_every=1)
+    router = _fleet(n=2, factory=lambda: _SchedEngine(work=3),
+                    health=health)
+    gids = [router.submit(_req(i)) for i in range(4)]  # 2 per replica
+    router.step()  # dispatch
+    placed_on_0 = {g for g in gids if router._tracked[g].replica_id == 0}
+    assert placed_on_0  # round-robin put work on the victim
+    router.drain(0, then="retire", cause="test-scale-in")
+    assert router.draining() == {0: "retire"}
+    # new work routes around the draining replica
+    extra = [router.submit(_req(100 + i)) for i in range(2)]
+    outs = router.run_until_complete(max_steps=50)
+    assert len(outs) == 6
+    assert all(o.state == "finished" for o in outs)
+    assert all(router._tracked[g].replica_id == 1 for g in extra)
+    # NOT the failover path: nothing was requeued or re-dispatched
+    assert router.registry.counter("router/requeued_total").value == 0
+    assert all(router._tracked[g].requeues == 0 for g in gids)
+    assert router.registry.counter("router/drains_total").value == 1
+    assert router.registry.counter("router/retired_total").value == 1
+    assert router.replicas[0].state is ReplicaState.RETIRED
+    # deliberate scale-in pages nobody: warn-severity terminal edge
+    edges = [e for e in health.edges() if e["rule"] == "replica_retired"]
+    assert len(edges) == 1 and edges[0]["severity"] == "warn"
+    assert edges[0]["state"] == "firing"
+    router.close()
+    health.close()
+
+
+def test_crash_failover_requeues_for_contrast():
+    """The same shape through mark-dead failover DOES requeue — the
+    semantic the drain tests distinguish against."""
+    router = _fleet(n=2, factory=lambda: _FakeEngine(work=3))
+    for i in range(4):
+        router.submit(_req(i))
+    router.step()
+    router.replicas[0].engine.crash_next = True
+    router.replicas[0].backoff = type(router.replicas[0].backoff)(
+        max_restarts=0)  # no budget: crash -> permanent failover
+    outs = router.run_until_complete(max_steps=80)
+    assert len(outs) == 4
+    assert router.registry.counter("router/requeued_total").value > 0
+    router.close()
+
+
+def test_drain_validation_errors():
+    router = _fleet(n=2)
+    with pytest.raises(ValueError, match="unknown drain plan"):
+        router.drain(0, then="explode")
+    with pytest.raises(ValueError, match="requires role="):
+        router.drain(0, then="re_role")
+    with pytest.raises(ValueError, match="unknown replica"):
+        router.drain(99)
+    router.drain(0, then="retire")
+    with pytest.raises(ValueError, match="already draining"):
+        router.drain(0, then="restart")
+    with pytest.raises(ValueError, match="last dispatchable"):
+        router.drain(1, then="retire")  # capacity suicide refused
+    router.step()  # completes replica 0's drain (no work) -> retired
+    with pytest.raises(ValueError, match="only a live replica"):
+        router.drain(0, then="restart")
+    router.close()
+
+
+def test_add_replica_validation():
+    router = _fleet(n=1)
+    with pytest.raises(ValueError, match="already in the fleet"):
+        router.add_replica(Replica(0, _SchedEngine, backoff_base_s=0.0))
+
+    class WideEngine(_SchedEngine):
+        C = 16
+
+    with pytest.raises(ValueError, match="heterogeneous"):
+        router.add_replica(Replica(7, WideEngine, backoff_base_s=0.0))
+    assert sorted(router.replicas) == [0]
+    router.close()
+
+
+# -- autopilot scale-in / restart / scale-out --------------------------------
+
+def test_scale_in_on_sustained_idle_respects_min_replicas():
+    ap, router, health, t = _pilot(
+        router=_fleet(n=3), idle_after=3, min_replicas=2)
+    actions = []
+    for _ in range(10):
+        actions += ap.step(now=t[0])
+        router.step()  # completes the drain (fleet is idle)
+        t[0] += 1.0
+    assert [a["action"] for a in actions] == ["scale_in"]
+    assert actions[0]["trigger"] == "idle"
+    retired = [r for r in router.replicas.values()
+               if r.state is ReplicaState.RETIRED]
+    assert len(retired) == 1  # stopped at min_replicas, despite idling on
+    assert router.registry.counter("autopilot/scale_ins_total").value == 1
+    assert router.registry.counter("autopilot/drains_total").value == 1
+
+
+def test_busy_fleet_is_never_idle():
+    """util counts in-system requests over slots; a loaded fleet never
+    trips the idle trigger even with a tiny idle_after."""
+    ap, router, health, t = _pilot(router=_fleet(n=2), idle_after=1,
+                                   min_replicas=1)
+    for i in range(6):  # 6 in-flight over 2 slots -> util 3.0
+        router.submit(_req(i))
+    router.step()
+    assert ap.step(now=t[0]) == []
+    assert len([r for r in router.replicas.values() if r.alive]) == 2
+
+
+def test_drain_restart_rotates_alerted_replica():
+    """A per-replica kv_headroom edge held for fire_after evaluations
+    rotates THAT replica through a warm drain-rebuild; the engine object
+    is replaced, the replica stays LIVE, no restart budget is spent."""
+    ap, router, health, t = _pilot(fire_after=2)
+    old_engine = router.replicas[1].engine
+    budget_before = router.replicas[1].backoff.restarts
+    health.rules = [_edge(rule="kv_headroom", replica=1)]
+    assert ap.step(now=t[0]) == []  # hysteresis: first evaluation holds
+    t[0] += 1.0
+    actions = ap.step(now=t[0])
+    assert [a["action"] for a in actions] == ["restart"]
+    assert actions[0]["replica"] == 1
+    assert actions[0]["edge"]["rule"] == "kv_headroom"
+    assert router.draining() == {1: "restart"}
+    router.step()  # idle -> drain completes -> rebuild
+    assert router.replicas[1].alive
+    assert router.replicas[1].engine is not old_engine
+    assert router.replicas[1].backoff.restarts == budget_before
+    assert router.registry.counter("router/restarts_total").value == 1
+
+
+def test_drain_restart_refuses_last_dispatchable_replica():
+    ap, router, health, t = _pilot(router=_fleet(n=1), fire_after=1)
+    health.rules = [_edge(rule="compile_storm")]
+    assert ap.step(now=t[0]) == []
+    assert router.draining() == {}
+
+
+def test_scale_out_on_burn_prefers_capacity_over_shedding():
+    factory = lambda rid: Replica(rid, _SchedEngine, backoff_base_s=0.0)
+    ap, router, health, t = _pilot(fire_after=2, max_replicas=3)
+    ap.replica_factory = factory
+    health.rules = [_edge()]
+    ap.step(now=t[0])
+    t[0] += 1.0
+    actions = ap.step(now=t[0])
+    assert [a["action"] for a in actions] == ["scale_out"]
+    assert actions[0]["replica"] == 2
+    assert sorted(router.replicas) == [0, 1, 2]
+    assert actions[0]["detail"]["fleet_size"] == 3
+    assert ap.shed_scale == 1.0  # capacity added; no shedding needed yet
+    # at max_replicas the next sustained burn tightens instead
+    t[0] += 100.0
+    ap.step(now=t[0])
+    t[0] += 1.0
+    actions = ap.step(now=t[0])
+    assert [a["action"] for a in actions] == ["tighten"]
+    assert ap.shed_scale == 2.0
+
+
+def test_scale_out_resolves_stale_retired_alerts_as_replaced():
+    factory = lambda rid: Replica(rid, _SchedEngine, backoff_base_s=0.0)
+    ap, router, health, t = _pilot(fire_after=1, max_replicas=4)
+    ap.replica_factory = factory
+    router.drain(0, then="retire")
+    router.step()  # replica 0 retires
+    assert router.replicas[0].state is ReplicaState.RETIRED
+    health.rules = [_edge()]
+    actions = ap.step(now=t[0])
+    assert [a["action"] for a in actions] == ["scale_out"]
+    assert actions[0]["detail"]["replaces"] == [0]
+    assert health.replaced == [(0, 2)]
+
+
+def test_scale_out_envelope_mismatch_degrades_to_tighten():
+    """A factory minting an incompatible envelope is refused by
+    add_replica; the controller falls back to admission tightening and
+    the broken factory sits out its cooldown instead of being hammered."""
+
+    class WideEngine(_SchedEngine):
+        C = 16
+
+    ap, router, health, t = _pilot(fire_after=1, max_replicas=8)
+    ap.replica_factory = lambda rid: Replica(rid, WideEngine,
+                                             backoff_base_s=0.0)
+    health.rules = [_edge()]
+    actions = ap.step(now=t[0])
+    assert [a["action"] for a in actions] == ["tighten"]
+    assert sorted(router.replicas) == [0, 1]  # nothing joined
+    assert ap.shed_scale == 2.0
+    # scale_out cooldown was stamped by the failure: the immediate next
+    # burn evaluation does not retry the broken factory
+    t[0] += 1.0
+    assert all(a["action"] != "scale_out" for a in ap.step(now=t[0]))
+
+
+# -- dynamic admission -------------------------------------------------------
+
+def test_tighten_and_relax_drive_schedulers_and_tenant_limits():
+    ap, router, health, t = _pilot(fire_after=2, resolve_after=2,
+                                   tenant_rate=8.0, tenant_burst=4.0)
+    scheds = [r.engine.scheduler for r in router.replicas.values()]
+    health.rules = [_edge()]
+    ap.step(now=t[0])
+    t[0] += 1.0
+    actions = ap.step(now=t[0])
+    assert [a["action"] for a in actions] == ["tighten"]
+    assert actions[0]["detail"] == {"shed_scale": 2.0, "tenant_rate": 4.0}
+    assert all(s.load_shed_scale == 2.0 for s in scheds)
+    assert all(s.default_limit == (4.0, 4.0) for s in scheds)
+    # resolve: burn clear for resolve_after evaluations -> stepwise relax
+    health.rules = []
+    t[0] += 100.0
+    assert ap.step(now=t[0]) == []  # hysteresis on the way down too
+    t[0] += 1.0
+    actions = ap.step(now=t[0])
+    assert [a["action"] for a in actions] == ["relax"]
+    assert ap.shed_scale == 1.0
+    assert all(s.load_shed_scale == 1.0 for s in scheds)
+    assert all(s.default_limit is None and s.cleared == 1 for s in scheds)
+
+
+def test_tightening_reasserted_on_rebuilt_engines():
+    """An engine rebuilt mid-incident starts at the static knobs; the
+    controller re-pushes the current tightening every evaluation."""
+    ap, router, health, t = _pilot(fire_after=1)
+    health.rules = [_edge()]
+    ap.step(now=t[0])
+    assert ap.shed_scale == 2.0
+    router.drain(1, then="restart")
+    router.step()  # rebuild -> fresh scheduler at 1.0
+    fresh = router.replicas[1].engine.scheduler
+    assert fresh.load_shed_scale == 1.0
+    t[0] += 1.0
+    ap.step(now=t[0])
+    assert fresh.load_shed_scale == 2.0
+
+
+def test_token_bucket_refill_and_clamp():
+    b = TokenBucket(rate=2.0, burst=4.0)
+    assert b.tokens == 4.0  # starts full
+    assert all(b.consume(1.0, now=0.0) for _ in range(4))
+    assert not b.consume(1.0, now=0.0)  # empty
+    assert b.consume(1.0, now=0.5)      # 0.5 s * 2/s = 1 token back
+    assert not b.consume(1.0, now=0.5)
+    assert b.consume(1.0, now=100.0)    # refill clamps at burst
+    assert b.tokens == 3.0
+    with pytest.raises(ValueError):
+        TokenBucket(rate=0.0, burst=4.0)
+
+
+def test_scheduler_tenant_rate_limit_raises_rate_limited():
+    sched = SlotScheduler(num_slots=2, context_len=8, max_total_len=16)
+    sched.set_default_tenant_limit(1.0, 2.0)
+    sched.submit(_req(1), now=0.0)
+    sched.submit(_req(2), now=0.0)
+    with pytest.raises(RateLimited):
+        sched.submit(_req(3), now=0.0)  # burst of 2 spent
+    assert isinstance(RateLimited("x"), BackpressureError)  # retryable
+    sched.submit(_req(4), now=1.0)  # refilled
+    # retune preserves fill: no fresh burst is handed out
+    sched.set_tenant_limit(0, rate=1.0, burst=10.0)
+    with pytest.raises(RateLimited):
+        sched.submit(_req(5), now=1.0)
+    sched.clear_tenant_limits()
+    sched.submit(_req(6), now=1.0)
+
+
+def test_load_shed_scale_validation():
+    sched = SlotScheduler(num_slots=1, context_len=8, max_total_len=16)
+    with pytest.raises(ValueError, match="load_shed_scale"):
+        sched.set_load_shed_scale(0.5)
+    sched.set_load_shed_scale(4.0)
+    assert sched.load_shed_scale == 4.0
+
+
+# -- role rebalance ----------------------------------------------------------
+
+class _RolesRouter(FleetRouter):
+    """Minimal disagg surface: per-replica steering roles (the real
+    DisaggRouter adds placement/migration on top; the autopilot only
+    needs roles() + drain(then='re_role'))."""
+
+    def roles(self):
+        return {rid: r.role for rid, r in self.replicas.items()}
+
+
+def test_rebalance_re_roles_on_queue_mix_drift():
+    replicas = [Replica(i, _SchedEngine, backoff_base_s=0.0,
+                        role=("prefill" if i == 0 else "decode"))
+                for i in range(4)]
+    router = _RolesRouter(replicas, policy="round_robin")
+    ap, router, health, t = _pilot(router=router, fire_after=2,
+                                   rebalance_min_queued=8)
+    # interactive backlog far outweighs the 1/4 prefill share
+    router.replicas[0].engine.scheduler.qi = 9
+    router.replicas[0].engine.scheduler.qb = 1
+    assert ap.step(now=t[0]) == []  # hysteresis
+    t[0] += 1.0
+    actions = ap.step(now=t[0])
+    assert [a["action"] for a in actions] == ["rebalance"]
+    assert actions[0]["trigger"] == "queue_mix"
+    assert actions[0]["detail"]["to_role"] == "prefill"
+    rid = actions[0]["replica"]
+    assert router.replicas[rid].role == "decode"  # donor
+    router.step()  # drain completes (idle) -> re-role
+    assert router.replicas[rid].role == "prefill"
+    assert router.registry.counter("autopilot/rebalances_total").value == 1
+
+
+def test_rebalance_needs_backlog_and_a_donor_pair():
+    replicas = [Replica(i, _SchedEngine, backoff_base_s=0.0,
+                        role=("prefill" if i == 0 else "decode"))
+                for i in range(2)]
+    ap, router, health, t = _pilot(
+        router=_RolesRouter(replicas, policy="round_robin"), fire_after=1,
+        rebalance_min_queued=8)
+    # backlog too small to trust -> no action
+    router.replicas[0].engine.scheduler.qi = 3
+    assert ap.step(now=t[0]) == []
+    # drifted, but the donor role has only one member -> refused
+    router.replicas[0].engine.scheduler.qi = 20
+    t[0] += 1.0
+    assert ap.step(now=t[0]) == []
+    assert router.draining() == {}
+
+
+def test_plain_router_has_no_rebalance_surface():
+    ap, router, health, t = _pilot(fire_after=1, rebalance_min_queued=0)
+    assert ap._queue_mix_drift() is None  # FleetRouter: no roles()
+
+
+# -- kill-switch / off-path discipline ---------------------------------------
+
+def test_kill_switch_lands_within_one_cadence_and_unsheds():
+    ap, router, health, t = _pilot(fire_after=1)
+    scheds = [r.engine.scheduler for r in router.replicas.values()]
+    health.rules = [_edge()]
+    ap.step(now=t[0])
+    assert ap.shed_scale == 2.0
+    ap.set_mode("page_only")
+    # a disabled controller must not leave the fleet shedding
+    assert ap.shed_scale == 1.0
+    assert all(s.load_shed_scale == 1.0 for s in scheds)
+    assert router.registry.gauge("autopilot/mode").value == 0.0
+    before = autopilot_mod.ACTIONS_EVALUATED
+    t[0] += 100.0
+    assert ap.step(now=t[0]) == []  # burn still firing; pager-only now
+    assert autopilot_mod.ACTIONS_EVALUATED == before + 1  # still ticking
+    assert ap.healthz_fields()["mode"] == "page_only"
+    ap.set_mode("auto")
+    t[0] += 1.0
+    assert [a["action"] for a in ap.step(now=t[0])] == ["tighten"]
+    with pytest.raises(ValueError, match="mode"):
+        ap.set_mode("off")
+
+
+def test_cadence_skips_evaluate_nothing():
+    ap, router, health, t = _pilot(eval_every=4, fire_after=1)
+    health.rules = [_edge()]
+    assert [ap.step(now=float(i)) for i in range(3)] == [[], [], []]
+    assert ap._streaks == {}  # cadence skips never touched the triggers
+    actions = ap.step(now=3.0)  # 4th tick evaluates
+    assert [a["action"] for a in actions] == ["tighten"]
+
+
+def test_healthz_fields_shape():
+    ap, router, health, t = _pilot(fire_after=1, action_budget=8)
+    doc = ap.healthz_fields()
+    assert doc == {"mode": "auto", "shed_scale": 1.0, "last_action": None,
+                   "actions_in_window": 0, "action_budget": 8,
+                   "budget_remaining": 8, "suppressed": 0}
+    health.rules = [_edge()]
+    ap.step(now=t[0])
+    doc = ap.healthz_fields()
+    assert doc["last_action"]["action"] == "tighten"
+    assert doc["last_action"]["trigger"] == "slo_burn_fast_interactive"
+    assert doc["budget_remaining"] == 7
+
+
+# -- audit ledger ------------------------------------------------------------
+
+def test_actions_ledger_schema_checked_and_complete(tmp_path):
+    path = str(tmp_path / "autopilot_actions.jsonl")
+    ap, router, health, t = _pilot(fire_after=1)
+    ap.sink = autopilot_mod._ActionSink(path)
+    # eager artifact: "took no actions" and "no autopilot" differ on disk
+    assert validate_jsonl("autopilot_action", path) == 0
+    health.rules = [_edge()]
+    ap.step(now=t[0])
+    health.rules = []
+    t[0] += 100.0
+    ap.step(now=t[0])
+    t[0] += 1.0
+    ap.step(now=t[0])
+    ap.close()
+    n = validate_jsonl("autopilot_action", path)
+    assert n == len(ap.actions) == 2  # tighten + relax, schema-clean
+    records = [json.loads(line) for line in open(path)]
+    assert [r["action"] for r in records] == ["tighten", "relax"]
+    assert all(r["schema"] == AUTOPILOT_ACTION_SCHEMA for r in records)
+    assert records[0]["edge"]["rule"] == "slo_burn_fast_interactive"
+    assert records[1]["edge"] is None  # synthetic trigger
+    for r in records:
+        validate_record("autopilot_action", r)
+
+
+def test_action_record_rejects_malformed(tmp_path):
+    good = {"schema": AUTOPILOT_ACTION_SCHEMA, "time": 1.0, "mono": 1.0,
+            "action": "tighten", "trigger": "slo_burn_fast_interactive",
+            "mode": "auto", "replica": -1, "detail": {}, "edge": None,
+            "budget_remaining": 7}
+    validate_record("autopilot_action", good)
+    missing = dict(good)
+    del missing["budget_remaining"]
+    with pytest.raises(ValueError, match="missing"):
+        validate_record("autopilot_action", missing)
+    wrong = dict(good, replica=True)  # bool is not an int here
+    with pytest.raises(ValueError):
+        validate_record("autopilot_action", wrong)
+
+
+# -- allocation-free when off ------------------------------------------------
+
+def test_autopilot_off_is_zero_evaluations():
+    """A fleet serving run with NO autopilot attached never touches the
+    controller: the module counter is exact (the ALERTS_EVALUATED /
+    SPANS_CREATED discipline), so 'off costs nothing' is checkable."""
+    before = autopilot_mod.ACTIONS_EVALUATED
+    router = _fleet(n=2, factory=lambda: _SchedEngine(work=2))
+    for i in range(6):
+        router.submit(_req(i))
+    outs = router.run_until_complete(max_steps=60)
+    assert len(outs) == 6
+    router.close()
+    assert autopilot_mod.ACTIONS_EVALUATED == before, (
+        "autopilot-off serving evaluated controller triggers")

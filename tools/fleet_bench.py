@@ -38,6 +38,17 @@ at the ``kvcache/page_import`` fault point mid-migration still yields
 exactly one finished, token-identical output per request with zero page
 leaks on either side.
 
+``--autopilot`` switches to the autopilot chaos rung (the
+``fleet_autopilot`` tpu_watch job): a deadline-blown load spike plus a
+mid-run replica kill into a 2-replica fleet running
+:class:`~...serving.fleet.autopilot.Autopilot`, absorbed with zero
+human input.  Gates, all required: the fast-window burn alert fires and
+autopilot scales OUT off it (the fleet demonstrably grew); the killed
+replica's ``replica_down`` fires AND resolves; every accepted request
+yields exactly one terminal output (ledger-checked); every action the
+controller took is a schema-valid ``autopilot_actions.jsonl`` record;
+and the post-spike recovery wave finishes to the last request.
+
 Run by ``tools/tpu_watch.py`` as the ``serving_fleet`` extra job;
 ``--tiny`` smoke-tests the harness on CPU (the same rungs, smaller model).
 """
@@ -333,6 +344,180 @@ def run_failover(args, model, vocab_size, engine_kw) -> dict:
     return rec
 
 
+# -- autopilot chaos rung -----------------------------------------------------
+
+def run_autopilot(args, model, vocab_size, engine_kw) -> dict:
+    """Load spike + mid-run replica kill, absorbed with zero human input:
+    the fleet starts at 2 replicas under an :class:`Autopilot`, a wave of
+    deadline-blown requests drives the fast-window burn alert (scale-out
+    must fire off it), the kill exercises replica_down fire→resolve under
+    the same controller, and a no-deadline recovery wave must finish."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.obs.aggregate import FleetHealth
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+    from neuronx_distributed_tpu.resilience.faults import clear_plan, install_plan
+    from neuronx_distributed_tpu.serving import (
+        BackpressureError,
+        FleetRouter,
+        Replica,
+        Request,
+        ServingEngine,
+    )
+    from neuronx_distributed_tpu.serving.fleet import Autopilot, AutopilotConfig
+
+    C = model.config.context_len
+    rs = np.random.RandomState(args.seed + 5)
+    out_dir = (args.actions_out or args.stats_dir
+               or tempfile.mkdtemp(prefix="fleet_bench_"))
+    os.makedirs(out_dir, exist_ok=True)
+    actions_path = os.path.join(out_dir, "autopilot_actions.jsonl")
+    stats_path = os.path.join(out_dir, "router_stats.jsonl")
+    alerts_path = os.path.join(out_dir, "autopilot.alerts.jsonl")
+    for p in (actions_path, stats_path, alerts_path):
+        if os.path.exists(p):
+            os.remove(p)
+
+    def engine_factory():
+        return ServingEngine(model, registry=MetricRegistry(), **engine_kw)
+
+    def replica_factory(rid):
+        return Replica(rid, engine_factory, backoff_base_s=0.01)
+
+    start_replicas = 2
+    install_plan({"faults": [{
+        "point": "fleet/replica_step", "action": "exception",
+        "match": {"replica": 0, "step": args.kill_step}, "count": 1,
+        "message": "fleet_bench: injected replica kill"}]})
+    health = FleetHealth(path=alerts_path, eval_every=1)
+    router = FleetRouter(
+        [replica_factory(i) for i in range(start_replicas)],
+        policy="round_robin", seed=args.seed, stats_path=stats_path,
+        health=health)
+    autopilot = Autopilot(
+        router, health, replica_factory=replica_factory,
+        actions_path=actions_path,
+        config=AutopilotConfig(
+            eval_every=1, fire_after=2, resolve_after=2,
+            min_replicas=1, max_replicas=start_replicas + 1,
+            # scale-in off for this rung: the spike's aftermath IS idle,
+            # and a tail drain would fold scale-in timing into the gates
+            idle_after=10**6,
+            cooldown_s={"scale_out": 2.0, "scale_in": 60.0,
+                        "restart": 10.0, "tighten": 0.5, "relax": 0.5,
+                        "rebalance": 60.0}))
+
+    outs, shed = {}, 0
+
+    def tick():
+        for o in router.step():
+            outs[router.client_id(o.request_id)] = o
+        autopilot.step()
+
+    def feed(reqs):
+        nonlocal shed
+        accepted = 0
+        for r in reqs:
+            try:
+                router.submit(r)
+            except BackpressureError:
+                shed += 1  # rejected at admission: no ledger entry
+            else:
+                accepted += 1
+        return accepted
+
+    L = max(C // 2, 1)
+    prompt = lambda: rs.randint(1, vocab_size, size=L).tolist()
+    cid = iter(range(10**6))
+    easy = lambda n: [Request(request_id=next(cid), prompt_ids=prompt(),
+                              max_new_tokens=args.max_new_tokens)
+                      for _ in range(n)]
+    # the spike: admissible (the feasibility estimate is cold) but
+    # unservable within deadline behind a 2-replica backlog — each
+    # timed-out terminal burns SLO budget and feeds the burn-rate rule
+    spike = [Request(request_id=next(cid), prompt_ids=prompt(),
+                     max_new_tokens=args.max_new_tokens, deadline_s=0.05)
+             for _ in range(max(12, args.num_requests))]
+
+    accepted = 0
+    try:
+        accepted += feed(easy(4))
+        for _ in range(3):       # the kill lands in this warm phase
+            tick()
+        accepted += feed(spike)
+        for _ in range(6):
+            tick()
+        n_recover = 6
+        recover = easy(n_recover)
+        recover_ids = [r.request_id for r in recover]
+        accepted += feed(recover)
+        for _ in range(20000):
+            tick()
+            if not router.has_work:
+                break
+        router.assert_invariants()
+        snap = router.registry.snapshot()
+        router.close()
+        autopilot.close()
+    finally:
+        clear_plan()
+    health.close()
+    edges = health.edges()
+
+    actions = list(autopilot.actions)
+    by_action = {}
+    for a in actions:
+        by_action[a["action"]] = by_action.get(a["action"], 0) + 1
+    n_stats = validate_jsonl("router_stats", stats_path)
+    n_ledger = validate_jsonl("autopilot_action", actions_path)
+    recovered = sum(1 for rid in recover_ids
+                    if rid in outs and outs[rid].state == "finished")
+    burn_fired = sum(1 for e in edges
+                     if e["rule"].startswith("slo_burn_fast")
+                     and e["state"] == "firing")
+    rec = {
+        "metric": "fleet_autopilot", "rung": "autopilot",
+        "accepted": accepted, "shed_at_admission": shed,
+        "outputs": len(outs),
+        "finished": sum(1 for o in outs.values()
+                        if o.state == "finished"),
+        "timed_out": sum(1 for o in outs.values()
+                         if o.state == "timed_out"),
+        "recovered": recovered, "recovery_wave": n_recover,
+        "fleet_size": len(router.replicas),
+        "actions": by_action, "actions_total": len(actions),
+        "actions_ledger": n_ledger,
+        "suppressed": autopilot.suppressed,
+        "scale_outs": snap.get("autopilot/scale_outs_total", 0.0),
+        "burn_fired": burn_fired,
+        "replica_down_fired": sum(1 for e in edges
+                                  if e["rule"] == "replica_down"
+                                  and e["state"] == "firing"),
+        "replica_down_resolved": sum(1 for e in edges
+                                     if e["rule"] == "replica_down"
+                                     and e["state"] == "resolved"),
+        "stats_records": n_stats,
+        "actions_path": os.path.abspath(actions_path),
+        "stats_path": os.path.abspath(stats_path),
+        "alerts_path": os.path.abspath(alerts_path),
+    }
+    rec["gates"] = {
+        "burn_fired": burn_fired >= 1,
+        "scale_out": (by_action.get("scale_out", 0) >= 1
+                      and rec["fleet_size"] > start_replicas),
+        "kill_absorbed": (rec["replica_down_fired"] >= 1
+                          and rec["replica_down_resolved"] >= 1),
+        # exactly one terminal output per ACCEPTED request, and the
+        # router_stats ledger agrees record-for-record
+        "exactly_once": (len(outs) == accepted and n_stats == accepted),
+        "actions_ledger": (n_ledger == len(actions) and n_ledger >= 1),
+        "recovered": recovered == n_recover,
+    }
+    rec["ok"] = all(rec["gates"].values())
+    return rec
+
+
 # -- disaggregated-fleet rung -------------------------------------------------
 
 def _build_disagg(model, n_replicas, seed, **engine_kw):
@@ -604,6 +789,18 @@ def main() -> int:
                         "homogeneous TTFT p99 at equal chips, migration "
                         "token-parity, preemption-resume prefill skip, "
                         "and the chaos kill mid-migration (all rc-gated)")
+    p.add_argument("--autopilot", action="store_true",
+                   help="run the autopilot chaos rung instead: load spike "
+                        "+ mid-run replica kill absorbed with zero human "
+                        "input — burn fires, scale-out lands, the killed "
+                        "replica's replica_down fires and resolves, every "
+                        "action is a schema-valid autopilot_actions.jsonl "
+                        "record, and the recovery wave finishes (rc-gated)")
+    p.add_argument("--actions-out", default=None,
+                   help="--autopilot: directory for the rung's "
+                        "autopilot_actions.jsonl / router_stats.jsonl / "
+                        "autopilot.alerts.jsonl (default: --stats-dir or "
+                        "a temp dir)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -688,6 +885,7 @@ def main() -> int:
                        "page_size": args.page_size}}
     rc = 0
     rungs = ((run_disagg,) if args.disagg
+             else (run_autopilot,) if args.autopilot
              else (run_scale, run_affinity, run_failover))
     for rung in rungs:
         rec = rung(args, model, cfg.vocab_size, engine_kw)

@@ -9,6 +9,9 @@ metrics server:
   ``obs.aggregate``);
 - **firing alerts** — every ``*alerts.jsonl`` edge stream folded into the
   currently-firing set (rule, severity, observed vs bound, time firing);
+- **autopilot actions** — the ``*autopilot_actions.jsonl`` ledger's
+  recent tail (action, trigger, replica, budget remaining) — what the
+  controller did about the alerts above, live;
 - **per-replica view** — one row per replica artifact dir: KV occupancy
   (pages in use / total), active slots, queue depth, tokens.
 
@@ -91,6 +94,20 @@ def _firing_alerts(run_dir: str) -> list:
     firing.sort(key=lambda r: (order.get(r.get("severity"), 3),
                                r.get("rule", "")))
     return firing
+
+
+def _recent_actions(run_dir: str, tail: int = 8) -> list:
+    """The newest ``tail`` autopilot actions across every
+    ``*autopilot_actions.jsonl`` (top level + one dir down), oldest
+    first — the pane answers "what has the controller DONE lately"."""
+    paths = sorted(
+        glob.glob(os.path.join(run_dir, "*autopilot_actions.jsonl"))
+        + glob.glob(os.path.join(run_dir, "*", "*autopilot_actions.jsonl")))
+    records = []
+    for p in paths:
+        records.extend(_read_jsonl(p))
+    records.sort(key=lambda r: r.get("mono", 0.0))
+    return records[-tail:]
 
 
 def _fmt(v, nd=0) -> str:
@@ -178,6 +195,29 @@ def render_run_dir(run_dir: str) -> str:
                 f"{_fmt(r.get('bound'), 3):>12}")
     else:
         lines.append("  (quiet)")
+
+    # -- autopilot actions: rendered whenever an action ledger exists
+    # (an empty ledger means the controller is attached and quiet)
+    actions = _recent_actions(run_dir)
+    have_ledger = bool(
+        glob.glob(os.path.join(run_dir, "*autopilot_actions.jsonl"))
+        + glob.glob(os.path.join(run_dir, "*", "*autopilot_actions.jsonl")))
+    if have_ledger:
+        mode = actions[-1].get("mode", "?") if actions else "?"
+        lines += ["", f"== autopilot (mode {mode}, "
+                  f"{len(actions)} recent action(s)) =="]
+        if actions:
+            lines.append(f"  {'action':<12} {'trigger':<26} {'replica':>7} "
+                         f"{'budget left':>11}")
+            for a in actions:
+                rid = a.get("replica", -1)
+                lines.append(
+                    f"  {a.get('action', '?'):<12} "
+                    f"{a.get('trigger', '?'):<26} "
+                    f"{rid if rid >= 0 else '-':>7} "
+                    f"{a.get('budget_remaining', '?'):>11}")
+        else:
+            lines.append("  (attached, no actions yet)")
 
     # -- per-replica occupancy
     if per_replica:

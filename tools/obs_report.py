@@ -38,9 +38,11 @@ in each dir): markdown table to stdout (or ``--markdown``), JSON via
 ``--out``, and a NONZERO exit code when run B regressed — more compiles
 than ``(1 + --compile-regress-threshold) * A``, new compile storms, any
 subsystem's peak bytes past ``(1 + --mem-regress-threshold) * A``'s, any
-alert rule firing in B that never fired in A, or B's perf-attribution
-rollup MFU sagging below ``(1 - --mfu-regress-threshold) * A``'s — so CI
-can gate on it.
+alert rule firing in B that never fired in A, B's perf-attribution
+rollup MFU sagging below ``(1 - --mfu-regress-threshold) * A``'s, or B's
+autopilot action rate past ``(1 + --autopilot-regress-threshold) * A``'s
+(a controller acting more often under the same workload is flapping or
+fighting a real regression) — so CI can gate on it.
 """
 
 from __future__ import annotations
@@ -103,6 +105,11 @@ def main(argv=None) -> int:
                    help="router_stats.jsonl path (auto-detected in "
                         "--run-dir) — rolls fleet terminal records into "
                         "the fleet section")
+    p.add_argument("--autopilot", action="append", default=[],
+                   help="autopilot_actions.jsonl file (repeatable; "
+                        "*autopilot_actions.jsonl auto-detected in "
+                        "--run-dir) — builds the autopilot section "
+                        "(action table, per-trigger rollup, action rate)")
     p.add_argument("--compare", nargs=2, metavar=("RUN_A", "RUN_B"),
                    default=None,
                    help="compile/memory regression diff between two run "
@@ -120,6 +127,12 @@ def main(argv=None) -> int:
                         "rollup MFU below A's before rc 1 (default 5%%; "
                         "only applies when both runs carry perf "
                         "attribution)")
+    p.add_argument("--autopilot-regress-threshold", type=float, default=0.5,
+                   help="--compare: allowed fractional growth in run B's "
+                        "autopilot action rate over A's before rc 1 "
+                        "(default 50%%; actions appearing in B when A "
+                        "never acted regress threshold-free; only applies "
+                        "when both runs carry autopilot action ledgers)")
     p.add_argument("--tail", type=int, default=10,
                    help="flight-record tail length in the summary")
     p.add_argument("--out", default=None, help="write JSON here (default stdout)")
@@ -133,11 +146,12 @@ def main(argv=None) -> int:
             args.compare[0], args.compare[1],
             compile_threshold=args.compile_regress_threshold,
             mem_threshold=args.mem_regress_threshold,
-            mfu_threshold=args.mfu_regress_threshold)
+            mfu_threshold=args.mfu_regress_threshold,
+            autopilot_threshold=args.autopilot_regress_threshold)
         if args.out:
             doc = {k: diff[k] for k in ("a", "b", "compile", "memory",
-                                        "alerts", "perf", "regressions",
-                                        "regressed")}
+                                        "alerts", "perf", "autopilot",
+                                        "regressions", "regressed")}
             with open(args.out, "w") as f:
                 f.write(json.dumps(doc, indent=2) + "\n")
         if args.markdown:
@@ -153,7 +167,8 @@ def main(argv=None) -> int:
     if not (args.run_dir or args.scalar_dir or args.scalars or args.flight
             or args.hlo_audit or args.timeline or args.supervisor_events
             or args.trace or args.compile_ledger or args.memory_breakdown
-            or args.alerts or args.perf or args.router_stats):
+            or args.alerts or args.perf or args.router_stats
+            or args.autopilot):
         p.error("nothing to report on: pass --run-dir or explicit artifact paths")
 
     from neuronx_distributed_tpu.obs.report import build_report, render_markdown
@@ -181,6 +196,7 @@ def main(argv=None) -> int:
         alerts_paths=args.alerts,
         router_stats_path=args.router_stats,
         perf_paths=args.perf,
+        autopilot_paths=args.autopilot,
         tail=args.tail,
     )
     validate_record("obs_report", report)  # the emitter honors its own schema

@@ -229,6 +229,42 @@ def _validate_perf_dir(perf_dir: str) -> tuple:
     return True, counts
 
 
+def _validate_autopilot_dir(actions_dir: str) -> tuple:
+    """Post-hook for the fleet_autopilot job: the rung's
+    ``autopilot_actions.jsonl`` must exist and validate against the
+    checked-in ``autopilot_action`` schema with at least one action (the
+    chaos rung's spike + kill MUST have made the controller act — an
+    empty ledger means the loop never closed), and the rung's
+    ``autopilot.alerts.jsonl`` must be schema-valid alongside it.
+    Returns ``(ok, detail)``."""
+    import glob
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    actions = sorted(glob.glob(
+        os.path.join(actions_dir, "*autopilot_actions.jsonl")))
+    if not actions:
+        return False, f"no autopilot_actions artifacts in {actions_dir}"
+    counts = {}
+    for f in actions:
+        try:
+            n = validate_jsonl("autopilot_action", f)
+        except ValueError as e:
+            return False, f"{os.path.basename(f)}: {e}"
+        if n == 0:
+            return False, (f"{os.path.basename(f)}: empty action ledger "
+                           f"(the chaos rung must make the controller act)")
+        counts[os.path.basename(f)] = n
+    for f in sorted(glob.glob(os.path.join(actions_dir, "*.alerts.jsonl"))):
+        try:
+            counts[os.path.basename(f)] = validate_jsonl("alert", f)
+        except ValueError as e:
+            return False, f"{os.path.basename(f)}: {e}"
+    return True, counts
+
+
 def run_extra_jobs(results_path: str) -> None:
     """One-shot jobs that ride the first healthy window (VERDICT r3 #6)."""
     import tempfile
@@ -237,6 +273,7 @@ def run_extra_jobs(results_path: str) -> None:
     ledger_dir = tempfile.mkdtemp(prefix="tpu_watch_ledger_")
     alerts_dir = tempfile.mkdtemp(prefix="tpu_watch_alerts_")
     perf_dir = tempfile.mkdtemp(prefix="tpu_watch_perf_")
+    autopilot_dir = tempfile.mkdtemp(prefix="tpu_watch_autopilot_")
     jobs = [
         ("tp_allreduce", [sys.executable, os.path.join(REPO, "tools", "ici_bench.py")]),
         ("serving_latency", [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")]),
@@ -301,6 +338,16 @@ def run_extra_jobs(results_path: str) -> None:
         ("serving_disagg", [sys.executable,
                             os.path.join(REPO, "tools", "fleet_bench.py"),
                             "--disagg"]),
+        # fleet autopilot (serving/fleet/autopilot.py): load spike +
+        # mid-run replica kill absorbed with zero human input — scale-out
+        # fires off the fast-window burn, the kill's replica_down fires
+        # and resolves, every action lands schema-valid in
+        # autopilot_actions.jsonl (asserted by the post-hook), and the
+        # recovery wave finishes (rc-gated)
+        ("fleet_autopilot", [sys.executable,
+                             os.path.join(REPO, "tools", "fleet_bench.py"),
+                             "--autopilot", "--actions-out",
+                             autopilot_dir]),
         # multi-tenant serving (tenancy/ subsystem): >= 8 LoRA adapters
         # co-batched at near-baseline inter-token p99 (rc-gated)
         ("serving_lora", [sys.executable,
@@ -394,6 +441,17 @@ def run_extra_jobs(results_path: str) -> None:
                     error = (f"perf validation: {detail}"
                              + (f" | bench: {error}" if error else ""))
                 ok = ok and pf_ok
+            if name == "fleet_autopilot":
+                # artifact-first: the action ledger certifies the job
+                # whatever the bench gate said — and it must be non-empty
+                ap_ok, detail = _validate_autopilot_dir(autopilot_dir)
+                if ap_ok:
+                    payload = {"autopilot_records": detail,
+                               **(payload or {})}
+                else:
+                    error = (f"autopilot validation: {detail}"
+                             + (f" | bench: {error}" if error else ""))
+                ok = ok and ap_ok
             append(results_path, {"kind": name, "ok": ok,
                                   "result": payload, "error": error})
         except subprocess.TimeoutExpired:
