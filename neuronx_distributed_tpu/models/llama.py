@@ -501,40 +501,67 @@ class LlamaAttention(nn.Module):
                 # a parked slot (offset >= T) writes nothing: route it out of
                 # range and let the scatter drop it
                 phys = jnp.where(idx < T, phys, NP)
+                # never commit an INVALID cell (a chunk's left-pad rows,
+                # whose validity stays 0): their hidden states are
+                # path-dependent garbage (empty-band kernel rows vs
+                # fully-masked gather rows), and on int8 pools a garbage
+                # cell would pollute the whole page's quantization scale
+                live = None
+                if kv_valid is not None:
+                    live = jnp.take_along_axis(
+                        jnp.asarray(kv_valid), jnp.clip(idx, 0, T - 1),
+                        axis=1) > 0                      # [B, Sn]
+                    phys = jnp.where(live, phys, NP)
                 if quantized:
-                    # single-token quantize-on-write: gather each slot's
-                    # touched page, dequantize it, insert the new token,
-                    # re-quantize the whole page and scatter it (and its
-                    # fresh scale/zero) back.  Decode pages are exclusively
-                    # owned per slot (never shared — sharing is prompt-page
-                    # only), so the page-granular read-modify-write cannot
-                    # race another slot; parked rows gather a clipped page
-                    # whose writeback drops at phys == NP.
-                    if Sn != 1:
-                        raise ValueError(
-                            "quantized KV pages support single-token decode "
-                            f"scatter only, got {Sn} new positions "
-                            "(speculative multi-token verification writes "
-                            "are fp-pool only)")
+                    # quantize-on-write, any Sn >= 1: the Sn new cells span
+                    # up to ceil((Sn-1)/page)+1 consecutive logical pages
+                    # (the first may be written mid-page).  Per straddled
+                    # page: gather it, dequantize, insert every new cell
+                    # landing in it, re-quantize the whole page and scatter
+                    # it (and its fresh scale/zero) back.  Sn == 1 reduces
+                    # to the classic single-token decode RMW; Sn > 1 is the
+                    # speculative verify / chunked-prefill commit.  Decode
+                    # pages are exclusively owned per slot (never shared —
+                    # sharing is prompt-page only), so the page-granular
+                    # read-modify-write cannot race another slot; untouched
+                    # and parked rows route to phys == NP and their
+                    # writeback drops.
                     from neuronx_distributed_tpu.kvcache.quant import (
                         dequantize_page, quantize_page)
 
-                    p1 = phys[:, 0]                      # [B]
-                    pc = jnp.clip(p1, 0, NP - 1)
-                    hot = (jnp.arange(page)[None, :, None, None]
-                           == in_off[:, 0][:, None, None, None])
+                    base = cache_offset // page          # [B], unclipped
+                    n_pg = (Sn - 1 + page - 1) // page + 1
+                    cell = jnp.arange(page)[None, :]
 
-                    def requant_write(cq, sc, zp, new):
-                        pg = dequantize_page(cq[pc], sc[pc], zp[pc])
-                        pg = jnp.where(hot, new.astype(pg.dtype), pg)
-                        q2, s2, z2 = quantize_page(pg)
-                        cq = cq.at[p1].set(q2, mode="drop")
-                        sc = sc.at[p1].set(s2, mode="drop")
-                        zp = zp.at[p1].set(z2, mode="drop")
+                    def requant_pages(cq, sc, zp, new):
+                        for j in range(n_pg):
+                            lp = base + j                # logical page [B]
+                            lp_c = jnp.clip(lp, 0, PP - 1)
+                            pj = jnp.take_along_axis(
+                                block_table, lp_c[:, None], axis=1)[:, 0]
+                            pos = lp[:, None] * page + cell       # [B, page]
+                            s_idx = pos - cache_offset[:, None]
+                            hot = ((s_idx >= 0) & (s_idx < Sn) & (pos < T))
+                            if kv_valid is not None:
+                                hot &= jnp.take_along_axis(
+                                    jnp.asarray(kv_valid),
+                                    jnp.clip(pos, 0, T - 1), axis=1) > 0
+                            pj = jnp.where(jnp.any(hot, axis=1), pj, NP)
+                            pc = jnp.clip(pj, 0, NP - 1)
+                            sel = jnp.clip(s_idx, 0, Sn - 1)
+                            ins = jnp.take_along_axis(
+                                new, sel[:, :, None, None], axis=1)
+                            pg = dequantize_page(cq[pc], sc[pc], zp[pc])
+                            pg = jnp.where(hot[:, :, None, None],
+                                           ins.astype(pg.dtype), pg)
+                            q2, s2, z2 = quantize_page(pg)
+                            cq = cq.at[pj].set(q2, mode="drop")
+                            sc = sc.at[pj].set(s2, mode="drop")
+                            zp = zp.at[pj].set(z2, mode="drop")
                         return cq, sc, zp
 
-                    ck, ks, kz = requant_write(ck, ks, kz, k)
-                    cv, vs, vz = requant_write(cv, vs, vz, v)
+                    ck, ks, kz = requant_pages(ck, ks, kz, k)
+                    cv, vs, vz = requant_pages(cv, vs, vz, v)
                 else:
                     ck = ck.at[phys, in_off].set(
                         k.astype(ck.dtype), mode="drop")

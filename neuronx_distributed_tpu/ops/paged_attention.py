@@ -92,31 +92,55 @@ SHAPE_DEFAULTS = {
     (16, 512, 8, 128, None): (8, 4),
 }
 
+# (page_size, pages_per_slot, num_kv_heads, head_dim, quant, chunk_width) ->
+#     (block_pages, split_k)
+# Wide-chunk entries (S > 1): the in-kernel chunked-prefill and speculative
+# verify shapes, committed from `flash_autotune --paged --chunk-width S`
+# sweeps.  A wide chunk amortizes grid overhead across S query rows, so the
+# winning (bp, split_k) generally differs from the S = 1 decode entry at the
+# same pool geometry — wider blocks, less split-K.
+CHUNK_SHAPE_DEFAULTS = {
+    # page, PP, NKV, D, quant, S  : bp, split_k
+    (16, 128, 12, 128, None, 64): (16, 1),   # T=2k bench, 64-token chunks
+    (16, 512, 12, 128, None, 64): (16, 2),   # T=8k
+    (16, 512, 12, 128, "int8", 64): (16, 2),
+    (16, 128, 8, 128, None, 64): (16, 1),    # llama3-8b kv8 geometry
+}
+
 
 def resolve_paged_kernel(flag, tensor_parallel: int = 1) -> bool:
     """Resolve the three-state ``paged_kernel`` knob (``"auto"`` | ``True``
     | ``False``) to a concrete bool: auto picks the kernel on a real TPU
-    backend at tp == 1 and the gather path everywhere else (CPU/interpret
-    runs pay interpreter overhead per grid step, and the kernel is not yet
-    shard_mapped over a tp-sharded kv-head axis).  An explicit ``True`` is
-    honored anywhere — that is how the CPU parity tests drive the
-    interpreter."""
+    backend and the gather path on CPU (interpret runs pay interpreter
+    overhead per grid step).  tp > 1 meshes run the kernel too — it is
+    shard_mapped over the tp-sharded kv-head axis (``tensor_parallel``
+    stays in the signature for callers that recorded it; it no longer
+    forces a fallback).  An explicit ``True`` is honored anywhere — that
+    is how the CPU parity tests drive the interpreter."""
     if flag is True or flag is False:
         return flag
     if flag not in ("auto", None):
         raise ValueError(
             f"paged_kernel must be 'auto', True or False, got {flag!r}")
-    return jax.default_backend() == "tpu" and tensor_parallel == 1
+    del tensor_parallel
+    return jax.default_backend() == "tpu"
 
 
 def lookup_defaults(page_size: int, pages_per_slot: int, num_kv_heads: int,
-                    head_dim: int, quant: Optional[str] = None
-                    ) -> Tuple[int, int]:
+                    head_dim: int, quant: Optional[str] = None,
+                    chunk_width: int = 1) -> Tuple[int, int]:
     """``(block_pages, split_k)`` for the given paged-decode shape: the
     autotuned table entry when one exists, else a heuristic — enough pages
     per block to fill ~128 kv lanes (one MXU tile of scores), split-K only
     once the chain is long enough that a single sequential walk leaves the
-    chip idle."""
+    chip idle.  ``chunk_width > 1`` (prefill chunks, speculative verify)
+    consults :data:`CHUNK_SHAPE_DEFAULTS` first and falls back to the
+    decode entry at the same pool geometry."""
+    if chunk_width > 1:
+        ckey = (page_size, pages_per_slot, num_kv_heads, head_dim, quant,
+                chunk_width)
+        if ckey in CHUNK_SHAPE_DEFAULTS:
+            return CHUNK_SHAPE_DEFAULTS[ckey]
     key = (page_size, pages_per_slot, num_kv_heads, head_dim, quant)
     if key in SHAPE_DEFAULTS:
         return SHAPE_DEFAULTS[key]
@@ -295,7 +319,8 @@ def _paged_attention_impl(q, kv_pages, block_table, cache_offset, kv_start,
     interpret = _auto_interpret(interpret)
     if block_pages is None or split_k is None:
         d_bp, d_sk = lookup_defaults(page, PP, NKV, D,
-                                     "int8" if quantized else None)
+                                     "int8" if quantized else None,
+                                     chunk_width=S)
         block_pages = d_bp if block_pages is None else block_pages
         split_k = d_sk if split_k is None else split_k
     bp = max(1, min(int(block_pages), PP))
@@ -432,6 +457,13 @@ def paged_attention(
     ``split_k`` default from :func:`lookup_defaults`; ``interpret`` auto
     (pallas interpreter off-TPU), matching ``ops.flash_attention``.
 
+    On a live tp > 1 mesh the kernel runs under a ``shard_map`` over the
+    kv-head axis: heads shard naturally (each ``(slot, kv-head)`` grid
+    program is independent), the pool's kv-head axis is already tp-sharded
+    by ``kvcache.pool``, and the block table / offsets / per-page quant
+    params are replicated — no collectives, the row-parallel output
+    projection reduces afterwards as usual.
+
     Returns ``[B, S, NQ, D]`` in ``q.dtype``.
     """
     if pltpu is None:  # pragma: no cover - CPU builds ship pltpu today
@@ -444,11 +476,64 @@ def paged_attention(
         raise ValueError(
             f"q heads ({q.shape[2]}) must group over kv heads "
             f"({kv_pages[0].shape[2]})")
+    kw = dict(sm_scale=sm_scale, window=window, softcap=softcap,
+              block_pages=block_pages, split_k=split_k,
+              interpret=_auto_interpret(interpret))
+    wrap = _tp_shard_mapped(q.shape[2], kv_pages[0].shape[2])
+    if wrap is not None:
+        if kv_start is None:
+            kv_start = jnp.zeros(cache_offset.shape, jnp.int32)
+        return wrap(kw)(q, tuple(kv_pages), block_table.astype(jnp.int32),
+                        cache_offset.astype(jnp.int32),
+                        kv_start.astype(jnp.int32))
     return _paged_attention_impl(
-        q, tuple(kv_pages), block_table, cache_offset, kv_start,
-        sm_scale=sm_scale, window=window, softcap=softcap,
-        block_pages=block_pages, split_k=split_k,
-        interpret=_auto_interpret(interpret))
+        q, tuple(kv_pages), block_table, cache_offset, kv_start, **kw)
+
+
+def _tp_shard_mapped(nq: int, nkv: int):
+    """The tp > 1 dispatch decision: returns a ``wrap`` closure when a live
+    mesh shards the kv-head axis (``wrap(kw)`` is the shard_mapped kernel),
+    else None (single-device meshes, and head counts the mesh does not
+    divide — those stay on the global-kernel path, matching the pool's own
+    replicate-when-indivisible policy)."""
+    from neuronx_distributed_tpu.parallel.mesh import (
+        TENSOR_AXIS,
+        get_mesh,
+        model_parallel_is_initialized,
+    )
+
+    if not model_parallel_is_initialized():
+        return None
+    mesh = get_mesh()
+    tp = mesh.shape[TENSOR_AXIS]
+    if tp == 1 or nkv % tp or nq % tp or (nq // tp) % (nkv // tp):
+        return None
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_tpu.utils.common import shard_map
+
+    heads = P(None, None, TENSOR_AXIS, None)
+
+    def wrap(kw):
+        def per_shard(q_, pool_, bt_, off_, start_):
+            return _paged_attention_impl(q_, pool_, bt_, off_, start_, **kw)
+
+        pool_spec = tuple(heads if i < 2 else P(None)
+                          for i in range(6))  # trimmed to the pool's arity
+
+        def call(q_, pool_, bt_, off_, start_):
+            # full-manual over the whole mesh (the 0.4-era shim refuses
+            # partial-manual): every non-tp axis is explicitly replicated
+            return shard_map(
+                per_shard, mesh,
+                in_specs=(heads, pool_spec[:len(pool_)], P(None, None),
+                          P(None), P(None)),
+                out_specs=heads,
+            )(q_, pool_, bt_, off_, start_)
+
+        return call
+
+    return wrap
 
 
 def paged_attention_reference(q, kv_pages, block_table, cache_offset,

@@ -383,8 +383,11 @@ class ServingEngine:
       outputs stay token-identical to whole-prefill (prefix-cache hits
       still skip resident chunks).  Co-batched decodes tick every step, so
       inter-token latency no longer spikes with a neighbor's prompt
-      length.  Does not compose with ``spec_k``/``kv_quant``/
-      ``adapter_store`` yet;
+      length.  Composes with ``spec_k`` (the draft row prefills whole at
+      admission), ``kv_quant`` (chunk writes quantize-on-scatter) and
+      ``adapter_store`` (chunks prefill under the request's adapter) —
+      every pair is one parameterization of the same paged phase-fn
+      family;
     - ``Request.priority`` ("interactive" | "batch") + EDF replace FCFS:
       interactive requests are granted first and may PREEMPT a decoding
       batch-tier victim when blocked on slots/pages (victim pages released
@@ -493,9 +496,9 @@ class ServingEngine:
         self._draft_model = draft
         # multi-tenant serving (tenancy/): per-request LoRA adapters paged
         # through the adapter store; int8 KV pages double the pool at a
-        # measured, bounded logit drift.  Both live on the paged machinery,
-        # and neither composes with speculative decoding yet (the verify
-        # chunk would need adapter-aware/requantizing multi-token writes).
+        # measured, bounded logit drift.  Both live on the paged machinery
+        # and compose with speculative decoding — the verify chunk is the
+        # same parameterized phase fn, adapter-aware and requantizing.
         if adapter_store is not None and page_size is None:
             raise ValueError(
                 "adapter_store needs the paged engine (page_size=/"
@@ -509,11 +512,6 @@ class ServingEngine:
                 raise ValueError(
                     "kv_quant quantizes KV pages: pass page_size=/"
                     "num_pages= alongside it")
-        if spec_k and (adapter_store is not None or kv_quant is not None):
-            raise ValueError(
-                "speculative decoding does not compose with adapter_store/"
-                "kv_quant yet (the multi-token verification chunk would "
-                "need adapter-aware, requantizing page writes)")
         # paged chunked prefill (Sarathi-style stall-free batching): long
         # prompts trickle into the page pool across steps — a PREFILLING
         # slot co-exists with decoding slots, and the per-step token budget
@@ -531,11 +529,6 @@ class ServingEngine:
                     f"a positive multiple of page_size ({page_size}) — "
                     "chunks are page-aligned so cached prefix pages can be "
                     "skipped whole")
-            if spec_k or kv_quant is not None or adapter_store is not None:
-                raise ValueError(
-                    "prefill_chunk_tokens does not compose with draft=/"
-                    "spec_k=, kv_quant= or adapter_store= yet (the chunk "
-                    "scatter is fp-pool, base-model only)")
         self._chunk_tokens = prefill_chunk_tokens
         self._chunking: dict = {}   # slot -> _ChunkPrefill in progress
         self._chunk_rr = 0          # budget-rotation cursor (fairness)
@@ -793,6 +786,27 @@ class ServingEngine:
             self._adapter_tables = np.zeros((self.B, ap), np.int32)
             self._slot_adapter = [0] * self.B
             self._adapter_dirty = True
+        # spec × tenancy: when the draft shares the target's adapter
+        # geometry (always true for a self-draft), its proposals run under
+        # each slot's adapter too — sampled self-draft output stays
+        # bit-identical to the plain adapter engine's.  A geometry-
+        # incompatible draft proposes base-model tokens; the adapter-aware
+        # verify still corrects the distribution, at a lower acceptance
+        # rate.
+        self._draft_lora = False
+        if self._adapters is not None and draft is not None:
+            from neuronx_distributed_tpu.tenancy.store import AdapterLayout
+
+            lay = self._adapters.layout
+            try:
+                self._draft_lora = (
+                    hasattr(draft, "prefill_one_lora")
+                    and AdapterLayout.for_model(
+                        draft, lay.rank, lay.page_elems) == lay)
+            except (AttributeError, TypeError):
+                self._draft_lora = False
+            if self._draft_lora:
+                draft._adapter_layout = lay
         if self._kv_quant is not None:
             self.registry.counter(QUANT_PAGES_TOTAL)
 
@@ -1385,6 +1399,23 @@ class ServingEngine:
                 self._trace_phase_attrs(req, chunked=True,
                                         fresh_pages=len(fresh))
                 self._set_sampling_state(slot, req)
+                if self._spec_k:
+                    # the draft's contiguous row prefills whole at
+                    # admission (spec × chunked-prefill): the draft is the
+                    # small model — its full-width forward is the cheap
+                    # half — and its row sits parked (offset = T) until
+                    # the target's final chunk lands
+                    if self._draft_lora and aid:
+                        _, drow_caches = self._draft_model.prefill_one_lora(
+                            jnp.asarray(ids), valid_ctx, self._adapter_pool,
+                            self._adapter_tables[slot][None, :])
+                    else:
+                        _, drow_caches = self._draft_model.prefill_one(
+                            jnp.asarray(ids), valid_ctx)
+                    self._draft_caches, self._draft_valid = \
+                        self._draft_model.insert_slot(
+                            self._draft_caches, drow_caches,
+                            self._draft_valid, row_valid, slot)
                 return
             if cached is not None:
                 # exact full-prompt prefix hit: the chain's pages already
@@ -1409,7 +1440,8 @@ class ServingEngine:
                 self._trace_phase_attrs(req, fresh_pages=len(fresh))
                 for lp, phys in fresh:
                     self.caches = self.model.write_page(
-                        self.caches, row_caches, lp, phys)
+                        self.caches, row_caches, lp, phys,
+                        row_valid=valid_np)
                 if self._kv_quant is not None and fresh:
                     self.registry.counter(QUANT_PAGES_TOTAL).inc(len(fresh))
                 # prefix-index registration waits for the finite-logits
@@ -1431,8 +1463,13 @@ class ServingEngine:
             # slot row — it runs even on a target prefix-cache hit (the
             # draft's KV is not paged/shared), and its row is simply
             # overwritten at the next insert if this admission fails
-            _, drow_caches = self._draft_model.prefill_one(
-                jnp.asarray(ids), valid_ctx)
+            if self._draft_lora and aid:
+                _, drow_caches = self._draft_model.prefill_one_lora(
+                    jnp.asarray(ids), valid_ctx, self._adapter_pool,
+                    self._adapter_tables[slot][None, :])
+            else:
+                _, drow_caches = self._draft_model.prefill_one(
+                    jnp.asarray(ids), valid_ctx)
             self._draft_caches, self._draft_valid = \
                 self._draft_model.insert_slot(
                     self._draft_caches, drow_caches, self._draft_valid,
@@ -1604,10 +1641,15 @@ class ServingEngine:
             fault_point("serving/prefill_chunk",
                         request_id=st.req.request_id,
                         engine_step=self._steps, chunk_offset=off)
+            # an adapter request's chunks prefill with its LoRA deltas
+            # applied (all-NULL tables = adapter 0 = exact base model)
+            ad = ((self._adapter_pool, self._adapter_tables[slot][None, :])
+                  if self._adapters is not None else (None, None))
             logits, self.caches = self.model.prefill_chunk_pages(
                 jnp.asarray(ids_chunk), off,
                 self._kv.tables[slot][None, :].copy(), self.caches,
-                st.valid_row[None, :].copy())
+                st.valid_row[None, :].copy(), apool=ad[0], atables=ad[1],
+                paged_kernel=self._paged_kernel)
         except BaseException as e:
             if t0 is not None:
                 t1 = self._clock()
@@ -1624,11 +1666,17 @@ class ServingEngine:
                 self._perf.note_phase("prefill_chunk", (t1 - t0) * 1e3)
         st.req.prefill_chunks += 1
         st.next_i += n_pages
-        # chunk prefill stays on the gather path (it attends the per-row
-        # [1, T] view); its rematerialization is honest in the counter, so
-        # a kernel engine with chunking on shows exactly the chunks' bytes
-        self.registry.counter(GATHER_BYTES_TOTAL).inc(
-            self._gather_bytes_step // self.B)
+        if not self._paged_kernel:
+            # gather-path chunk: it attends a per-row [1, T] clone of the
+            # committed pool — book its rematerialized bytes honestly so
+            # the `gather_bytes_total == 0` kernel-mode gate covers chunked
+            # prefill too (with the kernel on, the chunk walks the pool
+            # in-kernel and this counter must NOT move)
+            self.registry.counter(GATHER_BYTES_TOTAL).inc(
+                self._gather_bytes_step // self.B)
+        if self._kv_quant is not None:
+            # the chunk's page-aligned writes each requantized their page
+            self.registry.counter(QUANT_PAGES_TOTAL).inc(n_pages)
         if st.pages_remaining == 0:
             # same fault point the whole-prefill path perturbs, applied to
             # the prefill logits the first token will sample from
@@ -1949,13 +1997,26 @@ class ServingEngine:
         tidx_steps = tok_idx[None, :] + np.arange(k, dtype=np.int32)[:, None]
         staged = [self._next_tok[:, None].copy(), self._offsets.copy(),
                   tok_idx, offs_steps, tidx_steps]
-        if self._kv.tables_dirty or self._tables_dev is None:
+        stage_kv = self._kv.tables_dirty or self._tables_dev is None
+        stage_ad = self._adapters is not None and (
+            self._adapter_dirty or self._atables_dev is None)
+        if stage_kv:
             staged.append(self._kv.tables.copy())
-            tok, offs, tidx, offs_j, tidx_j, self._tables_dev = \
-                self._audit.put(tuple(staged))
+        if stage_ad:
+            # a dirty adapter table rides the SAME packed put as the block
+            # tables — still one explicit host→device crossing per round
+            staged.append(self._adapter_tables.copy())
+        put = list(self._audit.put(tuple(staged)))
+        tok, offs, tidx, offs_j, tidx_j = put[:5]
+        cursor = 5
+        if stage_kv:
+            self._tables_dev = put[cursor]
+            cursor += 1
             self._kv.tables_dirty = False
-        else:
-            tok, offs, tidx, offs_j, tidx_j = self._audit.put(tuple(staged))
+        if stage_ad:
+            self._atables_dev = put[cursor]
+            cursor += 1
+            self._adapter_dirty = False
         if self._sampling_dirty:
             self._keys_dev, self._temps_dev, self._topks_dev, \
                 self._topps_dev = self._audit.put(
@@ -1965,10 +2026,16 @@ class ServingEngine:
         draft = self._draft_model
         dtok = tok
         props, q_filts, dfin = [], [], None
+        # an adapter-compatible draft proposes under each slot's adapter
+        # (the same gathered-delta path as the target's verify), so with
+        # draft == target the proposals ARE the plain adapter engine's draws
+        dad = ((self._adapter_pool, self._atables_dev)
+               if self._draft_lora else (None, None))
         for j in range(k):
             dlogits, self._draft_caches, self._draft_valid = \
                 draft.decode_slots(dtok, offs_j[j], self._draft_caches,
-                                   self._draft_valid)
+                                   self._draft_valid, apool=dad[0],
+                                   atables=dad[1])
             dlogits = perturb("serving/draft_logits", dlogits,
                               engine_step=self._steps, round_pos=j)
             ptoks, qf, fin = _propose_rows(
@@ -1979,10 +2046,25 @@ class ServingEngine:
             dfin = fin if dfin is None else jnp.logical_and(dfin, fin)
             dtok = ptoks[:, None]
         chunk = jnp.concatenate([tok] + [t[:, None] for t in props], axis=1)
+        # adapter-aware verify (spec × tenancy): the chunk is scored under
+        # each slot's OWN adapter — the same gathered-delta path its plain
+        # decode would take — so acceptance judges the distribution the
+        # request actually samples from
+        ad = ((self._adapter_pool, self._atables_dev)
+              if self._adapters is not None else (None, None))
         vlogits, self.caches, self.valid = self.model.verify_pages(
             chunk, offs, self._tables_dev, self.caches, self.valid,
-            paged_kernel=self._paged_kernel)
+            apool=ad[0], atables=ad[1], paged_kernel=self._paged_kernel)
         self._count_gather_step()
+        if self._kv_quant is not None:
+            # every active slot's k+1-token verify write requantized the
+            # page(s) its chunk straddles — book them honestly
+            page = self._kv.page_size
+            pages = sum(
+                int((self._offsets[slot] + k) // page
+                    - self._offsets[slot] // page + 1)
+                for slot, _ in active)
+            self.registry.counter(QUANT_PAGES_TOTAL).inc(pages)
         vlogits = perturb("serving/verify_logits", vlogits,
                           engine_step=self._steps)
         packed = _spec_accept(
@@ -2086,10 +2168,12 @@ class ServingEngine:
         self._batch_t0 = None
         if need_ingest:
             (ing_offs,) = self._audit.put((ingest,))
+            dad = ((self._adapter_pool, self._atables_dev)
+                   if self._draft_lora else (None, None))
             _, self._draft_caches, self._draft_valid = \
                 self._draft_model.decode_slots(
                     last_prop[:, None], ing_offs, self._draft_caches,
-                    self._draft_valid)
+                    self._draft_valid, apool=dad[0], atables=dad[1])
         return post
 
     def _finish_decode(self, post: list, outputs: list) -> None:

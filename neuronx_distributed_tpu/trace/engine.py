@@ -798,21 +798,29 @@ class ParallelInferenceModel(_ServingBase):
 
     # -- continuous-batching phase fns (serving/engine.ServingEngine) ------
 
-    def _decode_slots_fn(self, params, tok, offsets, caches, valid):
+    def _decode_slots_fn(self, params, tok, offsets, caches, valid,
+                         apool=None, atables=None):
         """One token step with PER-SLOT cache offsets ``[B]`` — the
         continuous-batching generalization of :meth:`_decode_fn`: every slot
         writes its new key at its own position and takes its RoPE phase from
         its own validity prefix, so requests at different depths decode in
         one batched step.  An offset of ``T`` parks an idle slot (writes
-        nothing).  Returns ``(logits [B, V], caches, valid)``."""
+        nothing).  ``apool``/``atables`` run the step under each slot's own
+        LoRA adapter (the contiguous-cache counterpart of
+        ``decode_pages_lora`` — an adapter-compatible spec DRAFT proposes
+        under the request's adapter, keeping sampled self-draft output
+        bit-identical to the plain engine's).  Returns
+        ``(logits [B, V], caches, valid)``."""
         T = valid.shape[1]
         hot = jnp.arange(T)[None, :] == offsets[:, None]  # [B, T]
         valid = jnp.where(hot, 1, valid)  # the new token becomes a key
         # per-example position: number of valid keys strictly before offset
         before = jnp.where(jnp.arange(T)[None, :] < offsets[:, None], valid, 0)
         positions = jnp.sum(before, axis=1, keepdims=True).astype(jnp.int32)
+        extra = ({} if apool is None
+                 else {"adapters": self._gather_adapters(apool, atables)})
         logits, caches = self.module.apply(
-            params, tok, positions, caches, offsets, kv_valid=valid
+            params, tok, positions, caches, offsets, kv_valid=valid, **extra
         )
         return logits[:, -1, :], caches, valid
 
@@ -825,18 +833,27 @@ class ParallelInferenceModel(_ServingBase):
                 "serving_phase", capacity=SERVING_CACHE_SIZE, owner=self)
         return self._serving_cache
 
-    def decode_slots(self, tok, offsets, caches, valid):
+    def decode_slots(self, tok, offsets, caches, valid, apool=None,
+                     atables=None):
         """Compiled per-slot decode step (lazily jitted, cache donated);
         ``offsets`` is the per-slot next-write index ``[B]`` (``T`` = idle).
-        Outputs pinned to the AOT executables' shardings."""
+        ``apool``/``atables`` select the adapter-aware variant (its own
+        cached program).  Outputs pinned to the AOT executables'
+        shardings."""
         self._serving_lru()
-        fn = self._serving_cache.get("decode_slots")
+        lora = apool is not None
+        name = "decode_slots_lora" if lora else "decode_slots"
+        fn = self._serving_cache.get(name)
         if fn is None:
             io = self._io_shardings
             fn = jax.jit(self._decode_slots_fn, donate_argnums=(3,),
                          out_shardings=(None, io["cache_out"], io["batch"](None)))
-            fn = self._serving_cache.put("decode_slots", fn)
-        return fn(self.params, tok, jnp.asarray(offsets, jnp.int32), caches, valid)
+            fn = self._serving_cache.put(name, fn)
+        args = (self.params, tok, jnp.asarray(offsets, jnp.int32), caches,
+                valid)
+        if lora:
+            args = args + (apool, jnp.asarray(atables, jnp.int32))
+        return fn(*args)
 
     def prefill_one(self, ids, valid):
         """Single-request prefill ``[1, C] -> (logits [1, V], caches B=1)``
@@ -907,55 +924,119 @@ class ParallelInferenceModel(_ServingBase):
             else None,
             caches)
 
-    def _decode_pages_fn(self, params, tok, offsets, block_table, caches,
-                         valid, adapters=None, paged_kernel=False):
-        """The paged twin of :meth:`_decode_slots_fn`: same per-slot offsets,
-        validity update, and mask-derived positions, but the KV state is the
-        page pool + block tables (the model scatters the new token into its
-        physical page and attends over the gathered per-row view — or, with
-        ``paged_kernel``, straight over the pool via the block-table-native
-        ``ops.paged_attention`` kernel, no per-row clone).  An offset of
-        ``T`` parks an idle slot.  ``adapters`` (the tenancy path) rides as
-        an extra apply kwarg, so the offset/validity/position math — the
-        token-identity contract — exists exactly once."""
+    def _paged_step_fn(self, params, toks, offsets, block_table, caches,
+                       valid, apool=None, atables=None, paged_kernel=False,
+                       update_valid=True, last_only=True):
+        """THE paged phase fn — one parameterized family serving decode,
+        multi-adapter decode, speculative verify and chunked prefill (the
+        former ``_decode_pages_fn`` / ``_decode_pages_lora_fn`` /
+        ``_verify_pages_fn`` / ``_prefill_chunk_pages_fn`` quartet).  Token
+        ``s`` of slot ``b`` is written at cache index ``offsets[b] + s``
+        through the block table; positions are global prefix counts of the
+        validity row, so RoPE phases match the contiguous executables
+        exactly.  An offset of ``T`` parks an idle slot (writes drop,
+        logits are garbage the caller ignores).
+
+        The axes of the family:
+
+        - ``toks [B, S]`` — ``S = 1`` is classic decode, ``S = k + 1`` the
+          speculative verification chunk, ``S = Cc`` a prefill chunk;
+        - ``apool``/``atables`` — per-slot LoRA deltas gathered from the
+          adapter pool (``None`` = base model), composing with ANY ``S``:
+          adapter-aware verify is the same code as adapter-aware decode;
+        - the pool pytree — fp pairs or int8 six-tuples; the model's
+          multi-token requantizing scatter makes spec × int8 the same code
+          as single-token quantized decode;
+        - ``paged_kernel`` — block-table-native ``ops.paged_attention``
+          over the pool (shard_mapped at tp > 1) vs the gather path;
+        - ``update_valid`` — decode/verify mark their tokens as new keys;
+          chunked prefill pre-writes the FULL prompt's validity at
+          admission (keys beyond the chunk are causally masked by the
+          q-offset band), so its validity row passes through untouched;
+        - ``last_only`` — decode/prefill sample from the last position
+          only; verify needs the whole ``[B, S, V]`` chunk of logits.
+
+        Since every configuration is one parameterization of this single
+        fn, the offset/validity/position math — the token-identity
+        contract — exists exactly once, and feature pairs cannot diverge
+        from their solo baselines."""
+        S = toks.shape[1]
         T = valid.shape[1]
-        hot = jnp.arange(T)[None, :] == offsets[:, None]  # [B, T]
-        valid = jnp.where(hot, 1, valid)  # the new token becomes a key
-        before = jnp.where(jnp.arange(T)[None, :] < offsets[:, None], valid, 0)
-        positions = jnp.sum(before, axis=1, keepdims=True).astype(jnp.int32)
-        extra = {} if adapters is None else {"adapters": adapters}
+        idx = offsets[:, None] + jnp.arange(S)[None, :]  # [B, S] write indices
+        if update_valid:
+            hot = jnp.any(jnp.arange(T)[None, None, :] == idx[:, :, None],
+                          axis=1)
+            valid = jnp.where(hot, 1, valid)  # the new tokens become keys
+        counts = jnp.cumsum(valid, axis=1) - valid  # valid keys strictly before
+        positions = jnp.take_along_axis(counts, jnp.clip(idx, 0, T - 1), axis=1)
+        extra = {}
+        if apool is not None:
+            extra["adapters"] = self._gather_adapters(apool, atables)
         if paged_kernel:
             extra["paged_kernel"] = True
         logits, caches = self.module.apply(
-            params, tok, positions, caches, offsets, kv_valid=valid,
-            block_table=block_table, **extra,
+            params, toks, positions.astype(jnp.int32), caches, offsets,
+            kv_valid=valid, block_table=block_table, **extra,
         )
-        return logits[:, -1, :], caches, valid
+        if last_only:
+            logits = logits[:, -1, :]
+        return logits, caches, valid
+
+    def _paged_phase(self, toks, offsets, block_table, caches, valid,
+                     apool=None, atables=None, paged_kernel=None,
+                     update_valid=True, last_only=True):
+        """Compile-cache dispatcher for :meth:`_paged_step_fn`: every
+        configuration jits the SAME underlying fn, keyed on its static
+        parameterization — (chunk width, pool layout, batch rows, kernel
+        flag, adapters, validity/logits mode).  The leading key component
+        keeps the classic per-phase family names (``decode_pages`` /
+        ``decode_pages_lora`` / ``verify_pages`` / ``prefill_chunk_pages``)
+        so the compile ledger's per-family thrash detection and
+        ``obs.perf``'s program→phase attribution join keep working — but a
+        mixed spec × int8 × lora × chunked run now holds a handful of
+        parameterizations of ONE program family, not four divergent code
+        paths racing the LRU."""
+        import functools as _ft
+
+        self._serving_lru()
+        toks = jnp.asarray(toks).astype(jnp.int32)
+        valid = jnp.asarray(valid, jnp.int32)
+        pk = self.paged_kernel if paged_kernel is None else bool(paged_kernel)
+        lora = apool is not None
+        name = ("prefill_chunk_pages" if not update_valid
+                else "verify_pages" if not last_only
+                else "decode_pages_lora" if lora else "decode_pages")
+        key = (name, self._pool_tag(caches), int(toks.shape[1]),
+               int(valid.shape[0]), pk, lora, update_valid, last_only)
+        fn = self._serving_cache.get(key)
+        if fn is None:
+            vout = (self._io_shardings["batch"](None)
+                    if int(valid.shape[0]) == self.config.batch_size
+                    else None)
+            fn = jax.jit(
+                _ft.partial(self._paged_step_fn, paged_kernel=pk,
+                            update_valid=update_valid, last_only=last_only),
+                donate_argnums=(4,),
+                out_shardings=(None, self._pool_out_shardings(caches), vout))
+            fn = self._serving_cache.put(key, fn)
+        args = (self.params, toks, jnp.asarray(offsets, jnp.int32),
+                jnp.asarray(block_table, jnp.int32), caches, valid)
+        if lora:
+            args = args + (apool, jnp.asarray(atables, jnp.int32))
+        return fn(*args)
 
     def decode_pages(self, tok, offsets, block_table, caches, valid,
                      paged_kernel=None):
-        """Compiled paged per-slot decode step (page pool donated).
+        """Compiled paged per-slot decode step (page pool donated) — the
+        ``S = 1`` member of the :meth:`_paged_step_fn` family.
         ``block_table`` is the ``[B, max_total_len // page_size]`` int32
         logical→physical page map; ``caches`` the pool pytree (fp pairs or
         the int8 six-tuples — each layout compiles its own program).
         ``paged_kernel`` (default: the model's resolved flag) selects the
         block-table-native kernel over the gather path; each value is its
         own cached program."""
-        import functools as _ft
-
-        self._serving_lru()
-        pk = self.paged_kernel if paged_kernel is None else bool(paged_kernel)
-        key = ("decode_pages", self._pool_tag(caches), pk)
-        fn = self._serving_cache.get(key)
-        if fn is None:
-            fn = jax.jit(
-                _ft.partial(self._decode_pages_fn, paged_kernel=pk),
-                donate_argnums=(4,),
-                out_shardings=(None, self._pool_out_shardings(caches),
-                               self._io_shardings["batch"](None)))
-            fn = self._serving_cache.put(key, fn)
-        return fn(self.params, tok, jnp.asarray(offsets, jnp.int32),
-                  jnp.asarray(block_table, jnp.int32), caches, valid)
+        return self._paged_phase(tok, offsets, block_table, caches, valid,
+                                 paged_kernel=paged_kernel)
 
     # -- multi-adapter (tenancy/) phase fns --------------------------------
 
@@ -1010,43 +1091,22 @@ class ParallelInferenceModel(_ServingBase):
             out.append(tuple(factors))
         return out
 
-    def _decode_pages_lora_fn(self, params, tok, offsets, block_table,
-                              caches, valid, apool, atables,
-                              paged_kernel=False):
-        """The multi-adapter twin of :meth:`_decode_pages_fn` — the SAME
-        phase fn (one copy of the offsets/validity/position math), plus
-        per-slot LoRA deltas gathered from the adapter pool as one
-        ``[B, r, d]`` einsum pair per targeted projection (S-LoRA's batched
-        heterogeneous-adapter decode)."""
-        return self._decode_pages_fn(
-            params, tok, offsets, block_table, caches, valid,
-            adapters=self._gather_adapters(apool, atables),
-            paged_kernel=paged_kernel)
-
     def decode_pages_lora(self, tok, offsets, block_table, caches, valid,
                           apool, atables, paged_kernel=None):
-        """Compiled multi-adapter paged decode step (page pool donated).
-        ``apool`` is the device adapter pool, ``atables`` the per-slot
-        ``[B, adapter_pages]`` int32 page map (all-NULL rows = adapter 0 =
-        exact no-op).  ``paged_kernel`` as on :meth:`decode_pages` — the
-        LoRA deltas land on q/v BEFORE the scatter/attend, so both paths
-        see identical adapted projections."""
-        import functools as _ft
-
-        self._serving_lru()
-        pk = self.paged_kernel if paged_kernel is None else bool(paged_kernel)
-        key = ("decode_pages_lora", self._pool_tag(caches), pk)
-        fn = self._serving_cache.get(key)
-        if fn is None:
-            fn = jax.jit(
-                _ft.partial(self._decode_pages_lora_fn, paged_kernel=pk),
-                donate_argnums=(4,),
-                out_shardings=(None, self._pool_out_shardings(caches),
-                               self._io_shardings["batch"](None)))
-            fn = self._serving_cache.put(key, fn)
-        return fn(self.params, tok, jnp.asarray(offsets, jnp.int32),
-                  jnp.asarray(block_table, jnp.int32), caches, valid,
-                  apool, jnp.asarray(atables, jnp.int32))
+        """Compiled multi-adapter paged decode step (page pool donated) —
+        the ``S = 1`` + adapters member of the :meth:`_paged_step_fn`
+        family (one copy of the offsets/validity/position math), with
+        per-slot LoRA deltas gathered from the adapter pool as one
+        ``[B, r, d]`` einsum pair per targeted projection (S-LoRA's batched
+        heterogeneous-adapter decode).  ``apool`` is the device adapter
+        pool, ``atables`` the per-slot ``[B, adapter_pages]`` int32 page
+        map (all-NULL rows = adapter 0 = exact no-op).  ``paged_kernel``
+        as on :meth:`decode_pages` — the LoRA deltas land on q/v BEFORE
+        the scatter/attend, so both paths see identical adapted
+        projections."""
+        return self._paged_phase(tok, offsets, block_table, caches, valid,
+                                 apool=apool, atables=atables,
+                                 paged_kernel=paged_kernel)
 
     def _context_lora_fn(self, params, ids, valid, apool, atable):
         """Single-request prefill with the request's LoRA adapter applied
@@ -1071,105 +1131,52 @@ class ParallelInferenceModel(_ServingBase):
         return fn(self.params, ids.astype(jnp.int32), valid, apool,
                   jnp.asarray(atable, jnp.int32))
 
-    def _prefill_chunk_pages_fn(self, params, ids, offsets, block_table,
-                                caches, valid):
-        """Prefill one ``[1, Cc]`` prompt chunk of a single slot straight
-        into the page pool — the paged, per-slot generalization of
-        :meth:`_prefill_chunk_fn` (Sarathi-style chunked prefill for the
-        serving engine): token ``s`` scatters into the slot's physical
-        page at logical index ``offsets[0] + s`` through the block table,
-        and attends over the gathered per-row view exactly like
-        :meth:`_decode_pages_fn`.
-
-        ``valid [1, T]`` is the slot's whole-cache key-validity row with
-        the FULL prompt's (left-padded) validity pre-written and zeros
-        beyond it; chunk token positions are global prefix counts of that
-        mask, so RoPE phases match the one-shot ``prefill_one`` exactly,
-        and keys beyond the chunk are causally masked (q offset = cache
-        offset) so the not-yet-written tail contributes nothing.  Returns
-        the chunk's last-position logits (the final chunk's are the
-        prefill logits the first token samples from) and the updated
-        pool."""
-        Cc = ids.shape[1]
-        T = valid.shape[1]
-        counts = jnp.cumsum(valid, axis=1) - valid  # valid keys strictly before
-        idx = offsets[:, None] + jnp.arange(Cc)[None, :]  # [1, Cc]
-        positions = jnp.take_along_axis(counts, jnp.clip(idx, 0, T - 1), axis=1)
-        logits, caches = self.module.apply(
-            params, ids, positions.astype(jnp.int32), caches, offsets,
-            kv_valid=valid, block_table=block_table,
-        )
-        return logits[:, -1, :], caches
-
-    def prefill_chunk_pages(self, ids, offset, block_table, caches, valid):
-        """Compiled paged chunk prefill (pool donated), lazily jitted per
-        chunk width ``Cc`` — one program serves every chunk of that width
-        at any offset of any slot.  ``ids [1, Cc]`` is the chunk's (padded)
-        prompt slice, ``offset`` the scalar cache index its first token
-        writes at, ``block_table [1, PP]`` the slot's logical→physical page
-        map, ``valid [1, T]`` the slot's full-prompt validity row."""
-        self._serving_lru()
-        key = ("prefill_chunk_pages", self._pool_tag(caches),
-               int(ids.shape[1]))
-        fn = self._serving_cache.get(key)
-        if fn is None:
-            fn = jax.jit(self._prefill_chunk_pages_fn, donate_argnums=(4,),
-                         out_shardings=(None, self._pool_out_shardings(caches)))
-            fn = self._serving_cache.put(key, fn)
-        return fn(self.params, ids.astype(jnp.int32),
-                  jnp.asarray([offset], jnp.int32),
-                  jnp.asarray(block_table, jnp.int32), caches,
-                  jnp.asarray(valid, jnp.int32))
-
-    def _verify_pages_fn(self, params, toks, offsets, block_table, caches,
-                         valid, paged_kernel=False):
-        """Score a ``[B, S]`` chunk at PER-SLOT offsets against the page
-        pool — the batched target-verification step of speculative decoding
-        (the per-slot generalization of :meth:`_score_chunk_fn`): token
-        ``s`` of slot ``b`` is written at cache index ``offsets[b] + s``
-        (the model's multi-token block-table scatter) and position ``i``'s
-        logits judge the draft's proposal ``i+1`` — the shifted-logits
-        verification trick.  An offset of ``T`` parks an idle slot (all its
-        writes drop, its logits are garbage the caller ignores).  Returns
-        ``(logits [B, S, V], caches, valid)``."""
-        B, S = toks.shape
-        T = valid.shape[1]
-        idx = offsets[:, None] + jnp.arange(S)[None, :]  # [B, S] write indices
-        hot = jnp.any(jnp.arange(T)[None, None, :] == idx[:, :, None], axis=1)
-        valid = jnp.where(hot, 1, valid)  # the chunk's tokens become keys
-        counts = jnp.cumsum(valid, axis=1) - valid  # valid keys strictly before
-        positions = jnp.take_along_axis(counts, jnp.clip(idx, 0, T - 1), axis=1)
-        extra = {"paged_kernel": True} if paged_kernel else {}
-        logits, caches = self.module.apply(
-            params, toks, positions.astype(jnp.int32), caches, offsets,
-            kv_valid=valid, block_table=block_table, **extra,
-        )
-        return logits, caches, valid
+    def prefill_chunk_pages(self, ids, offset, block_table, caches, valid,
+                            apool=None, atables=None, paged_kernel=None):
+        """Compiled paged chunk prefill (pool donated) — the ``S = Cc``,
+        ``update_valid=False`` member of the :meth:`_paged_step_fn` family
+        (Sarathi-style chunked prefill for the serving engine), lazily
+        jitted per chunk width ``Cc`` so one program serves every chunk of
+        that width at any offset of any slot.  ``ids [1, Cc]`` is the
+        chunk's (padded) prompt slice, ``offset`` the scalar cache index
+        its first token writes at, ``block_table [1, PP]`` the slot's
+        logical→physical page map, ``valid [1, T]`` the slot's whole-cache
+        key-validity row with the FULL prompt's (left-padded) validity
+        pre-written and zeros beyond it: chunk token positions are global
+        prefix counts of that mask, so RoPE phases match the one-shot
+        ``prefill_one`` exactly, and keys beyond the chunk are causally
+        masked (q offset = cache offset) so the not-yet-written tail
+        contributes nothing.  ``apool``/``atables`` prefill an adapter
+        request's chunks with its LoRA deltas applied (the tenancy
+        composition); ``paged_kernel`` walks the pool via the in-kernel
+        chunked-prefill path instead of the O(T) gather.  Returns the
+        chunk's last-position logits (the final chunk's are the prefill
+        logits the first token samples from) and the updated pool."""
+        logits, caches, _ = self._paged_phase(
+            ids, jnp.asarray([offset], jnp.int32), block_table, caches,
+            valid, apool=apool, atables=atables, paged_kernel=paged_kernel,
+            update_valid=False, last_only=True)
+        return logits, caches
 
     def verify_pages(self, toks, offsets, block_table, caches, valid,
-                     paged_kernel=None):
+                     apool=None, atables=None, paged_kernel=None):
         """Compiled batched speculative-verification step (page pool
-        donated), lazily jitted per chunk width ``S = k + 1`` so one program
-        serves every round at a given draft depth.  Outputs pinned to the
-        AOT executables' shardings like :meth:`decode_pages`.
-        ``paged_kernel`` as there — the verification chunk is the same
-        block-table-native kernel with ``S = k + 1`` query rows."""
-        import functools as _ft
-
-        self._serving_lru()
-        pk = self.paged_kernel if paged_kernel is None else bool(paged_kernel)
-        key = ("verify_pages", int(toks.shape[1]), pk)
-        fn = self._serving_cache.get(key)
-        if fn is None:
-            fn = jax.jit(
-                _ft.partial(self._verify_pages_fn, paged_kernel=pk),
-                donate_argnums=(4,),
-                out_shardings=(None, self._pool_out_shardings(caches),
-                               self._io_shardings["batch"](None)))
-            fn = self._serving_cache.put(key, fn)
-        return fn(self.params, toks.astype(jnp.int32),
-                  jnp.asarray(offsets, jnp.int32),
-                  jnp.asarray(block_table, jnp.int32), caches, valid)
+        donated) — the ``S = k + 1``, ``last_only=False`` member of the
+        :meth:`_paged_step_fn` family, lazily jitted per chunk width so one
+        program serves every round at a given draft depth: token ``s`` of
+        slot ``b`` is written at cache index ``offsets[b] + s`` (the
+        model's multi-token block-table scatter — requantizing per page on
+        int8 pools) and position ``i``'s logits judge the draft's proposal
+        ``i+1`` — the shifted-logits verification trick.  An offset of
+        ``T`` parks an idle slot (writes drop, logits are garbage the
+        caller ignores).  ``apool``/``atables`` make the verify
+        adapter-aware (spec × tenancy: the chunk is scored under each
+        slot's OWN adapter, exactly as its solo decode would sample);
+        ``paged_kernel`` as on :meth:`decode_pages`.  Returns
+        ``(logits [B, S, V], caches, valid)``."""
+        return self._paged_phase(toks, offsets, block_table, caches, valid,
+                                 apool=apool, atables=atables,
+                                 paged_kernel=paged_kernel, last_only=False)
 
     def _write_page_fn(self, caches, row_caches, lp, phys):
         """Write logical page ``lp`` of a prefilled one-row cache into
@@ -1183,10 +1190,16 @@ class ParallelInferenceModel(_ServingBase):
 
         return jax.tree.map(wr, caches, row_caches)
 
-    def _write_page_quant_fn(self, caches, row_caches, lp, phys):
+    def _write_page_quant_fn(self, caches, row_caches, lp, phys,
+                             row_valid=None):
         """Quantize-on-write prefill page write: the fp row-cache chunk is
         quantized per page (scale/zero computed from the page content) and
-        the int8 payload + page params land at ``phys``."""
+        the int8 payload + page params land at ``phys``.  ``row_valid``
+        (the request's ``[C]`` validity row) zeroes INVALID cells — a
+        left-pad row's hidden states are masked-attention garbage, and
+        letting them into the page would pollute its quantization scale;
+        zeroing matches the chunk scatter's valid-masked commit exactly,
+        so chunked and whole int8 prefills quantize identical pages."""
         from neuronx_distributed_tpu.kvcache.quant import quantize_page
 
         out = []
@@ -1196,6 +1209,10 @@ class ParallelInferenceModel(_ServingBase):
             def one(cq, sc, zp, r):
                 chunk = jax.lax.dynamic_slice_in_dim(
                     r, lp * page, page, axis=1)[0]  # [page, NKV, D]
+                if row_valid is not None:
+                    v = jax.lax.dynamic_slice_in_dim(
+                        row_valid, lp * page, page, axis=0)
+                    chunk = chunk * (v > 0)[:, None, None].astype(chunk.dtype)
                 q2, s2, z2 = quantize_page(chunk)
                 cq = jax.lax.dynamic_update_slice(
                     cq, q2[None], (phys, 0, 0, 0))
@@ -1208,22 +1225,30 @@ class ParallelInferenceModel(_ServingBase):
             out.append((ck, cv, ks, kz, vs, vz))
         return out
 
-    def write_page(self, caches, row_caches, logical_page, phys_page):
+    def write_page(self, caches, row_caches, logical_page, phys_page,
+                   row_valid=None):
         """Compiled page-aligned prefill write (pool donated): page
         ``logical_page`` of the ``prefill_one`` row caches lands in pool
         page ``phys_page``.  Cached-prefix pages are simply never written —
         the caller skips them entirely.  A quantized pool quantizes on
-        write (per-page scale/zero from the page content)."""
+        write (per-page scale/zero from the page content), with
+        ``row_valid`` zero-masking invalid (left-pad) cells out of the
+        scale; the fp pool ignores ``row_valid`` (garbage cells are never
+        attended and couple to nothing)."""
         self._serving_lru()
-        key = ("write_page", self._pool_tag(caches))
+        quant = self._pool_tag(caches) == "int8"
+        masked = quant and row_valid is not None
+        key = ("write_page", self._pool_tag(caches), masked)
         fn = self._serving_cache.get(key)
         if fn is None:
-            impl = (self._write_page_quant_fn
-                    if self._pool_tag(caches) == "int8"
-                    else self._write_page_fn)
+            impl = self._write_page_quant_fn if quant else self._write_page_fn
             fn = jax.jit(impl, donate_argnums=(0,),
                          out_shardings=self._pool_out_shardings(caches))
             fn = self._serving_cache.put(key, fn)
+        if masked:
+            return fn(caches, row_caches, jnp.int32(logical_page),
+                      jnp.int32(phys_page),
+                      jnp.asarray(row_valid, jnp.int32))
         return fn(caches, row_caches, jnp.int32(logical_page),
                   jnp.int32(phys_page))
 

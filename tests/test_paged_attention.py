@@ -176,7 +176,12 @@ def test_defaults_lookup_and_resolution():
     assert resolve_paged_kernel(True) is True
     assert resolve_paged_kernel(False) is False
     assert resolve_paged_kernel("auto") is (jax.default_backend() == "tpu")
-    assert resolve_paged_kernel("auto", tensor_parallel=8) is False
+    # tp > 1 no longer forces the gather path — the kernel is shard_mapped
+    # over the kv-head axis, so auto resolves on backend alone and an
+    # explicit True is honored on any mesh
+    assert resolve_paged_kernel("auto", tensor_parallel=8) is (
+        jax.default_backend() == "tpu")
+    assert resolve_paged_kernel(True, tensor_parallel=8) is True
     with pytest.raises(ValueError, match="paged_kernel"):
         resolve_paged_kernel("yes")
     with pytest.raises(ValueError, match="six-tuple"):

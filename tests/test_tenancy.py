@@ -727,11 +727,13 @@ def test_int8_engine_e2e_and_quant_accounting(tenancy_pool):
     assert engine._kv.alloc.in_use == 0 or engine._kv.index is not None
 
 
-def test_spec_does_not_compose_with_tenancy(tenancy_pool):
+def test_engine_validation_raises(tenancy_pool):
+    """The surviving up-front validations: adapters need the paged engine,
+    and only int8 KV quantization exists.  (spec × kv_quant and
+    spec × adapter_store used to be refused here too — they are now one
+    parameterization of the shared paged phase-fn family; the composition
+    matrix in test_compose_serving.py covers them end to end.)"""
     cfg, module, params, pool = tenancy_pool
-    with pytest.raises(ValueError, match="does not compose"):
-        ServingEngine(pool, page_size=4, num_pages=16, draft=pool, spec_k=2,
-                      kv_quant="int8")
     with pytest.raises(ValueError, match="paged engine"):
         ServingEngine(pool, adapter_store=_model_store(pool))
     with pytest.raises(ValueError, match="int8"):

@@ -12,7 +12,11 @@ in a short healthy tunnel window and its numbers justify (or refute) the
 (page, pages_per_slot, kv_heads, head_dim, quant) shape key and prints a
 ``defaults_entry`` line in exactly the `SHAPE_DEFAULTS` table format the
 kernel consults — run it per serving shape on silicon and commit the
-winning entries.
+winning entries.  With ``--chunk-width S`` (S > 1: in-kernel chunked
+prefill and speculative verify) the key grows a sixth element and the
+``defaults_entry`` targets the `CHUNK_SHAPE_DEFAULTS` table instead —
+wide chunks amortize grid overhead differently, so they get their own
+committed entries rather than reusing the S = 1 decode winner.
 
 Usage:
     python tools/flash_autotune.py                 # flash bench shape, TPU
@@ -117,7 +121,10 @@ def run_paged(args) -> int:
 
     bps = divisors(PP, [1, 2, 4, 8, 16])
     results = []
-    key = [page, PP, NKV, D, quant]
+    # S = 1 tunes the decode table; S > 1 (chunked prefill / spec verify)
+    # tunes the six-tuple CHUNK_SHAPE_DEFAULTS key at this pool geometry
+    key = [page, PP, NKV, D, quant] + ([S] if S > 1 else [])
+    table = "CHUNK_SHAPE_DEFAULTS" if S > 1 else "SHAPE_DEFAULTS"
     for bp in bps:
         for sk in divisors(PP // bp, [1, 2, 4, 8]):
             fn = jax.jit(lambda q_, bp=bp, sk=sk: paged_attention(
@@ -139,9 +146,10 @@ def run_paged(args) -> int:
     ok = [r for r in results if "error" not in r]
     if ok:
         best = min(ok, key=lambda r: r["decode_ms"])
-        # the SHAPE_DEFAULTS entry to commit (ops/paged_attention.py)
+        # the defaults-table entry to commit (ops/paged_attention.py)
         print(json.dumps({
             "defaults_entry": {
+                "table": table,
                 "key": key,
                 "block_pages": best["block_pages"],
                 "split_k": best["split_k"],

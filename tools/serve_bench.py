@@ -37,6 +37,16 @@ Three modes:
   holds interactive inter-token p99 within 2x the baseline WHILE the
   control spikes past that bound.
 
+- `--compose`: every serving feature through ONE engine on a tp=2 mesh —
+  speculative decoding (draft == target), int8 KV pages, co-batched LoRA
+  adapters, chunked + priority prefill and the paged-attention kernel
+  substrate — over a mixed interactive/batch workload.  The warm engine
+  replays the identical workload first, so the measured window must
+  compile NOTHING.  One JSON line; rc 1 on any refused admission, any
+  unfinished request, any post-warmup compile (a compile storm), or
+  nonzero `kvcache/gather_bytes_total` (a phase fell off the kernel
+  substrate).  Wired into `tpu_watch` as the `serving_compose` job.
+
 ``--trace-out DIR`` (engine rungs: `--continuous`, `--slo`) attaches a
 request-lifecycle tracer to every measured engine and drops one
 schema-checked `<rung>.trace_events.jsonl` + one Perfetto-loadable
@@ -1061,6 +1071,185 @@ def run_spec(args, module, params, cfg, icfg) -> int:
     return rc
 
 
+def run_compose(args, module, params, cfg, icfg) -> int:
+    """Every serving feature through ONE engine on a tp=2 mesh —
+    speculative decoding (draft == target), int8 KV pages, co-batched
+    LoRA adapters, chunked + priority prefill, and the paged-attention
+    kernel substrate — the zero-refused-pairs contract made executable.
+
+    The warm engine replays the IDENTICAL workload first (same prompts,
+    same adapters, same chunk widths), so every phase-fn parameterization
+    the measured window hits is compiled up front; the measured engine
+    then declares warmup done.  One JSON line; rc 1 on any refused
+    admission (``serving/rejected_total`` nonzero), any unfinished
+    request, any compile past the declared warmup (a compile storm
+    inside the measured window), or a nonzero
+    ``kvcache/gather_bytes_total`` (some phase fell off the kernel
+    substrate back onto the gather path)."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.obs import MetricRegistry
+    from neuronx_distributed_tpu.parallel.mesh import get_tensor_parallel_size
+    from neuronx_distributed_tpu.serving import (
+        Request, ServingEngine, poisson_arrivals, replay_trace)
+    from neuronx_distributed_tpu.tenancy import AdapterLayout, make_adapter_store
+    from neuronx_distributed_tpu.trace import ParallelInferenceModel
+
+    B, C, T = args.batch_size, args.context_len, args.max_total_len
+    page = args.page_size
+    if C % page or T % page:
+        raise SystemExit(f"--page-size {page} must divide --context-len {C} "
+                         f"and --max-total-len {T}")
+    chunk = args.slo_chunk or max(page, (C // 8) // page * page)
+    if chunk % page:
+        raise SystemExit(f"--slo-chunk {chunk} must be a multiple of "
+                         f"--page-size {page}")
+    spec_k = 2
+    if C + args.max_new_tokens + spec_k > T:
+        raise SystemExit(
+            f"--context-len {C} + --max-new-tokens {args.max_new_tokens} + "
+            f"k {spec_k} exceeds --max-total-len {T}")
+    num_pages = B * (T // page) + 1
+    model = ParallelInferenceModel(module, params, icfg)
+
+    A = 2  # distinct co-batched adapters (plus the base-model id 0)
+    rank = 2
+    H, NQ, NKV, D = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                     cfg.head_dim_)
+
+    def random_adapter(seed):
+        r2 = np.random.RandomState(seed)
+        return [{
+            "a_q": (r2.randn(H, rank) * 0.05).astype(np.float32),
+            "b_q": (r2.randn(rank, NQ * D) * 0.05).astype(np.float32),
+            "a_v": (r2.randn(H, rank) * 0.05).astype(np.float32),
+            "b_v": (r2.randn(rank, NKV * D) * 0.05).astype(np.float32),
+        } for _ in range(cfg.num_layers)]
+
+    def make_store():
+        per = AdapterLayout.for_model(model, rank, 2048).pages_per_adapter
+        store = make_adapter_store(model, rank=rank,
+                                   num_pages=A * per + 1, page_elems=2048)
+        for aid in range(1, A + 1):
+            store.register(aid, random_adapter(args.seed + aid), alpha=8.0)
+        return store
+
+    # mixed workload: short interactive prompts (whole or single-chunk
+    # prefill) interleaved with full-context batch-tier prompts (multi-
+    # chunk prefill), adapters round-robined over {base, 1..A}
+    rs = np.random.RandomState(args.seed)
+    n = args.num_requests
+    prompts, prios = [], []
+    for i in range(n):
+        if i % 4 == 3:
+            prompts.append(rs.randint(1, cfg.vocab_size, size=C).tolist())
+            prios.append("batch")
+        else:
+            prompts.append(rs.randint(
+                1, cfg.vocab_size,
+                size=rs.randint(max(2, C // 8), max(3, C // 2))).tolist())
+            prios.append("interactive")
+    arrivals = poisson_arrivals(n, args.arrival_rate, rs)
+
+    def requests(base_id):
+        return [Request(request_id=base_id + i, prompt_ids=prompts[i],
+                        max_new_tokens=args.max_new_tokens,
+                        adapter_id=i % (A + 1), priority=prios[i])
+                for i in range(n)]
+
+    led, mem = _make_ledgers(args)
+    kw = dict(page_size=page, num_pages=num_pages, draft=model,
+              spec_k=spec_k, kv_quant="int8", prefill_chunk_tokens=chunk,
+              paged_kernel=True)
+    # the warm pass replays the identical workload, so every phase-fn
+    # parameterization (chunk widths, spec rounds, adapter tables, the
+    # masked quantized page writer) compiles before measurement begins
+    warm = ServingEngine(model, registry=MetricRegistry(),
+                         compile_ledger=led, adapter_store=make_store(), **kw)
+    replay_trace(warm, np.zeros(n), requests(1 << 20))
+    warm.close()
+    del warm
+
+    engine = ServingEngine(model, registry=MetricRegistry(),
+                           compile_ledger=led, memory_ledger=mem,
+                           adapter_store=make_store(), **kw)
+    engine.declare_warmup_done()
+    peak_adapters = [0]
+    orig_step = engine.step
+
+    def step():
+        out = orig_step()
+        live = {engine._slot_adapter[s]
+                for s, _ in engine.scheduler.active()
+                if engine._slot_adapter[s]}
+        peak_adapters[0] = max(peak_adapters[0], len(live))
+        return out
+
+    engine.step = step
+    outputs, wall, peak = _drive_workload(engine, arrivals, requests(0))
+    engine.close()
+    snap = engine.registry.snapshot()
+
+    total_tokens = sum(len(o.token_ids) for o in outputs.values())
+    ttfts = [o.ttft_ms for o in outputs.values() if o.ttft_ms is not None]
+    inter = [ms for o in outputs.values() for ms in o.intertoken_ms]
+    rounds = snap.get("serving/spec_rounds_total", 0.0)
+    committed = snap.get("serving/spec_committed_total", 0.0)
+    rec = {
+        "metric": "serving_compose",
+        "tp": get_tensor_parallel_size(),
+        "features": ["spec", "kv_quant", "lora", "chunked_prefill",
+                     "paged_kernel"],
+        "spec_k": spec_k,
+        "adapters": A,
+        "chunk_tokens": chunk,
+        "num_requests": n,
+        "finished": sum(1 for o in outputs.values()
+                        if o.state == "finished"),
+        "rejected": snap.get("serving/rejected_total", 0.0),
+        "gather_bytes": snap.get("kvcache/gather_bytes_total", 0.0),
+        "quant_page_writes": snap.get("kvcache/quant_pages_total", 0.0),
+        "prefill_chunks": snap.get("serving/prefill_chunks_total", 0.0),
+        "tokens_per_step": round(committed / rounds, 4) if rounds else None,
+        "max_adapters_cobatched": peak_adapters[0],
+        "max_concurrent": peak,
+        "ttft_ms": _percentiles(ttfts),
+        "intertoken_ms": _percentiles(inter),
+        "goodput_tok_s": total_tokens / max(wall, 1e-9),
+        "wall_s": round(wall, 4),
+        **_ledger_fields(led, mem, args, "compose"),
+    }
+    print(json.dumps({**rec, "config": {
+        "batch": B, "context": C, "max_total": T,
+        "max_new": args.max_new_tokens, "page_size": page}}))
+
+    rc = 0
+    if rec["finished"] != n:
+        print(f"serve_bench: compose finished {rec['finished']} of {n} "
+              "requests", file=sys.stderr)
+        rc = 1
+    if rec["rejected"] > 0:
+        print(f"serve_bench: compose refused {rec['rejected']} "
+              "admission(s) — the zero-refused-pairs contract is broken",
+              file=sys.stderr)
+        rc = 1
+    if rec["compiles_during_measurement"] > 0:
+        print(f"serve_bench: {rec['compiles_during_measurement']} "
+              "compile(s) inside the measured window — a compile storm "
+              "(some feature pair missed the warm replay)", file=sys.stderr)
+        rc = 1
+    if rec["gather_bytes"] > 0:
+        print(f"serve_bench: compose moved {rec['gather_bytes']} gather "
+              "bytes — some phase fell off the kernel substrate",
+              file=sys.stderr)
+        rc = 1
+    if rec["prefill_chunks"] <= 0:
+        print("serve_bench: compose dispatched no prefill chunks",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def run_paged_kernel(args, module, params, cfg, icfg) -> int:
     """Block-table-native decode kernel vs the [B, T] gather path: decode
     step cost at a FIXED real context across growing ``max_total_len``.
@@ -1239,6 +1428,12 @@ def main() -> int:
     p.add_argument("--lora-adapters", type=int, default=8,
                    help="distinct adapters the --lora rung registers and "
                         "round-robins requests across")
+    p.add_argument("--compose", action="store_true",
+                   help="composition mode: speculative decoding + int8 KV "
+                        "+ LoRA adapters + chunked/priority prefill + the "
+                        "paged kernel through ONE engine on a tp=2 mesh "
+                        "(one JSON line; rc 1 on any refused admission, "
+                        "any compile past warmup, or nonzero gather bytes)")
     p.add_argument("--paged-kernel", action="store_true",
                    help="paged decode kernel mode: block-table-native "
                         "kernel vs the [B, T] gather path at a fixed real "
@@ -1293,6 +1488,14 @@ def main() -> int:
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
+    if args.compose:
+        # the compose rung runs tp=2 even on the CPU mesh — force a second
+        # host device before jax initializes (no-op when already set)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2").strip()
+
     import jax
 
     if args.tiny:
@@ -1318,7 +1521,8 @@ def main() -> int:
         print("refusing to record a non-TPU serving number; use --tiny for "
               "a CPU harness smoke", file=sys.stderr)
         return 1
-    nxd.initialize_model_parallel(tensor_parallel_size=1, devices=devices[:1])
+    tp = 2 if args.compose and len(devices) >= 2 else 1
+    nxd.initialize_model_parallel(tensor_parallel_size=tp, devices=devices[:tp])
 
     if args.continuous and args.batch_size == 1:
         # a 1-slot pool degenerates to serial serving — not a continuous-
@@ -1348,6 +1552,12 @@ def main() -> int:
         args.batch_size = 2
         print("serve_bench: --kv-quant with --batch-size 1 is a degenerate "
               "concurrency comparison; using batch size 2", file=sys.stderr)
+    if args.compose and args.batch_size < 3:
+        # composition needs co-batched slots: spec rounds, adapter
+        # co-residency and chunked prefills all landing in one batch
+        args.batch_size = 3
+        print("serve_bench: --compose needs co-batched requests; using "
+              "batch size 3", file=sys.stderr)
     if args.slo and args.batch_size < 3:
         # the stall under test needs interactive decodes CO-BATCHED with a
         # long prompt's prefill
@@ -1393,6 +1603,8 @@ def main() -> int:
         max_total_len=args.max_total_len,
         kv_cache_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
     )
+    if args.compose:
+        return run_compose(args, module, params, cfg, icfg)
     if args.paged_kernel:
         return run_paged_kernel(args, module, params, cfg, icfg)
     if args.paged:

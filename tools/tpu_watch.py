@@ -358,6 +358,13 @@ def run_extra_jobs(results_path: str) -> None:
         ("serving_kv_quant", [sys.executable,
                               os.path.join(REPO, "tools", "serve_bench.py"),
                               "--kv-quant"]),
+        # feature composition: spec + int8 KV + LoRA + chunked prefill +
+        # the paged kernel through ONE engine at tp=2 — rc 1 on any
+        # refused admission, any post-warmup compile (compile storm) or
+        # nonzero gather bytes (a phase off the kernel substrate)
+        ("serving_compose", [sys.executable,
+                             os.path.join(REPO, "tools", "serve_bench.py"),
+                             "--compose"]),
         # block-table-native paged decode kernel vs the [B, T] gather path:
         # on silicon the gate runs on MEASURED step wall-time — rc 1 unless
         # the kernel's decode step is flat in max_total_len (<= 1.3x
