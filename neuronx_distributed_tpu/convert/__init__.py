@@ -4,8 +4,11 @@
 from neuronx_distributed_tpu.convert.nxd import (  # noqa: F401
     GPT_NEOX_TP_RULES,
     LLAMA_TP_RULES,
+    fuse_split_llama,
     load_nxd_checkpoint,
     merge_tp_shards,
+    save_nxd_checkpoint,
+    shard_for_rank,
     split_fused_llama,
 )
 from neuronx_distributed_tpu.convert.hf import (  # noqa: F401

@@ -292,6 +292,121 @@ def _strip_kv_replication(
     )
 
 
+def shard_for_rank(full: np.ndarray, rank: int, tp: int,
+                   partition_dim: int, stride: int = 1) -> np.ndarray:
+    """Inverse of :func:`merge_tp_shards` for ONE rank: split the full
+    tensor into ``tp * stride`` chunks along ``partition_dim`` and give
+    rank ``r`` chunks ``[r::tp]`` — the reference ``create_local_weight``
+    interleave (``parallel_layers/layers.py:54-62``)."""
+    size = full.shape[partition_dim]
+    if size % (tp * stride) != 0:
+        raise ValueError(
+            f"dim {partition_dim} of size {size} does not divide into "
+            f"tp * stride = {tp} * {stride} chunks")
+    chunks = np.split(full, tp * stride, axis=partition_dim)
+    return np.concatenate(chunks[rank::tp], axis=partition_dim)
+
+
+def fuse_split_llama(state: Dict[str, np.ndarray],
+                     ) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`split_fused_llama`: re-fuse HF-style
+    ``q/k/v_proj`` rows into the reference's ``qkv_proj`` (``[q; k; v]``
+    along dim 0) and ``gate/up_proj`` into ``gate_up_proj`` — the layout
+    the reference's fused modules save, so an exported checkpoint is
+    loadable by a reference model built with fused projections."""
+    out = dict(state)
+    for name in list(out):
+        if name.endswith(".q_proj.weight"):
+            base = name[: -len("q_proj.weight")]
+            q = out.pop(base + "q_proj.weight")
+            k = out.pop(base + "k_proj.weight")
+            v = out.pop(base + "v_proj.weight")
+            out[base + "qkv_proj.weight"] = np.concatenate([q, k, v], axis=0)
+        elif name.endswith(".gate_proj.weight"):
+            base = name[: -len("gate_proj.weight")]
+            g = out.pop(base + "gate_proj.weight")
+            u = out.pop(base + "up_proj.weight")
+            out[base + "gate_up_proj.weight"] = np.concatenate([g, u], axis=0)
+    return out
+
+
+def save_nxd_checkpoint(
+    model_dir: str,
+    state: Dict[str, np.ndarray],
+    tp: int = 1,
+    pp: int = 1,
+    tp_rules: Sequence[Tuple[str, Tuple[int, int]]] = LLAMA_TP_RULES,
+    extra_rules: Optional[Sequence[Tuple[str, Tuple[int, int]]]] = None,
+    kv_size_multiplier: int = 1,
+    pp_assign: Optional[Dict[str, int]] = None,
+    fuse_llama: bool = False,
+) -> List[str]:
+    """Export a full numpy state dict as a reference (neuronx-distributed)
+    per-rank checkpoint directory — the inverse of
+    :func:`load_nxd_checkpoint`, completing the TPU → reference migration
+    direction (train here, serve on the reference stack, or hand a
+    checkpoint back to a reference-pipeline colleague).
+
+    Every ``(tp_rank, pp_rank)`` gets one torch file
+    ``dp_rank_00_tp_rank_{TT}_pp_rank_{PP}.pt`` (``use_xser=False``
+    layout).  Params matching a TP rule are split by the
+    ``create_local_weight`` interleave (:func:`shard_for_rank`, honoring
+    the fused-module ``stride``); unmatched params are replicated
+    bit-identically to every tp rank — exactly the condition the importer
+    checks, so ``load_nxd_checkpoint(save_nxd_checkpoint(...))`` is an
+    identity on the state dict.
+
+    ``kv_size_multiplier > 1`` re-applies the reference's GQA KV
+    replication (``master.repeat(m)`` along dim 0,
+    ``modules/qkv_linear.py:110-115``) to ``weight_k/weight_v/bias_k/
+    bias_v`` entries before sharding — the tiling
+    :func:`_strip_kv_replication` inverts on import.  ``fuse_llama=True``
+    first re-fuses split q/k/v and gate/up entries
+    (:func:`fuse_split_llama`).  ``pp_assign`` maps param names to pp
+    ranks (disjoint subsets; default: everything on pp rank 0).
+
+    Returns the list of file paths written."""
+    import torch  # CPU-only usage
+
+    if tp < 1 or pp < 1:
+        raise ValueError(f"tp and pp must be >= 1 (got tp={tp}, pp={pp})")
+    if fuse_llama:
+        state = fuse_split_llama(state)
+    rules = tuple(extra_rules or ()) + tuple(tp_rules)
+    pp_assign = pp_assign or {}
+    bad = {n: r for n, r in pp_assign.items() if not 0 <= r < pp}
+    if bad:
+        raise ValueError(f"pp_assign ranks out of range [0, {pp}): {bad}")
+
+    # pp rank -> {name: full array}, disjoint by construction
+    per_pp: Dict[int, Dict[str, np.ndarray]] = {p: {} for p in range(pp)}
+    for name, arr in state.items():
+        arr = np.asarray(arr)
+        if (kv_size_multiplier > 1
+                and re.search(r"\.(weight_k|weight_v|bias_k|bias_v)$", name)):
+            arr = np.tile(arr,
+                          (kv_size_multiplier,) + (1,) * (arr.ndim - 1))
+        per_pp[pp_assign.get(name, 0)][name] = arr
+
+    os.makedirs(model_dir, exist_ok=True)
+    written = []
+    for p in range(pp):
+        for t in range(tp):
+            rank_sd = {}
+            for name, arr in per_pp[p].items():
+                ds = rule_for(name, rules)
+                shard = (arr if ds is None
+                         else shard_for_rank(arr, t, tp, ds[0], ds[1]))
+                rank_sd[name] = torch.from_numpy(np.ascontiguousarray(shard))
+            path = os.path.join(
+                model_dir, f"dp_rank_00_tp_rank_{t:02d}_pp_rank_{p:02d}.pt")
+            torch.save(rank_sd, path)
+            written.append(path)
+    logger.info("exported %d params as %d rank files (tp=%d pp=%d) to %s",
+                len(state), len(written), tp, pp, model_dir)
+    return written
+
+
 def split_fused_llama(state: Dict[str, np.ndarray],
                       num_heads: int, num_kv_heads: int, head_dim: int
                       ) -> Dict[str, np.ndarray]:

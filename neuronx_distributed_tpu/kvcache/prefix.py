@@ -280,6 +280,25 @@ class PrefixIndex:
                 stack.append((child, cfp))
         return out
 
+    def flush(self) -> int:
+        """Drop EVERY cached chain at once — the index's reference on each
+        non-NULL page is released (pages active slots or resume pins still
+        hold stay allocated under THEIR references; index-only pages return
+        to the free list).  Returns the number of nodes dropped.
+
+        The live-weight swap path: cached KV (and terminal prefill logits)
+        were computed under the outgoing params, so serving them to a
+        post-swap admission would leak old-version output past the version
+        boundary.  A flush is cheaper than being wrong — the cache re-warms
+        under the new weights."""
+        dropped = self._nodes
+        for node in self._iter():
+            self.alloc.free(node.page)  # no-op on NULL structure pages
+        self._root = _Node(None, NULL_PAGE, None)
+        self._nodes = 0
+        self._version += 1
+        return dropped
+
     # -- eviction ----------------------------------------------------------
 
     def _iter(self) -> Iterator[_Node]:

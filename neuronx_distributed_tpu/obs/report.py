@@ -65,11 +65,18 @@ from neuronx_distributed_tpu.obs.tracing import (
 # the run carried no autopilot), and --compare gates on run B's action
 # rate regressing past A's (a controller that has to act more often
 # under the same workload is flapping or fighting a real regression).
-OBS_REPORT_SCHEMA = "obs_report_v6"
+# v7 (live-weights PR): required "weights" section (weight_swaps.jsonl
+# rollup — swap/failure counts by source, per-replica version table with
+# a monotonicity check, swap-latency stats; null when the run carried no
+# swapper), and --compare gates on swap failures appearing in B when A's
+# swaps all committed (a deploy pipeline that starts refusing envelopes
+# under the same workload is a release regression).
+OBS_REPORT_SCHEMA = "obs_report_v7"
 SUPERVISOR_EVENTS_FILE = "supervisor_events.jsonl"
 SERVING_STATS_FILE = "serving_stats.jsonl"
 ROUTER_STATS_FILE = "router_stats.jsonl"
 AUTOPILOT_ACTIONS_FILE = "autopilot_actions.jsonl"
+WEIGHT_SWAPS_FILE = "weight_swaps.jsonl"
 
 
 def _read_scalar_file(path: str) -> List[dict]:
@@ -482,9 +489,13 @@ def compare_resources(run_a: str, run_b: str,
     ``(1 + autopilot_threshold) * A``'s (a controller that has to act
     more often under the same workload is flapping, or fighting a real
     regression upstream of it; actions appearing in B when A's autopilot
-    never acted regress threshold-free).  Returns ``{"a", "b",
-    "compile", "memory", "alerts", "perf", "autopilot", "regressions",
-    "regressed", "markdown"}``."""
+    never acted regress threshold-free).  ``*weight_swaps.jsonl`` adds
+    the deploy gates: swap FAILURES appearing in B when every swap in A
+    committed, and any replica whose weights_version went non-monotonic
+    (both threshold-free — a refused envelope or a version rollback under
+    the same deploy pipeline is a release regression, not noise).
+    Returns ``{"a", "b", "compile", "memory", "alerts", "perf",
+    "autopilot", "weights", "regressions", "regressed", "markdown"}``."""
     def load(run_dir):
         cl_path = os.path.join(run_dir, COMPILE_LEDGER_FILE)
         mb_path = os.path.join(run_dir, MEMORY_BREAKDOWN_FILE)
@@ -500,10 +511,12 @@ def compare_resources(run_a: str, run_b: str,
             glob.glob(os.path.join(run_dir, f"*{PERF_ATTRIBUTION_FILE}")))))
         autopilot = summarize_autopilot(sorted(glob.glob(
             os.path.join(run_dir, f"*{AUTOPILOT_ACTIONS_FILE}"))))
-        return compile_sum, breakdown, alerts, perf, autopilot
+        weights = summarize_weights(sorted(glob.glob(
+            os.path.join(run_dir, f"*{WEIGHT_SWAPS_FILE}"))))
+        return compile_sum, breakdown, alerts, perf, autopilot, weights
 
-    ca, ma, aa, perf_a, ap_a = load(run_a)
-    cb, mb, ab, perf_b, ap_b = load(run_b)
+    ca, ma, aa, perf_a, ap_a, wt_a = load(run_a)
+    cb, mb, ab, perf_b, ap_b, wt_b = load(run_b)
     regressions: List[str] = []
     lines = ["# Resource regression diff", "",
              f"- A: `{run_a}`", f"- B: `{run_b}`", ""]
@@ -624,6 +637,31 @@ def compare_resources(run_a: str, run_b: str,
                 f"autopilot regressed: {na} -> {nb} action(s) "
                 f"(threshold {autopilot_threshold:.0%})")
 
+    if wt_a is not None or wt_b is not None:
+        lines += ["## Weights (live swaps)", "",
+                  "| metric | A | B |", "|---|---|---|"]
+        for key in ("swaps", "failures", "monotonic"):
+            va = wt_a.get(key) if wt_a else None
+            vb = wt_b.get(key) if wt_b else None
+            fmt = lambda v: "n/a" if v is None else str(v)
+            lines.append(f"| {key} | {fmt(va)} | {fmt(vb)} |")
+        lines.append("")
+    if wt_b is not None:
+        # both gates are threshold-free: a deploy pipeline that starts
+        # refusing envelopes (when A's swaps all committed), or ANY
+        # version rollback, is a release regression
+        if wt_a is not None and wt_a["failures"] == 0 \
+                and wt_b["failures"] > 0:
+            regressions.append(
+                f"weights regressed: {wt_b['failures']} swap failure(s) "
+                "in B, none in A")
+        if not wt_b["monotonic"]:
+            bad = sorted(rid for rid, rep in wt_b["replicas"].items()
+                         if not rep["monotonic"])
+            regressions.append(
+                "weights regressed: weights_version went non-monotonic "
+                f"in B (replica(s) {', '.join(bad)})")
+
     if regressions:
         lines += ["## Regressions", ""] + [f"- {r}" for r in regressions] \
             + [""]
@@ -641,6 +679,7 @@ def compare_resources(run_a: str, run_b: str,
         "alerts": {"a": aa, "b": ab},
         "perf": {"a": ra, "b": rb},
         "autopilot": {"a": ap_a, "b": ap_b},
+        "weights": {"a": wt_a, "b": wt_b},
         "regressions": regressions,
         "regressed": bool(regressions),
         "markdown": "\n".join(lines),
@@ -782,11 +821,82 @@ def summarize_autopilot(paths: Sequence[str],
     }
 
 
+def summarize_weights(paths: Sequence[str],
+                      tail: int = 20) -> Optional[dict]:
+    """The "weights" section: roll every ``weight_swaps.jsonl`` stream
+    (solo engines write one; a fleet rolling update writes one per
+    replica) into committed/failed swap counts by source, swap-latency
+    stats, and a per-replica version table with a monotonicity check —
+    the invariant a live deploy must never break.  Returns None when no
+    swap files exist (the report key is null, not {}) — an
+    existing-but-quiet file reports zero swaps (an engine that installed
+    a swapper and never deployed is distinct from no swapper at all)."""
+    records: List[dict] = []
+    files = 0
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        files += 1
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    records.append(json.loads(line))
+    if not files:
+        return None
+    records.sort(key=lambda r: r.get("mono", 0.0))
+    by_source: Dict[str, int] = {}
+    replicas: Dict[int, dict] = {}
+    swaps = failures = 0
+    ms: List[float] = []
+    for r in records:
+        rid = int(r.get("replica", -1))
+        rep = replicas.setdefault(rid, {
+            "swaps": 0, "failures": 0, "version": 0, "monotonic": True})
+        src = r.get("source", "?")
+        if r.get("ok"):
+            swaps += 1
+            by_source[src] = by_source.get(src, 0) + 1
+            v = int(r.get("version", 0))
+            if v <= rep["version"]:
+                rep["monotonic"] = False
+            rep["version"] = max(rep["version"], v)
+            rep["swaps"] += 1
+            if r.get("swap_ms") is not None:
+                ms.append(float(r["swap_ms"]))
+        else:
+            failures += 1
+            rep["failures"] += 1
+    slim = [{"mono": r.get("mono", 0.0),
+             "event": r.get("event", "?"),
+             "version": r.get("version", 0),
+             "source": r.get("source", "?"),
+             "ok": bool(r.get("ok")),
+             "swap_ms": r.get("swap_ms"),
+             "error": r.get("error"),
+             "replica": r.get("replica", -1)} for r in records]
+    return {
+        "files": files,
+        "swaps": swaps,
+        "failures": failures,
+        "by_source": dict(sorted(by_source.items())),
+        "replicas": {str(rid): rep
+                     for rid, rep in sorted(replicas.items())},
+        "monotonic": all(rep["monotonic"] for rep in replicas.values()),
+        "swap_ms_mean": (round(sum(ms) / len(ms), 3) if ms else None),
+        "swap_ms_max": (round(max(ms), 3) if ms else None),
+        "last": slim[-1] if slim else None,
+        "tail": slim[-tail:],
+    }
+
+
 def read_serving_stats(path: str) -> List[dict]:
     """Read a ``serving_stats.jsonl`` stream ACROSS schema versions: v4
     records (pre-tracing) lack ``decode_steps``/``prefill_chunks``/
-    ``preempted_ms``/``trace_id``/``mono``; they are filled with defaults
-    so downstream consumers never branch on the version."""
+    ``preempted_ms``/``trace_id``/``mono``, v5 records (pre-live-weights)
+    lack ``weights_version``; they are filled with defaults so downstream
+    consumers never branch on the version (version 0 is exactly right for
+    a pre-swap-era record: the process-start weights)."""
     out: List[dict] = []
     with open(path) as f:
         for line in f:
@@ -799,6 +909,7 @@ def read_serving_stats(path: str) -> List[dict]:
             rec.setdefault("preempted_ms", 0.0)
             rec.setdefault("trace_id", None)
             rec.setdefault("mono", None)
+            rec.setdefault("weights_version", 0)
             out.append(rec)
     return out
 
@@ -946,6 +1057,7 @@ def build_report(
     router_stats_path: Optional[str] = None,
     perf_paths: Sequence[str] = (),
     autopilot_paths: Sequence[str] = (),
+    weights_paths: Sequence[str] = (),
     tail: int = 10,
 ) -> dict:
     """Merge the artifacts into one summary document.
@@ -966,6 +1078,7 @@ def build_report(
     alerts_paths = list(alerts_paths)
     perf_paths = list(perf_paths)
     autopilot_paths = list(autopilot_paths)
+    weights_paths = list(weights_paths)
     serving_stats_paths = ([serving_stats_path]
                            if serving_stats_path else [])
     fleet_scalar_streams: List[List[dict]] = []
@@ -994,6 +1107,10 @@ def build_report(
                     os.path.join(sub, f"*{PERF_ATTRIBUTION_FILE}"))):
                 if q not in perf_paths:
                     perf_paths.append(q)
+            for q in sorted(glob.glob(
+                    os.path.join(sub, f"*{WEIGHT_SWAPS_FILE}"))):
+                if q not in weights_paths:
+                    weights_paths.append(q)
         if router_stats_path is None:
             q = os.path.join(run_dir, ROUTER_STATS_FILE)
             router_stats_path = q if os.path.exists(q) else None
@@ -1004,6 +1121,10 @@ def build_report(
                 os.path.join(run_dir, f"*{AUTOPILOT_ACTIONS_FILE}"))):
             if q not in autopilot_paths:
                 autopilot_paths.append(q)
+        for q in sorted(glob.glob(
+                os.path.join(run_dir, f"*{WEIGHT_SWAPS_FILE}"))):
+            if q not in weights_paths:
+                weights_paths.append(q)
         p = os.path.join(run_dir, SCALARS_FILE)
         if os.path.exists(p) and p not in scalar_paths:
             scalar_paths.append(p)
@@ -1092,6 +1213,7 @@ def build_report(
     trace = summarize_trace(trace_paths, stats_records)
     alerts_section = summarize_alerts(alerts_paths)
     autopilot_section = summarize_autopilot(autopilot_paths)
+    weights_section = summarize_weights(weights_paths)
     if router_stats_path:
         from neuronx_distributed_tpu.obs.aggregate import (
             summarize_router_stats,
@@ -1135,6 +1257,7 @@ def build_report(
             "router_stats": router_stats_path,
             "perf": perf_paths,
             "autopilot": autopilot_paths,
+            "weights": weights_paths,
             "fleet_replicas": fleet_replicas,
         },
         "scalars": scalars,
@@ -1149,6 +1272,7 @@ def build_report(
         "memory": memory_section,
         "alerts": alerts_section,
         "autopilot": autopilot_section,
+        "weights": weights_section,
         "perf": perf_section,
         "health": {
             "anomaly_count": len(anomalies),
@@ -1182,6 +1306,12 @@ def build_report(
                 "rate_per_s": autopilot_section["rate_per_s"],
                 "last_action": (autopilot_section["last"]["action"]
                                 if autopilot_section["last"] else None)}),
+            # slim weights rollup — the full per-replica version table
+            # lives once, at the top-level "weights" section
+            "weights": (None if weights_section is None else {
+                "swaps": weights_section["swaps"],
+                "failures": weights_section["failures"],
+                "monotonic": weights_section["monotonic"]}),
             # slim perf rollup — the full per-family roofline table lives
             # once, at the top-level "perf" section
             "perf": (None if perf_section is None
@@ -1223,6 +1353,18 @@ def render_markdown(report: dict) -> str:
             f"- autopilot: **{ap['actions']} action(s)** across "
             f"{len(ap['triggers'])} trigger(s) "
             f"(rate {rate} over {ap['span_s']:.1f}s){last}")
+    wt = report.get("weights")
+    if wt:
+        mono = ("monotonic" if wt["monotonic"]
+                else "**NON-MONOTONIC version order**")
+        ver = (f"; now at version {wt['last']['version']} "
+               f"({wt['last']['source']})" if wt["last"] else "")
+        ms = (f", {wt['swap_ms_mean']:.1f} ms mean swap"
+              if wt["swap_ms_mean"] is not None else "")
+        lines.append(
+            f"- weights: **{wt['swaps']} live swap(s)**, "
+            f"{wt['failures']} failure(s) across "
+            f"{len(wt['replicas'])} engine(s) ({mono}{ms}){ver}")
     lines.append(f"- anomalies: **{h['anomaly_count']}**")
     lines.append(f"- supervisor restarts: **{h.get('restarts', 0)}**")
     lines.append(f"- collectives across audited programs: "

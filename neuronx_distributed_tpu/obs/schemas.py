@@ -89,6 +89,12 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "prefill_chunks": int,
         "preempted_ms": _NUM,
         "trace_id": (int, type(None)),
+        # v6 (live-weights PR): the weights_version whose params decoded
+        # the request's LAST committed token (0 = the process-start
+        # weights, never swapped) — a mid-swap request's output is
+        # attributable to the version that actually produced it.  v5
+        # records lack the field; obs.report reads it with default 0.
+        "weights_version": int,
     },
     # one line of router_stats.jsonl (serving.fleet.router.FleetRouter) —
     # one record per TERMINAL request across the whole fleet: which replica
@@ -162,6 +168,22 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "trigger": str, "mode": str, "replica": int, "detail": dict,
         "edge": (dict, type(None)), "budget_remaining": int,
     },
+    # one line of weight_swaps.jsonl (weights.swapper.WeightSwapper) — one
+    # record per swap ATTEMPT on one engine.  event is "swap" (committed)
+    # | "swap_failed" (validation / chaos / load failure — the old weights
+    # kept serving); version is the monotonic weights_version the engine
+    # serves AFTER the attempt (unchanged on failure), source "memory"
+    # (in-process param pytree, the rollout→train→swap path) | "checkpoint"
+    # (orbax round-trip), swap_ms the load+validate+install wall time
+    # (null when the attempt died before the clock mattered), error the
+    # failure detail (null on success), replica the owning fleet replica
+    # (-1 off-fleet).
+    "weight_swap": {
+        "schema": str, "time": _NUM, "mono": _NUM, "event": str,
+        "version": int, "source": str, "ok": bool,
+        "swap_ms": (int, float, type(None)),
+        "error": (str, type(None)), "replica": int,
+    },
     # memory_breakdown.json (obs.memory_ledger.MemoryLedger.dump) — the
     # per-subsystem device-byte breakdown, dumped on demand and on
     # RESOURCE_EXHAUSTED (reason "oom:<ExcType>"); "top" names the biggest
@@ -201,7 +223,10 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
     # MFU/tokens-ceiling rollup; null when the run carried no perf layer);
     # v6 (autopilot PR) adds the required "autopilot" section
     # (autopilot_actions.jsonl rollup: action table, per-trigger/per-kind
-    # counts, action rate; null when the run carried no autopilot)
+    # counts, action rate; null when the run carried no autopilot); v7
+    # (live-weights PR) adds the required "weights" section
+    # (weight_swaps.jsonl rollup: swap/failure counts, version range,
+    # swap-latency stats; null when the run never swapped weights)
     "obs_report": {
         "schema": str, "generated_at": _NUM, "scalars": dict,
         "histograms": dict, "flight": (dict, type(None)),
@@ -209,7 +234,7 @@ SCHEMAS: Dict[str, Dict[str, Any]] = {
         "supervisor": (dict, type(None)), "trace": (dict, type(None)),
         "compile": (dict, type(None)), "memory": (dict, type(None)),
         "alerts": (dict, type(None)), "perf": (dict, type(None)),
-        "autopilot": (dict, type(None)),
+        "autopilot": (dict, type(None)), "weights": (dict, type(None)),
     },
 }
 
@@ -387,6 +412,14 @@ REGISTRY_METRICS: Dict[str, str] = {
     "perf/mbu_milli": "gauge",
     "perf/roofline_pct_milli": "gauge",
     "perf/cost_model_missing_total": "counter",
+    # live weights (weights.swapper.WeightSwapper): hot-swap attempts and
+    # failures, the end-to-end swap latency (load + validate + install),
+    # and the monotonic version the engine currently serves (scrapeable —
+    # a mixed-version fleet mid-roll shows as diverging per-replica gauges)
+    "weights/swaps_total": "counter",
+    "weights/swap_failures_total": "counter",
+    "weights/swap_ms": "histogram",
+    "weights/weights_version": "gauge",
 }
 
 

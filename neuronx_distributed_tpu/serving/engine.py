@@ -94,7 +94,7 @@ from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
 
-SERVING_STATS_SCHEMA = "serving_stats/5"
+SERVING_STATS_SCHEMA = "serving_stats/6"
 
 FAIL_NON_FINITE = "non_finite_logits"
 
@@ -701,6 +701,13 @@ class ServingEngine:
             mode="forbid" if transfer_guard == "forbid" else "observe")
         # in-flight decode: (packed [2,B] device array, active snapshot)
         self._pending: "Optional[tuple]" = None
+        # live weights (weights.WeightSwapper): the monotonic version of
+        # the params currently serving (0 = process-start, never swapped)
+        # and the version an in-flight async decode was DISPATCHED under —
+        # a swap between dispatch and collect must attribute the collected
+        # tokens to the old version (the buffers that computed them)
+        self.weights_version = 0
+        self._pending_version = 0
         # device mirror of the paged block tables (refreshed via the packed
         # explicit put only when admission/termination changes them)
         self._tables_dev = None
@@ -1026,6 +1033,46 @@ class ServingEngine:
             # warm-pass program executions must not inflate the cost join:
             # phase device time only covers the measured window
             self._perf.mark_warmup_done()
+
+    def install_params(self, params: Any, version: int) -> None:
+        """Commit point of a live weight swap (``weights.WeightSwapper``):
+        rebind the model's param pytree and bump the serving version.  The
+        swapper has already validated + staged ``params`` against the
+        compiled envelope, so every already-compiled phase program accepts
+        the new pytree as a drop-in first argument — nothing recompiles
+        (the compile ledger proves it).  The old buffers free by reference
+        drop; an in-flight async decode dispatched against them keeps them
+        alive exactly until its collect, and its tokens are attributed to
+        ``_pending_version`` (the version that computed them).
+
+        Co-located replicas may SHARE one ``ParallelInferenceModel`` (one
+        set of compiled phase fns, one param pytree) — a fleet mid-roll
+        must not swap its neighbours, so the first install lazily replaces
+        ``self.model`` with a shallow per-engine view: same compiled
+        executables and caches by reference, private ``params`` binding."""
+        model = self.model
+        if not getattr(model, "_params_private", False):
+            import copy
+
+            view = copy.copy(model)
+            view._params_private = True
+            self.model = model = view
+        model.params = params
+        self.weights_version = int(version)
+        if self._kv is not None:
+            # cached prefix KV (and full-hit prefill logits) embody the
+            # OUTGOING params — a post-swap admission must never hit them,
+            # or old-version output leaks past the version boundary
+            dropped = self._kv.flush_prefix_cache()
+            if dropped:
+                logger.info("serving: weight swap flushed %d cached prefix "
+                            "chain node(s)", dropped)
+        ml = self.memory_ledger
+        if ml is not None:
+            # mem/params_bytes tracks the LIVE generation (the logical
+            # sizing model; transiently both generations exist on device
+            # until the old refs drop)
+            ml.account_tree("params", params)
 
     def _poll_module_jits(self, led) -> None:
         """Book growth of the shared sampler jits' caches as compile events
@@ -1763,7 +1810,8 @@ class ServingEngine:
         t0 = (self._clock() if tr is not None or self._perf is not None
               else None)
         bspan = (tr.begin("decode_step", t=t0, step=self._steps,
-                          active=len(active))
+                          active=len(active),
+                          weights_version=self.weights_version)
                  if tr is not None else None)
 
         if self._adapters is not None:
@@ -1861,6 +1909,9 @@ class ServingEngine:
             last = self._last_tok_time[slot]
             ms = (now - last) * 1e3 if last is not None else None
             req.generated.append(tok)
+            # attributed to the version that DISPATCHED this step — a swap
+            # between dispatch and collect computed under the old buffers
+            req.weights_version = self._pending_version
             req.decode_steps += 1
             if bspan is not None:
                 tr.instant("decode_slot", request_id=req.request_id,
@@ -1905,7 +1956,8 @@ class ServingEngine:
             if self.tracer is not None:
                 self._batch_span = self.tracer.begin(
                     "decode_step", t=t0, step=self._steps,
-                    active=len(active))
+                    active=len(active),
+                    weights_version=self.weights_version)
         # eager slicing of a stacked [3, B] array would bind scalar start
         # indices host-side (an implicit transfer the guard rejects), so the
         # per-step inputs stage as one explicit pytree put instead; in paged
@@ -1965,6 +2017,7 @@ class ServingEngine:
         self._pending = (_pack_tokens(toks, finite),
                          [(slot, req, int(self._slot_gen[slot]))
                           for slot, req in active])
+        self._pending_version = self.weights_version
 
     def _spec_dispatch(self, active: list) -> None:
         """Dispatch one speculative draft-k-verify round for the current
@@ -1992,7 +2045,8 @@ class ServingEngine:
             if self.tracer is not None:
                 self._batch_span = self.tracer.begin(
                     "spec_round", t=t0, step=self._steps,
-                    active=len(active), k=k)
+                    active=len(active), k=k,
+                    weights_version=self.weights_version)
         offs_steps = self._offsets[None, :] + np.arange(k, dtype=np.int32)[:, None]
         tidx_steps = tok_idx[None, :] + np.arange(k, dtype=np.int32)[:, None]
         staged = [self._next_tok[:, None].copy(), self._offsets.copy(),
@@ -2074,6 +2128,7 @@ class ServingEngine:
         self._pending = (packed,
                          [(slot, req, int(self._slot_gen[slot]))
                           for slot, req in active], props[-1])
+        self._pending_version = self.weights_version
 
     def _spec_collect(self) -> list:
         """Collect the in-flight speculative round: ONE explicit packed
@@ -2137,6 +2192,9 @@ class ServingEngine:
                     break  # stop inside the accepted run: commit up to it
             m = len(toks)
             reg.counter("serving/spec_committed_total").inc(m)
+            if m:
+                # the round ran under the dispatching version's buffers
+                req.weights_version = self._pending_version
             req.decode_steps += 1
             if bspan is not None:
                 # per-slot round outcome: proposals accepted + tokens
@@ -2275,6 +2333,7 @@ class ServingEngine:
         """Record + stream one generated token; finish the request when it
         hits a stop condition (slot freed immediately)."""
         req.generated.append(tok)
+        req.weights_version = self.weights_version
         self._last_tok_time[slot] = now
         self.registry.counter("serving/tokens_total").inc()
         if req.stream_cb is not None:
@@ -2370,6 +2429,9 @@ class ServingEngine:
                 "prefill_chunks": out.prefill_chunks,
                 "preempted_ms": out.preempted_ms,
                 "trace_id": out.trace_id,
+                # live weights (v6): the version that decoded the last
+                # committed token (0 = process-start, never swapped)
+                "weights_version": out.weights_version,
             }
             self._stats_f.write(json.dumps(rec) + "\n")
             self._stats_f.flush()

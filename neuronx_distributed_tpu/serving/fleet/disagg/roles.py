@@ -26,11 +26,18 @@ ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_MIXED)
 # requeued clone, so it stays a hard error even under roles.
 CAPACITY_KEYS = frozenset({"kv_pages", "kv_page_bytes", "adapter_pages"})
 
+# excluded alongside capacity: the live weights version is about which
+# params fill the compiled envelope, not the envelope itself — a
+# mixed-version fleet mid-rolling-update stays role-compatible
+_VERSION_KEYS = frozenset({"weights_version"})
+
 
 def role_envelope(desc: dict) -> dict:
     """The role-compatibility view of a replica's ``describe()``: the
-    compiled-envelope facts with the capacity keys removed."""
-    return {k: v for k, v in desc.items() if k not in CAPACITY_KEYS}
+    compiled-envelope facts with the capacity (and live-weights version)
+    keys removed."""
+    return {k: v for k, v in desc.items()
+            if k not in CAPACITY_KEYS and k not in _VERSION_KEYS}
 
 
 def role_compatible(a: dict, b: dict) -> bool:

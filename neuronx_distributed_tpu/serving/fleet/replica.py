@@ -204,6 +204,11 @@ class Replica:
                                    if store is not None else None),
             "adapter_rank": (store.layout.rank
                              if store is not None else None),
+            # live weights: which param version this replica serves RIGHT
+            # NOW.  Excluded from the homogeneity check (mixed versions
+            # are legal mid-rolling-update) — surfaced here so operators
+            # and fleet_watch can see the roll's progress per replica.
+            "weights_version": getattr(eng, "weights_version", 0),
         }
 
     # -- lifecycle ---------------------------------------------------------

@@ -43,6 +43,7 @@ schema-checked ``router_stats.jsonl`` record per terminal request.
 from __future__ import annotations
 
 import json
+import os
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -213,8 +214,13 @@ class FleetRouter:
         # replica stays LIVE and keeps stepping (in-flight work finishes in
         # place — zero requeues, zero re-prefills) but takes no NEW
         # dispatches; once empty, the plan runs (retire / warm rebuild /
-        # re-role).
+        # re-role / live weight swap).
         self._draining: Dict[int, dict] = {}
+        # fleet rolling update in progress (rolling_update): the one-at-a-
+        # time drain→swap→rejoin walk; None = no roll.  last_roll keeps the
+        # final status of the most recent completed roll.
+        self._rolling: Optional[dict] = None
+        self.last_roll: Optional[dict] = None
 
         reg = self.registry
         for c in ("dispatched", "requeued", "failovers", "restarts",
@@ -232,13 +238,19 @@ class FleetRouter:
         request admissible on one replica is admissible on any sibling.
         The disaggregated router overrides this with a ROLE-COMPATIBLE
         relaxation (capacity keys may differ between prefill- and
-        decode-heavy replicas; geometry never does)."""
+        decode-heavy replicas; geometry never does).  ``weights_version``
+        is excluded on both sides: a fleet mid-rolling-update is
+        EXPLICITLY allowed to serve mixed versions (the envelope is about
+        compiled geometry; the version is about which params fill it)."""
+        ref = {k: v for k, v in desc.items() if k != "weights_version"}
         for r in replicas[1:]:
-            if r.describe() != desc:
+            d = {k: v for k, v in r.describe().items()
+                 if k != "weights_version"}
+            if d != ref:
                 raise ValueError(
                     f"heterogeneous fleet: replica {r.replica_id} serves "
-                    f"{r.describe()}, replica {replicas[0].replica_id} "
-                    f"{desc} — prefix hashing and requeue both assume one "
+                    f"{d}, replica {replicas[0].replica_id} "
+                    f"{ref} — prefix hashing and requeue both assume one "
                     "compiled envelope")
 
     def _replica_role(self, rid: Optional[int]) -> Optional[str]:
@@ -327,7 +339,8 @@ class FleetRouter:
         return {rid: plan["then"] for rid, plan in self._draining.items()}
 
     def drain(self, replica_id: int, *, then: str = "retire",
-              role: Optional[str] = None, cause: str = "") -> None:
+              role: Optional[str] = None, cause: str = "",
+              payload: Optional[dict] = None) -> None:
         """Gracefully drain one replica: stop dispatching new work to it,
         let every in-flight request finish IN PLACE (this is NOT the
         crash-failover path — nothing is requeued, nothing re-prefills),
@@ -340,11 +353,23 @@ class FleetRouter:
           (clears compiled-fn churn / pool fragmentation) and rejoin.
         - ``then="re_role"``: disaggregation rebalance — flip the steering
           ``role`` (requires ``role=``) and rejoin with pages intact.
+        - ``then="swap"``: live weight swap — once empty, install new
+          params IN PLACE via ``weights.WeightSwapper`` (requires
+          ``payload=`` with ``"params"`` or ``"ckpt_dir"``; optional
+          ``"tag"``, ``"swaps_path"``) and rejoin.  The engine is never
+          rebuilt: its compiled phase programs survive, so the rejoined
+          replica serves the new version with ZERO post-warmup compiles.
+          A swap failure (audited) rejoins the replica on its OLD weights.
         """
-        if then not in ("retire", "restart", "re_role"):
+        if then not in ("retire", "restart", "re_role", "swap"):
             raise ValueError(f"unknown drain plan {then!r}")
         if then == "re_role" and role is None:
             raise ValueError("drain(then='re_role') requires role=")
+        if then == "swap" and not (payload and (
+                "params" in payload or "ckpt_dir" in payload)):
+            raise ValueError(
+                "drain(then='swap') requires payload= with 'params' or "
+                "'ckpt_dir'")
         replica = self.replicas.get(replica_id)
         if replica is None:
             raise ValueError(f"unknown replica {replica_id}")
@@ -363,7 +388,7 @@ class FleetRouter:
                 "suicide)")
         self._draining[replica_id] = {
             "then": then, "role": role, "cause": cause or then,
-            "since": self._clock()}
+            "payload": payload, "since": self._clock()}
         self.registry.counter("router/drains_total").inc()
         if self.tracer is not None:
             self.tracer.instant("route/drain", request_id=-1,
@@ -437,10 +462,172 @@ class FleetRouter:
                             self._health.replica_retired(
                                 rid, replica.last_cause or "rebuild_failed",
                                 now)
-            else:  # re_role
+            elif then == "re_role":
                 replica.role = plan["role"]
+            else:  # swap
+                self._swap_replica(rid, replica, plan, now)
             self._export_gauges(full=True)
         return []
+
+    def _swap_replica(self, rid: int, replica: Replica, plan: dict,
+                      now: float) -> bool:
+        """Run a drained replica's live weight swap IN PLACE (the engine —
+        and every compiled phase program — survives; only the param pytree
+        changes).  The prefix-cache flush inside the swap invalidates the
+        router's affinity shadow, so it resyncs from the (now empty) live
+        index.  A failed swap leaves the replica serving its OLD weights
+        and rejoining rotation — capacity over currency; the failure is
+        audited in weight_swaps.jsonl and the roll status."""
+        from neuronx_distributed_tpu.weights import SwapError, WeightSwapper
+
+        payload = plan.get("payload") or {}
+        ok = True
+        version = None
+        try:
+            swapper = WeightSwapper(
+                replica.engine, path=payload.get("swaps_path"),
+                replica=rid)
+            try:
+                if "params" in payload:
+                    # copy defaults True (memory source): each replica must
+                    # own its bytes — the shared payload pytree may be a
+                    # live trainer's donated buffers.  A caller that KNOWS
+                    # the pytree is immutable may pass "copy": False to
+                    # alias it across the whole fleet.
+                    version = swapper.swap(payload["params"],
+                                           source="memory",
+                                           copy=payload.get("copy"))
+                else:
+                    version = swapper.swap_from_checkpoint(
+                        payload["ckpt_dir"], tag=payload.get("tag"))
+            finally:
+                swapper.close()
+        except (SwapError, Exception) as e:  # noqa: BLE001 — audit + rejoin
+            ok = False
+            logger.warning(
+                "fleet: replica %d weight swap failed (%s); rejoining on "
+                "old weights", rid, e)
+        # cached prefix chains were flushed (or are untrustworthy after a
+        # failed half-load — there is none today, but stay conservative):
+        # the shadow must stop crediting them
+        self.shadows[rid].resync(replica.prefix_fingerprints())
+        if self._rolling is not None:
+            (self._rolling["done"] if ok
+             else self._rolling["failed"]).append(rid)
+            if ok:
+                self._rolling["versions"][rid] = version
+        if self.tracer is not None:
+            self.tracer.instant("route/weight_swap", request_id=-1,
+                                replica=rid, ok=ok,
+                                version=version if version is not None
+                                else -1)
+        if ok:
+            logger.info("fleet: replica %d swapped to weights version %s "
+                        "and rejoined rotation", rid, version)
+        return ok
+
+    # -- fleet rolling update ----------------------------------------------
+
+    def rolling_update(self, params: Any = None, *,
+                       ckpt_dir: Optional[str] = None,
+                       tag: Optional[str] = None,
+                       swaps_dir: Optional[str] = None,
+                       cause: str = "rolling_update") -> None:
+        """Deploy new weights across the whole fleet with zero downtime
+        and zero lost accepted requests: drain → swap → rejoin ONE replica
+        at a time, riding the graceful-drain surface (in-flight work
+        finishes in place; the rest of the fleet keeps taking traffic; a
+        mixed-version fleet mid-roll is explicitly allowed and visible in
+        ``Replica.describe()['weights_version']``).
+
+        ``params`` routes the in-memory path (the rollout→train→swap
+        loop); ``ckpt_dir``/``tag`` the orbax checkpoint path.
+        ``swaps_dir`` (optional) receives one
+        ``replica<rid>_weight_swaps.jsonl`` audit file per replica.  The
+        roll advances inside :meth:`step` — keep stepping (serving traffic
+        or not) until :meth:`roll_status` reports it complete.  Replicas
+        that die mid-roll are skipped (failover owns them); a replica
+        whose swap fails rejoins on its old weights and is listed in the
+        status' ``failed``."""
+        if (params is None) == (ckpt_dir is None):
+            raise ValueError(
+                "rolling_update needs exactly one of params= (in-memory) "
+                "or ckpt_dir= (checkpoint)")
+        if self._rolling is not None:
+            raise ValueError("a rolling update is already in progress")
+        payload: dict = {}
+        if params is not None:
+            payload["params"] = params
+        else:
+            payload["ckpt_dir"] = ckpt_dir
+            payload["tag"] = tag
+        queue = deque(sorted(
+            rid for rid in self.replicas if self._dispatchable(rid)))
+        if not queue:
+            raise FleetUnavailableError(
+                "rolling_update: no dispatchable replica to roll")
+        self._rolling = {
+            "queue": queue, "payload": payload, "swaps_dir": swaps_dir,
+            "cause": cause, "active": None, "done": [], "failed": [],
+            "skipped": [], "versions": {}, "started": self._clock(),
+        }
+        logger.info("fleet: rolling update started over replicas %s",
+                    list(queue))
+
+    def roll_status(self) -> Optional[dict]:
+        """The in-progress roll's status (None when no roll is active —
+        see :attr:`last_roll` for the most recent completed one)."""
+        if self._rolling is None:
+            return None
+        r = self._rolling
+        return {"active": r["active"], "queued": list(r["queue"]),
+                "done": list(r["done"]), "failed": list(r["failed"]),
+                "skipped": list(r["skipped"]),
+                "versions": dict(r["versions"])}
+
+    def _advance_roll(self, now: float) -> None:
+        """Advance the rolling update by at most one replica: wait while
+        the active replica is still drain-swapping, then start the next
+        queued one (skipping replicas that died or started draining for
+        some other reason since the roll was enqueued).  Runs inside
+        :meth:`step`, after ``_complete_drains`` — so a swap that
+        completed this step frees the roll to start the next replica in
+        the SAME step."""
+        roll = self._rolling
+        if roll is None:
+            return
+        active = roll["active"]
+        if active is not None and active in self._draining:
+            return  # still draining — one replica at a time
+        roll["active"] = None
+        while roll["queue"]:
+            rid = roll["queue"].popleft()
+            replica = self.replicas.get(rid)
+            if replica is None or not replica.alive \
+                    or rid in self._draining:
+                roll["skipped"].append(rid)
+                continue
+            payload = dict(roll["payload"])
+            if roll["swaps_dir"] is not None:
+                payload["swaps_path"] = os.path.join(
+                    roll["swaps_dir"], f"replica{rid}_weight_swaps.jsonl")
+            self.drain(rid, then="swap", cause=roll["cause"],
+                       payload=payload)
+            roll["active"] = rid
+            return
+        # queue empty, nothing active: the roll is complete
+        self.last_roll = {
+            "done": list(roll["done"]), "failed": list(roll["failed"]),
+            "skipped": list(roll["skipped"]),
+            "versions": dict(roll["versions"]),
+            "duration_s": now - roll["started"],
+        }
+        self._rolling = None
+        logger.info(
+            "fleet: rolling update complete (%d swapped, %d failed, "
+            "%d skipped, %.2fs)", len(self.last_roll["done"]),
+            len(self.last_roll["failed"]), len(self.last_roll["skipped"]),
+            self.last_roll["duration_s"])
 
     @property
     def inflight(self) -> int:
@@ -505,6 +692,8 @@ class FleetRouter:
 
         if self._draining:
             self._complete_drains(now)
+        if self._rolling is not None:
+            self._advance_roll(now)
 
         if all(r.state is ReplicaState.RETIRED
                for r in self.replicas.values()):
@@ -924,6 +1113,9 @@ class FleetRouter:
             "affinity_pages": rec.affinity_pages,
             "new_tokens": len(out.token_ids),
             "policy": self.policy.name,
+            # extra (schemas are floors): the weights version that decoded
+            # the request's last token — the mixed-version roll evidence
+            "weights_version": getattr(out, "weights_version", 0),
         }) + "\n")
         self._stats_f.flush()
 

@@ -327,6 +327,17 @@ class PagedKVManager:
             return set()
         return self.index.chain_fingerprints()
 
+    def flush_prefix_cache(self) -> int:
+        """Drop every cached prefix chain (live-weight swap path: cached
+        KV and prefill-logit payloads embody the OUTGOING params — a
+        post-swap admission must never prefix-hit them).  Active slots and
+        parked resume pins keep their own page references; parked victims
+        simply re-prefill under the new weights at re-grant.  Returns the
+        chains-dropped node count (0 without a prefix cache)."""
+        if self.index is None:
+            return 0
+        return self.index.flush()
+
     # -- internals ---------------------------------------------------------
 
     def _ensure_free(self, n: int) -> None:

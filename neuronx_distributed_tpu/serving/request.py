@@ -151,6 +151,11 @@ class Request:
     preempted_ms: float = 0.0
     parked_at: Optional[float] = None
     trace_id: Optional[int] = None
+    # live weights (engine-owned): the engine's weights_version when the
+    # request's LAST token committed — re-stamped per commit, so a request
+    # straddling a hot swap is attributed to the version that actually
+    # decoded its final output (0 = never-swapped process-start weights)
+    weights_version: int = 0
     # preemption-aware resume (engine-owned): the COMMITTED page chain a
     # preempted victim keeps pinned while parked — extra allocator
     # references on `resume_pages` (NULL holes excluded) plus the matching
@@ -260,6 +265,9 @@ class RequestOutput:
     prefill_chunks: int = 0
     preempted_ms: float = 0.0
     trace_id: Optional[int] = None
+    # live weights (v6): the weights_version that decoded the request's
+    # last committed token (0 = process-start weights, never swapped)
+    weights_version: int = 0
 
     @property
     def acceptance_rate(self) -> Optional[float]:
@@ -296,4 +304,5 @@ class RequestOutput:
             prefill_chunks=req.prefill_chunks,
             preempted_ms=req.preempted_ms,
             trace_id=req.trace_id,
+            weights_version=req.weights_version,
         )

@@ -106,6 +106,15 @@ class Callback:
     def on_step(self, step: int, metrics: dict) -> None:
         """Called after every optimizer step with the step metrics."""
 
+    def on_params(self, step: int, params: Any, opt_state: Any) -> None:
+        """Called after every optimizer step with the LIVE device params
+        (unlike :meth:`on_step`, which sees only host metrics).  This is
+        the hand-off point for co-located serving: a callback may pass
+        ``params`` straight to ``WeightSwapper.swap(..., source="memory")``
+        to hot-swap a running engine without a checkpoint round-trip.
+        Fires even on deferred-metrics iterations — the params are always
+        current; only their metrics lag.  Do NOT mutate ``params``."""
+
     def on_eval(self, step: int, metrics: dict) -> None:
         """Called after each eval-cadence evaluation (``eval_loss`` key)."""
 
@@ -692,6 +701,11 @@ def fit(
                         "seq_per_sec": round(seqs, 2),
                         "grad_norm": round(grad_norm, 4),
                     }), flush=True)
+            for cb in cbs:
+                # unconditional (even when metrics are deferred): the params
+                # themselves are never stale, and a swap-every-K callback
+                # must not miss its cadence step to a deferral window
+                cb.on_params(step, params, opt_state)
             _flush_eval()  # last cadence's eval: fetched one iteration late
             if eval_fn is not None and (step + 1) % eval_every == 0:
                 # dispatch now, fetch on the NEXT iteration (or at loop

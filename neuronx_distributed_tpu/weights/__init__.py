@@ -1,0 +1,17 @@
+"""Live weights: zero-downtime hot swaps for serving engines and fleets.
+
+The subsystem that closes the "weights are frozen for the process
+lifetime" assumption: :class:`WeightSwapper` validates and installs a new
+param pytree into a running engine between steps — no recompile, no
+dropped request — and the fleet router's ``rolling_update`` walks it
+across replicas one graceful drain at a time.  See ``docs/OPERATIONS.md``
+("Deploy new weights") for the runbook.
+"""
+
+from neuronx_distributed_tpu.weights.swapper import (  # noqa: F401
+    WEIGHT_SWAP_SCHEMA,
+    WEIGHT_SWAPS_FILE,
+    SwapError,
+    WeightSwapper,
+    param_envelope,
+)

@@ -1,0 +1,285 @@
+"""Zero-downtime live weight swaps for a running serving engine.
+
+A model deploy used to mean tearing the engine (or the whole fleet) down:
+continuous batching assumes the params pytree is frozen for the process
+lifetime.  This module closes that assumption.  :class:`WeightSwapper`
+takes a new param pytree — from an orbax checkpoint
+(:meth:`~WeightSwapper.swap_from_checkpoint`) or directly from a
+co-located trainer (:meth:`~WeightSwapper.swap`, the rollout→train→swap
+path with no checkpoint round-trip) — validates its ENVELOPE against the
+running :class:`~neuronx_distributed_tpu.trace.engine.ParallelInferenceModel`
+(pytree structure, per-leaf shape, dtype, sharding), and replaces the
+engine's param buffers between ``ServingEngine.step()`` calls.
+
+Why no recompile is needed — and how that is *enforced*, not hoped:
+
+- every compiled phase program (the AOT ``context``/``decode`` pair and
+  every ``_CompiledLRU`` family) takes ``params`` as its FIRST positional
+  argument; nothing is baked into any executable.  An envelope-identical
+  pytree is therefore a drop-in argument for every program already
+  compiled;
+- placement is part of the envelope: AOT executables are strict about
+  committed-argument shardings, so each incoming leaf is ``device_put``
+  onto the spec's ``NamedSharding`` (a layout-preserving transfer —
+  ``device_put`` never traces or compiles anything);
+- the PR-12 compile ledger is the acceptance oracle: a swap on a warmed
+  engine records ZERO compile-ledger rows (``tests/test_weights.py``
+  pins it), because a single post-warmup row is a compile_storm.
+
+Transactionality: validation and materialization complete BEFORE the
+engine is touched.  A structure/shape/dtype mismatch, a checkpoint load
+failure, or a ``weights/pre_swap`` chaos fault raises :class:`SwapError`
+(or the injected fault) with the OLD weights still serving — the engine
+never observes a half-installed pytree.  Every attempt (committed or
+failed) lands in ``weight_swaps.jsonl`` and the ``weights/*`` registry
+metrics; committed swaps bump the engine's monotonic ``weights_version``,
+which the engine stamps into serving_stats records and decode trace spans
+so a mid-swap request's output is attributable to the version that
+actually decoded it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.obs.schemas import validate_record
+from neuronx_distributed_tpu.resilience.faults import fault_point
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+WEIGHT_SWAP_SCHEMA = "weight_swap/1"
+
+WEIGHT_SWAPS_FILE = "weight_swaps.jsonl"
+
+
+class SwapError(RuntimeError):
+    """A live swap was refused or failed — the old weights kept serving."""
+
+
+def _spec_of(leaf: Any) -> jax.ShapeDtypeStruct:
+    from jax.sharding import NamedSharding
+
+    sh = getattr(leaf, "sharding", None)
+    sh = sh if isinstance(sh, NamedSharding) else None
+    return jax.ShapeDtypeStruct(jnp.shape(leaf), jnp.result_type(leaf),
+                                sharding=sh)
+
+
+def param_envelope(model: Any):
+    """The model's param envelope: a pytree of ``ShapeDtypeStruct`` (with
+    ``NamedSharding`` where the live params carry one) every incoming
+    pytree must match leaf-for-leaf.  Prefers the AOT signature the phase
+    programs were actually compiled against (``model._arg_specs[0]``);
+    falls back to deriving it from the live params."""
+    specs = getattr(model, "_arg_specs", None)
+    if specs:
+        return specs[0]
+    return jax.tree.map(_spec_of, model.params)
+
+
+class WeightSwapper:
+    """Live-weight controller for ONE serving engine.
+
+    ``engine`` is a running ``serving.engine.ServingEngine``; ``path`` the
+    ``weight_swaps.jsonl`` audit trail (None = no artifact); ``registry``
+    / ``tracer`` / ``clock`` default to the engine's own, so swap spans
+    and metrics land in the same run artifacts as the serving traffic.
+    ``replica`` tags the records when the engine serves inside a fleet.
+
+    Call :meth:`swap` / :meth:`swap_from_checkpoint` ONLY between engine
+    steps (the engine mutates nothing mid-call; an in-flight async decode
+    is handled — it was dispatched against the old buffers, which stay
+    alive until collected, and its tokens are stamped with the old
+    version).
+    """
+
+    def __init__(self, engine: Any, *, path: Optional[str] = None,
+                 registry: Any = None, tracer: Any = None,
+                 clock: Any = None, replica: int = -1):
+        self.engine = engine
+        self.replica = int(replica)
+        self.registry = registry if registry is not None else engine.registry
+        self.tracer = tracer if tracer is not None else engine.tracer
+        self._clock = clock if clock is not None else engine._clock
+        self.path = path
+        self._f = open(path, "a") if path is not None else None
+        reg = self.registry
+        # pre-declare: an engine that never swaps still exports the set,
+        # and the version gauge starts at the process-start version
+        reg.counter("weights/swaps_total")
+        reg.counter("weights/swap_failures_total")
+        from neuronx_distributed_tpu.obs import MS_BUCKETS
+
+        self._ms_buckets = MS_BUCKETS
+        reg.histogram("weights/swap_ms", MS_BUCKETS)
+        reg.gauge("weights/weights_version").set(
+            float(getattr(engine, "weights_version", 0)))
+
+    # -- public surface ----------------------------------------------------
+
+    def swap(self, params: Any, *, source: str = "memory",
+             copy: Optional[bool] = None) -> int:
+        """Validate + install ``params`` as the engine's live weights.
+
+        Returns the new monotonic ``weights_version``.  Raises
+        :class:`SwapError` (envelope mismatch) or the injected chaos fault
+        with the engine untouched.  ``source`` tags the audit record —
+        ``"memory"`` for a trainer handoff, ``"checkpoint"`` for an orbax
+        load (:meth:`swap_from_checkpoint` sets it).
+
+        ``copy`` controls whether each leaf is staged into a FRESH device
+        buffer.  Default: True for ``source="memory"``, False otherwise.
+        The memory default is load-bearing: the jitted train step donates
+        its param buffers (``make_train_step``, ``donate_argnums=(0, 1)``),
+        so a live trainer's pytree handed over by reference would be
+        invalidated by the very next optimizer step — the engine must own
+        its bytes.  Checkpoint loads already produce fresh buffers nothing
+        else references, so they skip the copy."""
+        eng = self.engine
+        copy = (source == "memory") if copy is None else bool(copy)
+        next_version = int(getattr(eng, "weights_version", 0)) + 1
+        t0 = self._clock()
+        tr = self.tracer
+        span = (tr.begin("weight_swap", t=t0, version=next_version,
+                         source=source)
+                if tr is not None else None)
+        try:
+            # the chaos hook: a "weights/pre_swap" fault proves the
+            # transaction — it fires before ANY engine state is touched
+            fault_point("weights/pre_swap", version=next_version,
+                        source=source)
+            staged = self._materialize(params, copy=copy)
+        except BaseException as e:
+            now = self._clock()
+            if span is not None:
+                tr.end(span, t=now, failed=str(e))
+            self._note_failure(e, source, (now - t0) * 1e3)
+            raise
+        # commit point: everything below is in-place bookkeeping that
+        # cannot fail the envelope (install_params only rebinds + accounts)
+        eng.install_params(staged, next_version)
+        now = self._clock()
+        swap_ms = (now - t0) * 1e3
+        if span is not None:
+            tr.end(span, t=now)
+        reg = self.registry
+        reg.counter("weights/swaps_total").inc()
+        reg.histogram("weights/swap_ms", self._ms_buckets).observe(swap_ms)
+        reg.gauge("weights/weights_version").set(float(next_version))
+        self._emit("swap", next_version, source, True, swap_ms, None)
+        logger.info("weights: swapped to version %d (%s, %.1f ms)",
+                    next_version, source, swap_ms)
+        return next_version
+
+    def swap_from_checkpoint(self, ckpt_dir: str,
+                             tag: Optional[str] = None) -> int:
+        """Load an orbax checkpoint's model state (re-sharded to the live
+        mesh via the engine's own params as template) and :meth:`swap` it
+        in.  A load failure is a failed attempt (audited) with the old
+        weights still serving."""
+        from neuronx_distributed_tpu.trainer.checkpoint import (
+            load_checkpoint,
+        )
+
+        t0 = self._clock()
+        try:
+            restored, _, _, _ = load_checkpoint(
+                ckpt_dir, tag=tag, model_template=self.engine.model.params)
+        except BaseException as e:
+            self._note_failure(e, "checkpoint", (self._clock() - t0) * 1e3)
+            raise SwapError(
+                f"checkpoint load failed ({ckpt_dir!r}, tag={tag!r}): "
+                f"{e}") from e
+        return self.swap(restored, source="checkpoint")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self) -> "WeightSwapper":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # -- internals ---------------------------------------------------------
+
+    def _materialize(self, params: Any, copy: bool = False) -> Any:
+        """Validate ``params`` against the model's compiled envelope and
+        stage every leaf onto its committed sharding.  Raises
+        :class:`SwapError` on ANY mismatch before a single engine field is
+        touched; on success returns a pytree the compiled programs accept
+        as a drop-in argument (``device_put`` only — never a trace, never
+        a compile).
+
+        ``copy=True`` forces fresh buffers via a host round-trip
+        (``np.asarray`` then ``device_put``): ``device_put`` onto an
+        array's own sharding is an alias, and an alias of donated trainer
+        buffers dies at the next optimizer step.  The round-trip is the
+        one staging path that can never trace or compile anything."""
+        env = param_envelope(self.engine.model)
+        new_td = jax.tree_util.tree_structure(params)
+        env_td = jax.tree_util.tree_structure(env)
+        if new_td != env_td:
+            raise SwapError(
+                "param pytree structure differs from the running model's "
+                f"envelope: got {new_td}, compiled against {env_td}")
+        env_leaves = jax.tree_util.tree_leaves(env)
+        new_leaves = jax.tree_util.tree_leaves(params)
+        staged = []
+        for i, (spec, leaf) in enumerate(zip(env_leaves, new_leaves)):
+            shape, dtype = jnp.shape(leaf), jnp.result_type(leaf)
+            if tuple(shape) != tuple(spec.shape):
+                raise SwapError(
+                    f"param leaf {i}: shape {tuple(shape)} != compiled "
+                    f"envelope {tuple(spec.shape)}")
+            if dtype != spec.dtype:
+                raise SwapError(
+                    f"param leaf {i}: dtype {dtype} != compiled envelope "
+                    f"{spec.dtype}")
+            sh = getattr(spec, "sharding", None)
+            if copy:
+                import numpy as np
+
+                leaf = np.asarray(leaf)
+            # committed placement is part of the envelope: put each leaf
+            # where the executables expect it (no-op when already there
+            # and not copying)
+            staged.append(jax.device_put(leaf, sh)
+                          if sh is not None else jnp.asarray(leaf))
+        return jax.tree_util.tree_unflatten(env_td, staged)
+
+    def _note_failure(self, e: BaseException, source: str,
+                      swap_ms: float) -> None:
+        version = int(getattr(self.engine, "weights_version", 0))
+        self.registry.counter("weights/swap_failures_total").inc()
+        self._emit("swap_failed", version, source, False, swap_ms, str(e))
+        logger.warning("weights: swap failed, version %d keeps serving: %s",
+                       version, e)
+
+    def _emit(self, event: str, version: int, source: str, ok: bool,
+              swap_ms: Optional[float], error: Optional[str]) -> None:
+        if self._f is None:
+            return
+        rec = {
+            "schema": WEIGHT_SWAP_SCHEMA,
+            "time": time.time(),
+            "mono": self._clock(),
+            "event": event,
+            "version": version,
+            "source": source,
+            "ok": ok,
+            "swap_ms": swap_ms,
+            "error": error,
+            "replica": self.replica,
+        }
+        validate_record("weight_swap", rec)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()

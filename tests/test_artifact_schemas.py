@@ -33,7 +33,8 @@ def test_schema_list_is_complete():
             "serving_stats", "supervisor_event",
             "router_stats", "trace_event",
             "compile_ledger", "memory_breakdown", "alert",
-            "perf_attribution", "autopilot_action"} <= set(SCHEMAS)
+            "perf_attribution", "autopilot_action",
+            "weight_swap"} <= set(SCHEMAS)
 
 
 def test_committed_tpu_watch_results_validate():
@@ -124,7 +125,7 @@ def test_serving_stats_schema(tmp_path):
          "adapter_id": 0, "priority": "interactive", "deadline_s": None,
          "queue_wait_ms": 0.5, "preemptions": 0, "shed_reason": None,
          "mono": 100.25, "decode_steps": 4, "prefill_chunks": 0,
-         "preempted_ms": 0.0, "trace_id": None},
+         "preempted_ms": 0.0, "trace_id": None, "weights_version": 0},
         # a non-speculative, multi-tenant, batch-tier record: served under
         # LoRA adapter 3, preempted once, shed at the pre-prefill expiry
         # check, linked into trace_events.jsonl via trace_id (v5)
@@ -136,7 +137,7 @@ def test_serving_stats_schema(tmp_path):
          "deadline_s": 0.25, "queue_wait_ms": 100.0, "preemptions": 1,
          "shed_reason": "expired_before_prefill",
          "mono": 101.5, "decode_steps": 0, "prefill_chunks": 2,
-         "preempted_ms": 40.0, "trace_id": 1},
+         "preempted_ms": 40.0, "trace_id": 1, "weights_version": 2},
     ]
     path = tmp_path / "serving_stats.jsonl"
     with open(path, "w") as f:
@@ -164,6 +165,12 @@ def test_serving_stats_schema(tmp_path):
                   "trace_id"):
             v4.pop(f)
         validate_record("serving_stats", v4)
+    with pytest.raises(ValueError, match="missing required field"):
+        # a v5-shaped record (no weights_version) no longer validates
+        # against the live-emitter floor; obs.report reads it as version 0
+        v5 = dict(recs[0])
+        v5.pop("weights_version")
+        validate_record("serving_stats", v5)
 
     # the SLO counters/per-class histograms are declared with their kinds,
     # and a live SLO-serving registry validates + grows the report line

@@ -49,6 +49,18 @@ yields exactly one terminal output (ledger-checked); every action the
 controller took is a schema-valid ``autopilot_actions.jsonl`` record;
 and the post-spike recovery wave finishes to the last request.
 
+``--rolling-update`` switches to the zero-downtime weight-deploy rung
+(the ``fleet_rolling_update`` tpu_watch job): live traffic drips through
+the fleet while ``FleetRouter.rolling_update()`` walks drain → swap →
+rejoin one replica at a time.  Gates, all required: every accepted
+request yields exactly one FINISHED output (zero lost to the roll); the
+roll completes with every replica swapped (none failed or skipped); the
+shared compile ledger records ZERO rows in the roll window (the swap
+reuses every compiled phase program); each replica's
+``weight_swaps.jsonl`` is schema-valid with strictly increasing
+versions; and every replica describes the new weights_version at the
+end — the mixed-version fleet mid-roll is reported as evidence.
+
 Run by ``tools/tpu_watch.py`` as the ``serving_fleet`` extra job;
 ``--tiny`` smoke-tests the harness on CPU (the same rungs, smaller model).
 """
@@ -753,6 +765,148 @@ def run_disagg(args, model, vocab_size, engine_kw) -> dict:
     }
 
 
+# -- rolling-update rung ------------------------------------------------------
+
+def run_rolling_update(args, model, vocab_size, engine_kw) -> dict:
+    """Zero-downtime fleet weight deploy under live traffic: requests keep
+    arriving while ``router.rolling_update()`` walks the fleet drain → swap
+    → rejoin, one replica at a time.  Gates, all required: every accepted
+    request yields exactly one FINISHED output (zero lost to the roll);
+    the roll completes with every replica swapped (none failed, none
+    skipped); ZERO compile-ledger rows land anywhere in the roll window
+    (the swap reuses every compiled phase program); each replica's
+    ``weight_swaps.jsonl`` is schema-valid with strictly increasing
+    versions; and every replica describes the new version at the end —
+    with the mixed-version fleet observable mid-roll."""
+    import numpy as np
+
+    import jax
+    from neuronx_distributed_tpu.obs.compile_ledger import CompileLedger
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+    from neuronx_distributed_tpu.serving import Request
+    from neuronx_distributed_tpu.serving.scheduler import BackpressureError
+
+    C = model.config.context_len
+    rs = np.random.RandomState(args.seed + 9)
+    out_dir = args.stats_dir or tempfile.mkdtemp(prefix="fleet_bench_")
+    os.makedirs(out_dir, exist_ok=True)
+    stats_path = os.path.join(out_dir, "router_stats.jsonl")
+    if os.path.exists(stats_path):
+        os.remove(stats_path)
+    for rid in range(args.replicas):
+        q = os.path.join(out_dir, f"replica{rid}_weight_swaps.jsonl")
+        if os.path.exists(q):
+            os.remove(q)
+
+    # one ledger shared by every replica engine: the roll-window gate is
+    # fleet-global (a recompile on ANY replica's swap fails the rung)
+    ledger = CompileLedger()
+    health, alerts_path = _make_fleet_health(args, "rolling_update")
+    router = _build_fleet(model, args.replicas, "round_robin", args.seed,
+                          stats_path=stats_path, health=health,
+                          compile_ledger=ledger, **engine_kw)
+
+    # the "new checkpoint": same envelope (structure/shape/dtype/sharding),
+    # measurably different bytes — a scaled copy of the serving params
+    new_params = jax.tree.map(lambda x: np.asarray(x) * 1.001, model.params)
+
+    n = args.num_requests
+    prompts = [rs.randint(1, vocab_size,
+                          size=int(rs.randint(C // 4, C // 2 + 1))).tolist()
+               for _ in range(n)]
+    outs: dict = {}
+    accepted = 0
+    roll_started = False
+    mark = None
+    mixed_seen = False
+    steps = 0
+
+    def versions_now():
+        return {rid: r.describe().get("weights_version", 0)
+                for rid, r in router.replicas.items() if r.alive}
+
+    while steps < 5000:
+        # drip traffic so requests are in flight THROUGH the whole roll
+        for _ in range(2):
+            if accepted < n:
+                try:
+                    router.submit(Request(
+                        request_id=accepted, prompt_ids=prompts[accepted],
+                        max_new_tokens=args.max_new_tokens))
+                    accepted += 1
+                except BackpressureError:
+                    break  # queue full: retry next step
+        for o in router.step():
+            outs[router.client_id(o.request_id)] = o
+        steps += 1
+        if not roll_started and accepted >= max(n // 3, 1):
+            mark = ledger.mark()
+            router.rolling_update(new_params, swaps_dir=out_dir,
+                                  cause="fleet_bench_rolling_update")
+            roll_started = True
+        if roll_started and router.roll_status() is not None:
+            mixed_seen = mixed_seen or len(set(versions_now().values())) > 1
+        if (roll_started and router.roll_status() is None
+                and accepted == n and not router.inflight):
+            break
+    roll_compiles = (ledger.compiles_since(mark) if mark is not None else -1)
+    last_roll = router.last_roll
+    final_versions = versions_now()
+    router.assert_invariants()
+    router.close()
+    health_fields = _fleet_health_fields(health, alerts_path)
+
+    # audit trail: each rolled replica's weight_swaps.jsonl must validate
+    # and carry strictly increasing versions for the records that committed
+    swap_files, monotonic, audited_swaps = [], True, 0
+    for rid in (last_roll or {}).get("done", []):
+        q = os.path.join(out_dir, f"replica{rid}_weight_swaps.jsonl")
+        if not os.path.exists(q):
+            monotonic = False
+            continue
+        swap_files.append(os.path.abspath(q))
+        n_rec = validate_jsonl("weight_swap", q)
+        audited_swaps += n_rec
+        vs = [r["version"] for r in
+              (json.loads(l) for l in open(q) if l.strip()) if r["ok"]]
+        if vs != sorted(vs) or len(set(vs)) != len(vs):
+            monotonic = False
+
+    n_stats = validate_jsonl("router_stats", stats_path)
+    finished = sum(1 for o in outs.values() if o.state == "finished")
+    rec = {
+        "metric": "serving_fleet", "rung": "rolling_update",
+        "num_requests": n,
+        "accepted": accepted,
+        "finished": finished,
+        "lost": accepted - len(outs),
+        "roll": last_roll,
+        "roll_compiles": roll_compiles,
+        "mixed_version_mid_roll": mixed_seen,
+        "final_versions": {str(k): v for k, v in final_versions.items()},
+        "versions_monotonic": monotonic,
+        "audited_swaps": audited_swaps,
+        "swap_files": swap_files,
+        "stats_records": n_stats,
+        "stats_path": os.path.abspath(stats_path),
+        **health_fields,
+    }
+    rec["ok"] = (
+        accepted == n
+        and finished == n                       # zero accepted requests lost
+        and len(outs) == n                      # exactly one output each
+        and last_roll is not None               # the roll ran to completion
+        and len(last_roll["done"]) == args.replicas
+        and not last_roll["failed"]
+        and not last_roll["skipped"]
+        and roll_compiles == 0                  # swap = zero recompiles
+        and monotonic                           # audited, increasing versions
+        and audited_swaps == args.replicas
+        and all(v == 1 for v in final_versions.values())
+        and n_stats == n)
+    return rec
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--tiny", action="store_true", help="CPU smoke config")
@@ -801,6 +955,15 @@ def main() -> int:
                         "autopilot_actions.jsonl / router_stats.jsonl / "
                         "autopilot.alerts.jsonl (default: --stats-dir or "
                         "a temp dir)")
+    p.add_argument("--rolling-update", action="store_true",
+                   help="run the zero-downtime weight-deploy rung instead: "
+                        "a rolling_update() walks the fleet drain → swap → "
+                        "rejoin under live traffic — zero accepted requests "
+                        "lost, zero compile-ledger rows in the roll window, "
+                        "schema-valid per-replica weight_swaps.jsonl with "
+                        "monotone versions, every replica at the new "
+                        "version at the end (rc-gated; artifacts land in "
+                        "--stats-dir or a temp dir)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -884,7 +1047,8 @@ def main() -> int:
                        "max_new": args.max_new_tokens,
                        "page_size": args.page_size}}
     rc = 0
-    rungs = ((run_disagg,) if args.disagg
+    rungs = ((run_rolling_update,) if args.rolling_update
+             else (run_disagg,) if args.disagg
              else (run_autopilot,) if args.autopilot
              else (run_scale, run_affinity, run_failover))
     for rung in rungs:

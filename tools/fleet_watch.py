@@ -13,7 +13,9 @@ metrics server:
   recent tail (action, trigger, replica, budget remaining) — what the
   controller did about the alerts above, live;
 - **per-replica view** — one row per replica artifact dir: KV occupancy
-  (pages in use / total), active slots, queue depth, tokens.
+  (pages in use / total), active slots, queue depth, tokens, and the
+  live ``wver`` (the replica's ``weights/weights_version`` gauge — a
+  mixed column mid-rolling-update is the deploy progressing, not a bug).
 
 Usage:
     python tools/fleet_watch.py --run-dir /runs/r1/obs          # artifacts
@@ -223,7 +225,8 @@ def render_run_dir(run_dir: str) -> str:
     if per_replica:
         lines += ["", "== replicas =="]
         lines.append(f"  {'replica':<12} {'role':<8} {'pages':>13} "
-                     f"{'occ':>7} {'active':>7} {'queue':>7} {'tokens':>9}")
+                     f"{'occ':>7} {'active':>7} {'queue':>7} {'tokens':>9} "
+                     f"{'wver':>5}")
         for label in sorted(per_replica):
             snap = per_replica[label]
             total = snap.get("kvcache/pages_total", 0.0)
@@ -233,12 +236,16 @@ def render_run_dir(run_dir: str) -> str:
             # "replica0" — match on the numeric suffix when present
             rid = "".join(ch for ch in label if ch.isdigit())
             role = replica_roles.get(rid) or "-"
+            # a replica that never swapped has no weights/ gauge yet:
+            # render the implicit version 0, not a blank
+            wver = snap.get("weights/weights_version")
             lines.append(
                 f"  {label:<12} {role:<8} "
                 f"{_fmt(in_use)}/{_fmt(total):<6} {occ:>7} "
                 f"{_fmt(snap.get('serving/slots_active')):>7} "
                 f"{_fmt(snap.get('serving/queue_depth')):>7} "
-                f"{_fmt(snap.get('serving/tokens_total')):>9}")
+                f"{_fmt(snap.get('serving/tokens_total')):>9} "
+                f"{_fmt(wver if wver is not None else 0):>5}")
     return "\n".join(lines) + "\n"
 
 

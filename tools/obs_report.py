@@ -42,7 +42,10 @@ alert rule firing in B that never fired in A, B's perf-attribution
 rollup MFU sagging below ``(1 - --mfu-regress-threshold) * A``'s, or B's
 autopilot action rate past ``(1 + --autopilot-regress-threshold) * A``'s
 (a controller acting more often under the same workload is flapping or
-fighting a real regression) — so CI can gate on it.
+fighting a real regression), weight-swap FAILURES appearing in B when
+every swap in A committed, or any replica's weights_version going
+non-monotonic in B (both threshold-free deploy gates) — so CI can gate
+on it.
 """
 
 from __future__ import annotations
@@ -110,6 +113,12 @@ def main(argv=None) -> int:
                         "*autopilot_actions.jsonl auto-detected in "
                         "--run-dir) — builds the autopilot section "
                         "(action table, per-trigger rollup, action rate)")
+    p.add_argument("--weight-swaps", action="append", default=[],
+                   help="weight_swaps.jsonl file (repeatable; "
+                        "*weight_swaps.jsonl auto-detected in --run-dir "
+                        "and its replica subdirs) — builds the weights "
+                        "section (live-swap/failure counts by source, "
+                        "per-replica version table, monotonicity check)")
     p.add_argument("--compare", nargs=2, metavar=("RUN_A", "RUN_B"),
                    default=None,
                    help="compile/memory regression diff between two run "
@@ -151,7 +160,8 @@ def main(argv=None) -> int:
         if args.out:
             doc = {k: diff[k] for k in ("a", "b", "compile", "memory",
                                         "alerts", "perf", "autopilot",
-                                        "regressions", "regressed")}
+                                        "weights", "regressions",
+                                        "regressed")}
             with open(args.out, "w") as f:
                 f.write(json.dumps(doc, indent=2) + "\n")
         if args.markdown:
@@ -168,7 +178,7 @@ def main(argv=None) -> int:
             or args.hlo_audit or args.timeline or args.supervisor_events
             or args.trace or args.compile_ledger or args.memory_breakdown
             or args.alerts or args.perf or args.router_stats
-            or args.autopilot):
+            or args.autopilot or args.weight_swaps):
         p.error("nothing to report on: pass --run-dir or explicit artifact paths")
 
     from neuronx_distributed_tpu.obs.report import build_report, render_markdown
@@ -197,6 +207,7 @@ def main(argv=None) -> int:
         router_stats_path=args.router_stats,
         perf_paths=args.perf,
         autopilot_paths=args.autopilot,
+        weights_paths=args.weight_swaps,
         tail=args.tail,
     )
     validate_record("obs_report", report)  # the emitter honors its own schema

@@ -265,6 +265,40 @@ def _validate_autopilot_dir(actions_dir: str) -> tuple:
     return True, counts
 
 
+def _validate_weight_swaps_dir(swaps_dir: str) -> tuple:
+    """Post-hook for the fleet_rolling_update job: the roll must have
+    dropped at least one per-replica ``*weight_swaps.jsonl``, every file
+    must validate against the checked-in ``weight_swap`` schema, be
+    non-empty (an empty audit trail means the roll never swapped), and
+    carry strictly increasing versions across its committed records.
+    Returns ``(ok, detail)``."""
+    import glob
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from neuronx_distributed_tpu.obs.schemas import validate_jsonl
+
+    files = sorted(glob.glob(os.path.join(swaps_dir, "*weight_swaps.jsonl")))
+    if not files:
+        return False, f"no weight_swaps artifacts in {swaps_dir}"
+    counts = {}
+    for f in files:
+        try:
+            n = validate_jsonl("weight_swap", f)
+        except ValueError as e:
+            return False, f"{os.path.basename(f)}: {e}"
+        if n == 0:
+            return False, (f"{os.path.basename(f)}: empty swap audit trail "
+                           f"(the roll must have swapped this replica)")
+        versions = [r["version"] for r in
+                    (json.loads(l) for l in open(f) if l.strip()) if r["ok"]]
+        if versions != sorted(set(versions)):
+            return False, (f"{os.path.basename(f)}: non-monotonic "
+                           f"weights_version sequence {versions}")
+        counts[os.path.basename(f)] = n
+    return True, counts
+
+
 def run_extra_jobs(results_path: str) -> None:
     """One-shot jobs that ride the first healthy window (VERDICT r3 #6)."""
     import tempfile
@@ -274,6 +308,7 @@ def run_extra_jobs(results_path: str) -> None:
     alerts_dir = tempfile.mkdtemp(prefix="tpu_watch_alerts_")
     perf_dir = tempfile.mkdtemp(prefix="tpu_watch_perf_")
     autopilot_dir = tempfile.mkdtemp(prefix="tpu_watch_autopilot_")
+    rolling_dir = tempfile.mkdtemp(prefix="tpu_watch_rolling_")
     jobs = [
         ("tp_allreduce", [sys.executable, os.path.join(REPO, "tools", "ici_bench.py")]),
         ("serving_latency", [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")]),
@@ -348,6 +383,17 @@ def run_extra_jobs(results_path: str) -> None:
                              os.path.join(REPO, "tools", "fleet_bench.py"),
                              "--autopilot", "--actions-out",
                              autopilot_dir]),
+        # zero-downtime weight deploy (weights/ + serving/fleet/): a
+        # rolling_update() walks the fleet drain → swap → rejoin under
+        # live traffic — zero accepted requests lost, zero compile-ledger
+        # rows in the roll window, every replica at the new version, and
+        # each replica's weight_swaps.jsonl schema-valid with monotone
+        # versions (asserted by the post-hook; rc-gated)
+        ("fleet_rolling_update", [sys.executable,
+                                  os.path.join(REPO, "tools",
+                                               "fleet_bench.py"),
+                                  "--rolling-update", "--stats-dir",
+                                  rolling_dir]),
         # multi-tenant serving (tenancy/ subsystem): >= 8 LoRA adapters
         # co-batched at near-baseline inter-token p99 (rc-gated)
         ("serving_lora", [sys.executable,
@@ -459,6 +505,17 @@ def run_extra_jobs(results_path: str) -> None:
                     error = (f"autopilot validation: {detail}"
                              + (f" | bench: {error}" if error else ""))
                 ok = ok and ap_ok
+            if name == "fleet_rolling_update":
+                # artifact-first: the per-replica swap audit trail
+                # certifies the deploy whatever the bench gate said
+                ws_ok, detail = _validate_weight_swaps_dir(rolling_dir)
+                if ws_ok:
+                    payload = {"weight_swap_records": detail,
+                               **(payload or {})}
+                else:
+                    error = (f"weight-swap validation: {detail}"
+                             + (f" | bench: {error}" if error else ""))
+                ok = ok and ws_ok
             append(results_path, {"kind": name, "ok": ok,
                                   "result": payload, "error": error})
         except subprocess.TimeoutExpired:
